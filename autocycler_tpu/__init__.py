@@ -1,0 +1,25 @@
+"""autocycler-tpu: a TPU-native consensus-assembly framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of rrwick/Autocycler
+(long-read bacterial consensus assembly).  The hot computations (k-mer
+grouping, all-vs-all contig distance, path-overlap DP, dotplot grid) run as
+batched device kernels; the irregular graph surgery stays on the host.
+
+Layering (bottom → top), mirroring the reference's layer map
+(see SURVEY.md §1; reference: /root/reference/src/main.rs:18-42):
+
+- ``utils``    — I/O, logging, small numerics (reference: misc.rs, log.rs)
+- ``models``   — Sequence / Position / Unitig / UnitigGraph data model
+                 (reference: sequence.rs, position.rs, unitig.rs,
+                 unitig_graph.rs, graph_simplification.rs)
+- ``ops``      — JAX/Pallas device kernels (greenfield; replaces the
+                 reference's hash-map hot loops, kmer_graph.rs)
+- ``parallel`` — mesh / sharding for batched multi-isolate runs (greenfield)
+- ``commands`` — the 12 pipeline subcommands (reference: compress.rs,
+                 cluster.rs, trim.rs, resolve.rs, combine.rs, clean.rs,
+                 decompress.rs, dotplot.rs, gfa2fasta.rs, subsample.rs,
+                 table.rs, helper.rs)
+- ``cli``      — argparse front-end (reference: main.rs)
+"""
+
+__version__ = "0.1.0"

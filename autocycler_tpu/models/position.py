@@ -1,0 +1,35 @@
+"""Occurrence of a graph element within an input contig.
+
+Parity target: reference position.rs:19-56, which bit-packs seq_id (15 bits)
+and strand (1 bit) into a u16 plus a u32 position. On the device side we use a
+struct-of-arrays int32 layout instead (ops.kmers); this host-side class is the
+ergonomic single-occurrence view. The 32767-sequence cap from the bit packing
+is enforced at load time (reference compress.rs:112-114).
+"""
+
+from __future__ import annotations
+
+from ..utils import FORWARD
+
+MAX_SEQ_ID = 32767  # 15-bit packing limit, reference position.rs:21 + compress.rs:112-114
+
+
+class Position:
+    __slots__ = ("seq_id", "strand", "pos")
+
+    def __init__(self, seq_id: int, strand: bool, pos: int):
+        self.seq_id = seq_id
+        self.strand = strand
+        self.pos = pos
+
+    def __repr__(self) -> str:
+        return f"{self.seq_id}{'+' if self.strand else '-'}{self.pos}"
+
+    def __eq__(self, other) -> bool:
+        return (self.seq_id, self.strand, self.pos) == (other.seq_id, other.strand, other.pos)
+
+    def __hash__(self) -> int:
+        return hash((self.seq_id, self.strand, self.pos))
+
+    def copy(self) -> "Position":
+        return Position(self.seq_id, self.strand, self.pos)

@@ -1,0 +1,572 @@
+"""UnitigGraph: the central host-side graph structure.
+
+Parity target: reference unitig_graph.rs (1501 LoC). The graph is the
+serialization format of the whole data model: every pipeline stage writes a
+self-contained GFA (S segments with DP/CL tags, 0M L links, P path lines with
+LN/FN/HD/CL provenance tags) that the next stage re-loads — see reference
+unitig_graph.rs:50-174 (load) and :317-360 (save).
+
+Construction from k-mers happens in ops/ + commands/compress.py (the device
+path); this module owns parsing, serialization, link surgery, invariants and
+topology queries. Irregular pointer-chasing graph mutation stays on the host
+by design (SURVEY.md §2.1, §7).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import (FORWARD, REVERSE, load_file_lines, quit_with_error, sign_at_end)
+from .position import Position
+from .sequence import Sequence
+from .unitig import Unitig, UnitigStrand
+
+
+def parse_unitig_path(path_str: str) -> List[Tuple[int, bool]]:
+    """'1+,2-,3+' -> [(1, True), (2, False), (3, True)]
+    (reference unitig_graph.rs:971-979)."""
+    path = []
+    for token in path_str.split(","):
+        if token.endswith("+"):
+            strand = FORWARD
+        elif token.endswith("-"):
+            strand = REVERSE
+        else:
+            quit_with_error(f"Invalid path strand: {token}")
+        path.append((int(token[:-1]), strand))
+    return path
+
+
+def reverse_path(path: List[Tuple[int, bool]]) -> List[Tuple[int, bool]]:
+    return [(num, not strand) for num, strand in reversed(path)]
+
+
+class UnitigGraph:
+    def __init__(self, k_size: int = 0):
+        self.unitigs: List[Unitig] = []
+        self.k_size = k_size
+        self.index: Dict[int, Unitig] = {}
+
+    # ---------------- loading ----------------
+
+    @classmethod
+    def from_gfa_file(cls, gfa_filename) -> Tuple["UnitigGraph", List[Sequence]]:
+        return cls.from_gfa_lines(load_file_lines(gfa_filename))
+
+    @classmethod
+    def from_gfa_lines(cls, gfa_lines) -> Tuple["UnitigGraph", List[Sequence]]:
+        graph = cls()
+        link_lines, path_lines = [], []
+        for line in gfa_lines:
+            parts = line.rstrip("\n").split("\t")
+            if not parts:
+                continue
+            if parts[0] == "H":
+                graph._read_header_line(parts)
+            elif parts[0] == "S":
+                graph.unitigs.append(Unitig.from_segment_line(line))
+            elif parts[0] == "L":
+                link_lines.append(parts)
+            elif parts[0] == "P":
+                path_lines.append(parts)
+        graph.build_index()
+        graph._build_links_from_gfa(link_lines)
+        sequences = graph._build_paths_from_gfa(path_lines)
+        graph.check_links()
+        return graph, sequences
+
+    def _read_header_line(self, parts: List[str]) -> None:
+        for p in parts:
+            if p.startswith("KM:i:"):
+                try:
+                    self.k_size = int(p[5:])
+                    return
+                except ValueError:
+                    pass
+
+    def build_index(self) -> None:
+        self.index = {u.number: u for u in self.unitigs}
+
+    def _build_links_from_gfa(self, link_lines: List[List[str]]) -> None:
+        for parts in link_lines:
+            if len(parts) < 6 or parts[5] != "0M":
+                quit_with_error("non-zero overlap found on the GFA link line.\n"
+                                "Are you sure this is an Autocycler-generated GFA file?")
+            seg_1, seg_2 = int(parts[1]), int(parts[3])
+            strand_1, strand_2 = parts[2] == "+", parts[4] == "+"
+            u1 = self.index.get(seg_1)
+            u2 = self.index.get(seg_2)
+            if u1 is None:
+                quit_with_error(f"link refers to nonexistent unitig: {seg_1}")
+            if u2 is None:
+                quit_with_error(f"link refers to nonexistent unitig: {seg_2}")
+            (u1.forward_next if strand_1 else u1.reverse_next).append(UnitigStrand(u2, strand_2))
+            (u2.forward_prev if strand_2 else u2.reverse_prev).append(UnitigStrand(u1, strand_1))
+
+    def _build_paths_from_gfa(self, path_lines: List[List[str]]) -> List[Sequence]:
+        sequences = []
+        for parts in path_lines:
+            seq_id = int(parts[1])
+            length = filename = header = None
+            cluster = 0
+            for p in parts[2:]:
+                if p.startswith("LN:i:"):
+                    length = int(p[5:])
+                elif p.startswith("FN:Z:"):
+                    filename = p[5:]
+                elif p.startswith("HD:Z:"):
+                    header = p[5:]
+                elif p.startswith("CL:i:"):
+                    cluster = int(p[5:])
+            if length is None or filename is None or header is None:
+                quit_with_error("missing required tag in GFA path line.")
+            path = parse_unitig_path(parts[2])
+            sequences.append(self.create_sequence_and_positions(
+                seq_id, length, filename, header, cluster, path))
+        return sequences
+
+    def create_sequence_and_positions(self, seq_id: int, length: int, filename: str,
+                                      header: str, cluster: int,
+                                      forward_path: List[Tuple[int, bool]]) -> Sequence:
+        """Register a sequence's path through the graph by stamping Position
+        records onto each traversed unitig, both strands
+        (reference unitig_graph.rs:151-174)."""
+        self._add_positions_from_path(forward_path, FORWARD, seq_id, length)
+        self._add_positions_from_path(reverse_path(forward_path), REVERSE, seq_id, length)
+        return Sequence.without_seq(seq_id, filename, header, length, cluster)
+
+    def _add_positions_from_path(self, path, path_strand: bool, seq_id: int,
+                                 length: int) -> None:
+        pos = 0
+        for unitig_num, unitig_strand in path:
+            unitig = self.index.get(unitig_num)
+            if unitig is None:
+                quit_with_error(f"unitig {unitig_num} not found in unitig index")
+            positions = unitig.forward_positions if unitig_strand else unitig.reverse_positions
+            positions.append(Position(seq_id, path_strand, pos))
+            pos += unitig.length()
+        assert pos == length, "Position calculation mismatch"
+
+    # ---------------- saving ----------------
+
+    def save_gfa(self, gfa_filename, sequences: List[Sequence],
+                 use_other_colour: bool = False) -> None:
+        with open(gfa_filename, "w") as f:
+            f.write(self.gfa_text(sequences, use_other_colour))
+
+    def gfa_text(self, sequences: List[Sequence], use_other_colour: bool = False) -> str:
+        lines = [f"H\tVN:Z:1.0\tKM:i:{self.k_size}"]
+        for unitig in self.unitigs:
+            lines.append(unitig.gfa_segment_line(use_other_colour))
+        for a, a_strand, b, b_strand in self.links_for_gfa():
+            lines.append(f"L\t{a}\t{a_strand}\t{b}\t{b_strand}\t0M")
+        for seq in sequences:
+            lines.append(self.gfa_path_line(seq))
+        return "\n".join(lines) + "\n"
+
+    def links_for_gfa(self, offset: int = 0):
+        links = []
+        for a in self.unitigs:
+            for b in a.forward_next:
+                links.append((a.number + offset, "+", b.number + offset,
+                              "+" if b.strand else "-"))
+            for b in a.reverse_next:
+                links.append((a.number + offset, "-", b.number + offset,
+                              "+" if b.strand else "-"))
+        return links
+
+    def gfa_path_line(self, seq: Sequence) -> str:
+        path = self.get_unitig_path_for_sequence(seq)
+        path_str = ",".join(f"{num}{'+' if strand else '-'}" for num, strand in path)
+        cluster_tag = f"\tCL:i:{seq.cluster}" if seq.cluster > 0 else ""
+        return (f"P\t{seq.id}\t{path_str}\t*\tLN:i:{seq.length}\tFN:Z:{seq.filename}"
+                f"\tHD:Z:{seq.contig_header}{cluster_tag}")
+
+    # ---------------- sequence reconstruction ----------------
+
+    def get_sequence_from_path(self, path: List[Tuple[int, bool]]) -> np.ndarray:
+        pieces = [self.index[num].get_seq(strand) for num, strand in path]
+        if not pieces:
+            return np.zeros(0, dtype=np.uint8)
+        return np.concatenate(pieces)
+
+    def get_sequence_from_path_signed(self, path: List[int]) -> np.ndarray:
+        return self.get_sequence_from_path([(abs(n), n >= 0) for n in path])
+
+    def _find_starting_unitig(self, seq_id: int) -> UnitigStrand:
+        """The unitig+strand where the given sequence's path begins
+        (reference unitig_graph.rs:407-425)."""
+        starting = []
+        for unitig in self.unitigs:
+            for p in unitig.forward_positions:
+                if p.seq_id == seq_id and p.strand and p.pos == 0:
+                    starting.append(UnitigStrand(unitig, FORWARD))
+            for p in unitig.reverse_positions:
+                if p.seq_id == seq_id and p.strand and p.pos == 0:
+                    starting.append(UnitigStrand(unitig, REVERSE))
+        assert len(starting) == 1
+        return starting[0]
+
+    def _get_next_unitig(self, seq_id: int, seq_strand: bool, unitig: Unitig,
+                         strand: bool, pos: int) -> Optional[Tuple[UnitigStrand, int]]:
+        next_pos = pos + unitig.length()
+        next_edges = unitig.forward_next if strand else unitig.reverse_next
+        for nxt in next_edges:
+            positions = (nxt.unitig.forward_positions if nxt.strand
+                         else nxt.unitig.reverse_positions)
+            for p in positions:
+                if p.seq_id == seq_id and p.strand == seq_strand and p.pos == next_pos:
+                    return UnitigStrand(nxt.unitig, nxt.strand), next_pos
+        return None
+
+    def get_unitig_path_for_sequence(self, seq: Sequence) -> List[Tuple[int, bool]]:
+        path = []
+        u = self._find_starting_unitig(seq.id)
+        pos = 0
+        while True:
+            path.append((u.number, u.strand))
+            step = self._get_next_unitig(seq.id, FORWARD, u.unitig, u.strand, pos)
+            if step is None:
+                break
+            u, pos = step
+        return path
+
+    def get_unitig_path_for_sequence_i32(self, seq: Sequence) -> List[int]:
+        return [num if strand else -num
+                for num, strand in self.get_unitig_path_for_sequence(seq)]
+
+    def reconstruct_original_sequences(self, seqs: List[Sequence]
+                                       ) -> Dict[str, List[Tuple[str, str]]]:
+        """filename -> [(header, sequence string)], in input order
+        (reference unitig_graph.rs:362-370)."""
+        out: Dict[str, List[Tuple[str, str]]] = {}
+        for seq in seqs:
+            path = self.get_unitig_path_for_sequence(seq)
+            sequence = self.get_sequence_from_path(path)
+            assert len(sequence) == seq.length, \
+                "reconstructed sequence does not have expected length"
+            out.setdefault(seq.filename, []).append(
+                (seq.contig_header, sequence.tobytes().decode()))
+        return out
+
+    # ---------------- stats / topology ----------------
+
+    def total_length(self) -> int:
+        return sum(u.length() for u in self.unitigs)
+
+    def link_count(self) -> Tuple[int, int]:
+        """(all links incl. reverse-duplicates, single-direction links)
+        (reference unitig_graph.rs:478-507)."""
+        all_links, one_way = set(), set()
+        for a in self.unitigs:
+            for signed_a, nexts in ((a.number, a.forward_next), (-a.number, a.reverse_next)):
+                for b in nexts:
+                    link = (signed_a, b.signed_number())
+                    rev_link = (-link[1], -link[0])
+                    all_links.add(link)
+                    all_links.add(rev_link)
+                    one_way.add(max(link, rev_link))
+        return len(all_links), len(one_way)
+
+    def topology(self) -> str:
+        """circular / linear-open-open / linear-hairpin-hairpin /
+        linear-open-hairpin / fragmented / empty / other
+        (reference unitig_graph.rs:527-545)."""
+        if not self.unitigs:
+            return "empty"
+        if len(self.unitigs) > 1:
+            return "fragmented"
+        u = self.unitigs[0]
+        if self.link_count()[0] == 0:
+            return "linear-open-open"
+        if u.is_isolated_and_circular():
+            return "circular"
+        if u.hairpin_start() and u.hairpin_end():
+            return "linear-hairpin-hairpin"
+        if u.hairpin_start() and u.open_end():
+            return "linear-open-hairpin"
+        if u.open_start() and u.hairpin_end():
+            return "linear-open-hairpin"
+        return "other"
+
+    def max_unitig_number(self) -> int:
+        return max((u.number for u in self.unitigs), default=0)
+
+    def print_basic_graph_info(self, with_topology: bool = False) -> None:
+        from ..utils import log
+        n, links = len(self.unitigs), self.link_count()[1]
+        topo = f" ({self.topology()})" if with_topology else ""
+        log.message(f"{n} unitig{'' if n == 1 else 's'}, "
+                    f"{links} link{'' if links == 1 else 's'}{topo}")
+        log.message(f"total length: {self.total_length()} bp")
+        log.message()
+
+    # ---------------- renumbering ----------------
+
+    def renumber_unitigs(self) -> None:
+        """Deterministic renumbering by (length desc, sequence lex asc,
+        depth desc) — the reproducibility anchor of the whole pipeline
+        (reference unitig_graph.rs:295-315)."""
+        self.unitigs.sort(key=lambda u: (-u.length(), u.forward_seq.tobytes(), -u.depth))
+        for i, unitig in enumerate(self.unitigs):
+            unitig.number = i + 1
+        self.build_index()
+
+    # ---------------- link surgery ----------------
+
+    def _unitig_for_signed(self, signed_num: int) -> Tuple[Unitig, bool]:
+        unitig = self.index.get(abs(signed_num))
+        if unitig is None:
+            quit_with_error(f"unitig {abs(signed_num)} not found in unitig index")
+        return unitig, signed_num > 0
+
+    def create_link(self, start_num: int, end_num: int) -> None:
+        """Create a signed link (and its reverse-strand twin unless it is its
+        own twin, i.e. a hairpin) (reference unitig_graph.rs:867-893)."""
+        self._create_link_one_way(start_num, end_num)
+        if start_num != -end_num:
+            self._create_link_one_way(-end_num, -start_num)
+
+    def _create_link_one_way(self, start_num: int, end_num: int) -> None:
+        start, start_strand = self._unitig_for_signed(start_num)
+        end, end_strand = self._unitig_for_signed(end_num)
+        (start.forward_next if start_strand else start.reverse_next).append(
+            UnitigStrand(end, end_strand))
+        (end.forward_prev if end_strand else end.reverse_prev).append(
+            UnitigStrand(start, start_strand))
+
+    def delete_link(self, start_num: int, end_num: int) -> None:
+        self._delete_link_one_way(start_num, end_num)
+        self._delete_link_one_way(-end_num, -start_num)
+
+    def _delete_link_one_way(self, start_num: int, end_num: int) -> None:
+        start, start_strand = self._unitig_for_signed(start_num)
+        end, end_strand = self._unitig_for_signed(end_num)
+        nexts = start.forward_next if start_strand else start.reverse_next
+        keep = [c for c in nexts
+                if not (c.number == abs(end_num) and c.strand == (end_num > 0))]
+        if start_strand:
+            start.forward_next = keep
+        else:
+            start.reverse_next = keep
+        prevs = end.forward_prev if end_strand else end.reverse_prev
+        keep = [c for c in prevs
+                if not (c.number == abs(start_num) and c.strand == (start_num > 0))]
+        if end_strand:
+            end.forward_prev = keep
+        else:
+            end.reverse_prev = keep
+
+    def delete_outgoing_links(self, signed_num: int) -> None:
+        unitig, strand = self._unitig_for_signed(signed_num)
+        nexts = unitig.forward_next if strand else unitig.reverse_next
+        for next_num in [u.signed_number() for u in nexts]:
+            self.delete_link(signed_num, next_num)
+
+    def delete_incoming_links(self, signed_num: int) -> None:
+        unitig, strand = self._unitig_for_signed(signed_num)
+        prevs = unitig.forward_prev if strand else unitig.reverse_prev
+        for prev_num in [u.signed_number() for u in prevs]:
+            self.delete_link(prev_num, signed_num)
+
+    def link_exists(self, a_num: int, a_strand: bool, b_num: int, b_strand: bool) -> bool:
+        unitig = self.index.get(a_num)
+        if unitig is None:
+            return False
+        nexts = unitig.forward_next if a_strand else unitig.reverse_next
+        return any(n.number == b_num and n.strand == b_strand for n in nexts)
+
+    def link_exists_prev(self, a_num: int, a_strand: bool, b_num: int, b_strand: bool) -> bool:
+        unitig = self.index.get(b_num)
+        if unitig is None:
+            return False
+        prevs = unitig.forward_prev if b_strand else unitig.reverse_prev
+        return any(p.number == a_num and p.strand == a_strand for p in prevs)
+
+    def check_links(self) -> None:
+        """Invariant checker: every link has its strand twin, its prev/next
+        mirror, and resolves through the index (reference
+        unitig_graph.rs:752-793). Raises AssertionError on violation."""
+        for a in self.unitigs:
+            for b in a.forward_next:
+                self._check_one_link(a.number, FORWARD, b.number, b.strand)
+            for b in a.reverse_next:
+                self._check_one_link(a.number, REVERSE, b.number, b.strand)
+            for b in a.forward_prev:
+                self._check_one_link(b.number, b.strand, a.number, FORWARD)
+            for b in a.reverse_prev:
+                self._check_one_link(b.number, b.strand, a.number, REVERSE)
+
+    def _check_one_link(self, a_num: int, a_strand: bool, b_num: int, b_strand: bool) -> None:
+        assert self.link_exists(a_num, a_strand, b_num, b_strand), "missing next link"
+        assert self.link_exists_prev(a_num, a_strand, b_num, b_strand), "missing prev link"
+        assert self.link_exists(b_num, not b_strand, a_num, not a_strand), "missing next link"
+        assert self.link_exists_prev(b_num, not b_strand, a_num, not a_strand), "missing prev link"
+        assert a_num in self.index and b_num in self.index, "unitig missing from index"
+
+    def delete_dangling_links(self) -> None:
+        """Drop links that point at unitigs no longer in the graph
+        (reference unitig_graph.rs:547-564)."""
+        numbers = {u.number for u in self.unitigs}
+        for u in self.unitigs:
+            u.forward_next = [c for c in u.forward_next if c.number in numbers]
+            u.forward_prev = [c for c in u.forward_prev if c.number in numbers]
+            u.reverse_next = [c for c in u.reverse_next if c.number in numbers]
+            u.reverse_prev = [c for c in u.reverse_prev if c.number in numbers]
+
+    # ---------------- unitig-level surgery ----------------
+
+    def remove_sequence_from_graph(self, seq_id: int) -> None:
+        for u in self.unitigs:
+            u.remove_sequence(seq_id)
+
+    def recalculate_depths(self) -> None:
+        for u in self.unitigs:
+            u.recalculate_depth()
+
+    def clear_positions(self) -> None:
+        for u in self.unitigs:
+            u.clear_positions()
+
+    def remove_zero_depth_unitigs(self) -> None:
+        self.unitigs = [u for u in self.unitigs if u.depth > 0.0]
+        self.delete_dangling_links()
+        self.build_index()
+
+    def remove_unitigs_by_number(self, to_remove) -> None:
+        to_remove = set(to_remove)
+        self.unitigs = [u for u in self.unitigs if u.number not in to_remove]
+        self.delete_dangling_links()
+        self.build_index()
+
+    def duplicate_unitig_by_number(self, unitig_num: int) -> None:
+        """Split a unitig with exactly two non-self links into two half-depth
+        copies, one link each; self-links are copied to both
+        (reference unitig_graph.rs:594-653)."""
+        target = self.index.get(unitig_num)
+        if target is None:
+            quit_with_error(f"unitig {unitig_num} not found in unitig index")
+        non_self = [(target.number, link.signed_number())
+                    for link in target.forward_next if link.number != unitig_num]
+        non_self += [(-target.number, link.signed_number())
+                     for link in target.reverse_next if link.number != unitig_num]
+        if len(non_self) != 2:
+            quit_with_error(f"unitig {unitig_num} does not contain exactly two "
+                            "non-self links")
+        self_links_fwd = [link.strand for link in target.forward_next
+                          if link.number == unitig_num]
+        self_links_rev = [link.strand for link in target.reverse_next
+                          if link.number == unitig_num]
+
+        a_num = self.max_unitig_number() + 1
+        b_num = a_num + 1
+        copies = []
+        for new_num in (a_num, b_num):
+            copy = Unitig(new_num, target.forward_seq.copy(), target.reverse_seq.copy(),
+                          depth=target.depth / 2.0, unitig_type=target.unitig_type)
+            copy.forward_positions = [p.copy() for p in target.forward_positions]
+            copy.reverse_positions = [p.copy() for p in target.reverse_positions]
+            copies.append(copy)
+        self.unitigs.extend(copies)
+        self.remove_unitigs_by_number({unitig_num})
+
+        for strand in self_links_fwd:
+            self.create_link(a_num, a_num if strand else -a_num)
+            self.create_link(b_num, b_num if strand else -b_num)
+        for strand in self_links_rev:
+            self.create_link(-a_num, a_num if strand else -a_num)
+            self.create_link(-b_num, b_num if strand else -b_num)
+
+        def substitute(pair, new_num):
+            start, end = pair
+            start = new_num if start == unitig_num else (-new_num if start == -unitig_num else start)
+            end = new_num if end == unitig_num else (-new_num if end == -unitig_num else end)
+            return start, end
+
+        self.create_link(*substitute(non_self[0], a_num))
+        self.create_link(*substitute(non_self[1], b_num))
+        self.check_links()
+
+    def remove_low_depth_unitigs(self, min_depth: float) -> None:
+        """Remove unitigs at/below the depth threshold, but only when removal
+        creates no dead ends (reference unitig_graph.rs:670-721). Iterates in
+        reverse unitig order so longer unitigs are kept."""
+        for u in list(reversed(self.unitigs)):
+            if u.number not in self.index:
+                continue
+            if u.depth > min_depth:
+                continue
+            ok = True
+            for next_us in u.forward_next:
+                if next_us.number == u.number:
+                    continue
+                prevs = (next_us.unitig.forward_prev if next_us.strand
+                         else next_us.unitig.reverse_prev)
+                if not any(lk.number != u.number for lk in prevs):
+                    ok = False
+                    break
+            if ok:
+                for prev_us in u.forward_prev:
+                    if prev_us.number == u.number:
+                        continue
+                    nexts = (prev_us.unitig.forward_next if prev_us.strand
+                             else prev_us.unitig.reverse_next)
+                    if not any(lk.number != u.number for lk in nexts):
+                        ok = False
+                        break
+            if not ok:
+                continue
+            self.unitigs = [x for x in self.unitigs if x.number != u.number]
+            self.delete_dangling_links()
+            self.build_index()
+
+    # ---------------- components ----------------
+
+    def connected_components(self) -> List[List[int]]:
+        """Connected components as sorted lists of unitig numbers, sorted
+        (reference unitig_graph.rs:905-933)."""
+        visited = set()
+        components = []
+        for unitig in self.unitigs:
+            if unitig.number in visited:
+                continue
+            component = []
+            stack = [unitig.number]
+            while stack:
+                current = stack.pop()
+                if current in visited:
+                    continue
+                visited.add(current)
+                component.append(current)
+                u = self.index[current]
+                for links in (u.forward_next, u.forward_prev, u.reverse_next, u.reverse_prev):
+                    for c in links:
+                        if c.number not in visited:
+                            stack.append(c.number)
+            component.sort()
+            components.append(component)
+        components.sort()
+        return components
+
+    def component_is_circular_loop(self, component: List[int]) -> bool:
+        """Whether a component forms one simple circular loop
+        (reference unitig_graph.rs:949-967)."""
+        if not component:
+            return False
+        first = component[0]
+        num, strand = first, FORWARD
+        visited = set()
+        while num != first or not visited:
+            if num in visited:
+                return False
+            visited.add(num)
+            unitig = self.index[num]
+            if (len(unitig.forward_next) != 1 or len(unitig.forward_prev) != 1 or
+                    len(unitig.reverse_next) != 1 or len(unitig.reverse_prev) != 1):
+                return False
+            nxt = unitig.forward_next[0] if strand else unitig.reverse_next[0]
+            num, strand = nxt.number, nxt.strand
+        return len(visited) == len(component)

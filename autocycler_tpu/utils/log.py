@@ -1,0 +1,41 @@
+"""stderr logging: timestamped section headers and dimmed explanations.
+
+Parity target: reference log.rs:18-44 (bold/underline headers with timestamp,
+wrapped dim explanation text). Colour is suppressed when stderr is not a TTY.
+"""
+
+from __future__ import annotations
+
+import datetime
+import sys
+import textwrap
+
+BOLD = "\033[1m"
+UNDERLINE = "\033[4m"
+DIM = "\033[2m"
+RESET = "\033[0m"
+
+
+def _colour_enabled() -> bool:
+    return sys.stderr.isatty()
+
+
+def section_header(text: str) -> None:
+    timestamp = datetime.datetime.now().strftime("%Y-%m-%d %H:%M:%S")
+    if _colour_enabled():
+        print(f"{DIM}{timestamp}{RESET}  {BOLD}{UNDERLINE}{text}{RESET}", file=sys.stderr)
+    else:
+        print(f"{timestamp}  {text}", file=sys.stderr)
+
+
+def explanation(text: str) -> None:
+    wrapped = textwrap.fill(" ".join(text.split()), width=80)
+    if _colour_enabled():
+        print(f"{DIM}{wrapped}{RESET}", file=sys.stderr)
+    else:
+        print(wrapped, file=sys.stderr)
+    print(file=sys.stderr)
+
+
+def message(text: str = "") -> None:
+    print(text, file=sys.stderr)

@@ -1,0 +1,246 @@
+"""Unit tests for the host data model (Sequence/Position/Unitig/UnitigGraph).
+
+Covers the behaviours the reference tests in sequence.rs, position.rs,
+unitig.rs and unitig_graph.rs test modules, over the same fixture graphs.
+"""
+
+import numpy as np
+import pytest
+
+from autocycler_tpu.models import Position, Sequence, Unitig, UnitigGraph, UnitigStrand, UnitigType
+from autocycler_tpu.utils import AutocyclerError, FORWARD, REVERSE, reverse_complement
+
+from fixtures_gfa import (TEST_GFA_1, TEST_GFA_2, TEST_GFA_4, TEST_GFA_5, TEST_GFA_6,
+                          TEST_GFA_7, TEST_GFA_8, TEST_GFA_9, TEST_GFA_10, TEST_GFA_11,
+                          TEST_GFA_12, TEST_GFA_13, TEST_GFA_14, gfa_lines)
+
+
+# ---------------- Sequence ----------------
+
+def make_seq(header="c123", seq="A", half_k=1):
+    return Sequence.with_seq(1, seq, "assembly_1.fasta", header, half_k)
+
+
+def test_sequence_padding_and_revcomp():
+    s = Sequence.with_seq(1, "ACGT", "a.fasta", "c1", 3)
+    assert s.forward_seq.tobytes() == b"...ACGT..."
+    assert s.reverse_seq.tobytes() == b"...ACGT..."
+    s = Sequence.with_seq(2, "AACC", "a.fasta", "c2", 2)
+    assert s.forward_seq.tobytes() == b"..AACC.."
+    assert s.reverse_seq.tobytes() == b"..GGTT.."
+    assert s.length == 4
+
+
+def test_sequence_non_acgt():
+    with pytest.raises(AutocyclerError):
+        Sequence.with_seq(1, "ACGTN", "a.fasta", "c1", 2)
+
+
+def test_is_trusted():
+    assert not make_seq("c123").is_trusted()
+    assert not make_seq("c123 other stuff").is_trusted()
+    assert make_seq("c123 Autocycler_trusted").is_trusted()
+    assert make_seq("c123 other stuff autocycler_trusted").is_trusted()
+    assert make_seq("c123 AUTOCYCLER_TRUSTED other stuff").is_trusted()
+
+
+def test_cluster_weight():
+    assert make_seq("c123").cluster_weight() == 1
+    assert make_seq("c123 Autocycler_cluster_weight=1").cluster_weight() == 1
+    assert make_seq("c123 x Autocycler_cluster_weight=2 y").cluster_weight() == 2
+    assert make_seq("c123 AUTOCYCLER_CLUSTER_WEIGHT=5").cluster_weight() == 5
+    assert make_seq("c123 Autocycler_cluster_weight=0").cluster_weight() == 0
+    assert make_seq("c123 autocycler_cluster_weight=1234").cluster_weight() == 1234
+    assert make_seq("c123 Autocycler_cluster_weight=0.1").cluster_weight() == 1
+    assert make_seq("c123 Autocycler_cluster_weight=abc").cluster_weight() == 1
+
+
+def test_consensus_weight():
+    assert make_seq("c123").consensus_weight() == 1
+    assert make_seq("c123 AUTOCYCLER_CONSENSUS_WEIGHT=2").consensus_weight() == 2
+    assert make_seq("c123 x Autocycler_consensus_weight=0 y").consensus_weight() == 0
+    assert make_seq("c123 Autocycler_consensus_weight=23.456").consensus_weight() == 1
+    assert make_seq("c123 Autocycler_consensus_weight=-1").consensus_weight() == 1
+
+
+def test_sequence_display():
+    assert str(make_seq("c123")) == "assembly_1.fasta c123 (1 bp)"
+    assert str(make_seq("c123 Autocycler_trusted")) == "assembly_1.fasta c123 (1 bp) [trusted]"
+    assert (str(make_seq("c123 Autocycler_trusted Autocycler_cluster_weight=2"))
+            == "assembly_1.fasta c123 (1 bp) [trusted, cluster weight = 2]")
+
+
+def test_position_repr():
+    assert repr(Position(1, FORWARD, 123)) == "1+123"
+    assert repr(Position(2, REVERSE, 456)) == "2-456"
+    assert repr(Position(32767, FORWARD, 4294967295)) == "32767+4294967295"
+
+
+# ---------------- Unitig ----------------
+
+def test_from_segment_line():
+    u = Unitig.from_segment_line("S\t123\tACGATCGACTACGT\tDP:f:4.56")
+    assert str(u) == "unitig 123: ACGATCGACTACGT, 14 bp, 4.56x"
+    u = Unitig.from_segment_line("S\t321\tATCGACTACGACTACGACATCG\tDP:f:6.54")
+    assert str(u) == "unitig 321: ATCGAC...ACATCG, 22 bp, 6.54x"
+
+
+def test_segment_line_missing_depth():
+    with pytest.raises(AutocyclerError):
+        Unitig.from_segment_line("S\t1\tACGT")
+
+
+def test_unitig_get_seq():
+    a = Unitig.from_segment_line("S\t1\tGCTGAAGGGC\tDP:f:1")
+    assert a.seq_str(FORWARD) == "GCTGAAGGGC"
+    assert a.seq_str(REVERSE) == "GCCCTTCAGC"
+
+
+def _posed_unitig():
+    u = Unitig.from_segment_line("S\t1\tGCTGAAGGGC\tDP:f:1")
+    u.forward_positions = [Position(1, FORWARD, 100), Position(2, REVERSE, 200)]
+    u.reverse_positions = [Position(2, REVERSE, 890), Position(2, FORWARD, 790)]
+    return u
+
+
+def test_remove_seq_from_start():
+    u = _posed_unitig()
+    u.remove_seq_from_start(2)
+    assert u.seq_str() == "TGAAGGGC"
+    assert u.seq_str(REVERSE) == "GCCCTTCA"
+    assert [p.pos for p in u.forward_positions] == [102, 202]
+    assert [p.pos for p in u.reverse_positions] == [890, 790]
+
+
+def test_remove_seq_from_end():
+    u = _posed_unitig()
+    u.remove_seq_from_end(2)
+    assert u.seq_str() == "GCTGAAGG"
+    assert u.seq_str(REVERSE) == "CCTTCAGC"
+    assert [p.pos for p in u.forward_positions] == [100, 200]
+    assert [p.pos for p in u.reverse_positions] == [892, 792]
+
+
+def test_add_seq_to_start():
+    u = _posed_unitig()
+    u.add_seq_to_start(np.frombuffer(b"AC", dtype=np.uint8))
+    assert u.seq_str() == "ACGCTGAAGGGC"
+    assert u.seq_str(REVERSE) == "GCCCTTCAGCGT"
+    assert [p.pos for p in u.forward_positions] == [98, 198]
+    assert [p.pos for p in u.reverse_positions] == [890, 790]
+
+
+def test_add_seq_to_end():
+    u = _posed_unitig()
+    u.add_seq_to_end(np.frombuffer(b"AC", dtype=np.uint8))
+    assert u.seq_str() == "GCTGAAGGGCAC"
+    assert u.seq_str(REVERSE) == "GTGCCCTTCAGC"
+    assert [p.pos for p in u.forward_positions] == [100, 200]
+    assert [p.pos for p in u.reverse_positions] == [888, 788]
+
+
+# ---------------- UnitigGraph ----------------
+
+def test_graph_stats_gfa_1():
+    graph, _ = UnitigGraph.from_gfa_lines(gfa_lines(TEST_GFA_1))
+    graph.check_links()
+    assert graph.k_size == 9
+    assert len(graph.unitigs) == 10
+    assert graph.total_length() == 92
+    assert graph.link_count() == (21, 11)
+
+
+def test_gfa_round_trip():
+    for text in (TEST_GFA_1, TEST_GFA_2, TEST_GFA_4, TEST_GFA_5, TEST_GFA_8,
+                 TEST_GFA_9, TEST_GFA_14):
+        graph, seqs = UnitigGraph.from_gfa_lines(gfa_lines(text))
+        out = graph.gfa_text(seqs)
+        graph2, seqs2 = UnitigGraph.from_gfa_lines(out.splitlines())
+        assert graph2.gfa_text(seqs2) == out  # idempotent serialization
+        assert len(graph2.unitigs) == len(graph.unitigs)
+        assert graph2.link_count() == graph.link_count()
+
+
+def test_paths_and_positions_gfa_14():
+    graph, seqs = UnitigGraph.from_gfa_lines(gfa_lines(TEST_GFA_14))
+    assert [s.id for s in seqs] == [2, 4, 7]
+    assert [s.length for s in seqs] == [101, 178, 95]
+    assert [s.cluster for s in seqs] == [2, 2, 2]
+    p2 = graph.get_unitig_path_for_sequence_i32(seqs[0])
+    assert p2 == [8, 22, -17, 27, -18, 34, -5, 12, -21, 37, 19]
+    # Path reconstruction gives back sequences of the declared lengths.
+    seq_bytes = graph.get_sequence_from_path_signed(p2)
+    assert len(seq_bytes) == 101
+
+
+def test_topology():
+    cases = [
+        (TEST_GFA_8, "circular"),
+        (TEST_GFA_9, "linear-open-open"),
+        (TEST_GFA_10, "linear-hairpin-hairpin"),
+        (TEST_GFA_11, "linear-open-hairpin"),
+        (TEST_GFA_12, "linear-open-hairpin"),
+        (TEST_GFA_13, "other"),
+        (TEST_GFA_1, "fragmented"),
+    ]
+    for text, expected in cases:
+        graph, _ = UnitigGraph.from_gfa_lines(gfa_lines(text))
+        assert graph.topology() == expected, expected
+    assert UnitigGraph().topology() == "empty"
+
+
+def test_connected_components():
+    graph, _ = UnitigGraph.from_gfa_lines(gfa_lines(TEST_GFA_5))
+    assert graph.connected_components() == [[1, 5], [2], [3, 6], [4]]
+    graph, _ = UnitigGraph.from_gfa_lines(gfa_lines(TEST_GFA_4))
+    comps = graph.connected_components()
+    assert comps == [[1, 2, 3], [4, 5]]
+    assert graph.component_is_circular_loop(comps[0])
+    assert graph.component_is_circular_loop(comps[1])
+
+
+def test_create_and_delete_link():
+    graph, _ = UnitigGraph.from_gfa_lines(gfa_lines(TEST_GFA_6))
+    assert graph.link_exists(1, FORWARD, 2, REVERSE)
+    graph.delete_link(1, -2)
+    assert not graph.link_exists(1, FORWARD, 2, REVERSE)
+    graph.check_links()
+    graph.create_link(1, -2)
+    assert graph.link_exists(1, FORWARD, 2, REVERSE)
+    graph.check_links()
+
+
+def test_renumber_unitigs():
+    graph, _ = UnitigGraph.from_gfa_lines(gfa_lines(TEST_GFA_14))
+    graph.renumber_unitigs()
+    lengths = [u.length() for u in graph.unitigs]
+    assert lengths == sorted(lengths, reverse=True)
+    assert [u.number for u in graph.unitigs] == list(range(1, len(graph.unitigs) + 1))
+    graph.check_links()
+
+
+def test_remove_low_depth_unitigs():
+    graph, _ = UnitigGraph.from_gfa_lines(gfa_lines(TEST_GFA_5))
+    # unitig 2 is isolated with depth 1 -> removable without making dead ends
+    graph.remove_low_depth_unitigs(1.0)
+    assert 2 not in graph.index
+    graph.check_links()
+
+
+def test_duplicate_unitig():
+    graph, _ = UnitigGraph.from_gfa_lines(gfa_lines(TEST_GFA_6))
+    # unitig 1 has one non-self link; duplication must be rejected
+    with pytest.raises(AutocyclerError):
+        graph.duplicate_unitig_by_number(1)
+    graph2, _ = UnitigGraph.from_gfa_lines(gfa_lines(TEST_GFA_4))
+    graph2.duplicate_unitig_by_number(2)
+    assert 2 not in graph2.index
+    assert 6 in graph2.index and 7 in graph2.index
+    assert graph2.index[6].depth == pytest.approx(0.5)
+    graph2.check_links()
+
+
+def test_reverse_complement():
+    assert reverse_complement(b"ACGT.") == b".ACGT"
+    assert reverse_complement(b"AACC") == b"GGTT"
+    assert reverse_complement(b"AXA") == b"TNT"

@@ -1,0 +1,98 @@
+"""Unit tests for utils: stats, formatting, FASTA I/O (reference misc.rs tests)."""
+
+import gzip
+
+import pytest
+
+from autocycler_tpu.utils import (AutocyclerError, find_all_assemblies, format_duration,
+                                  format_float, format_float_sigfigs, load_fasta, mad,
+                                  median, reverse_signed_path, sign_at_end, sign_at_end_vec,
+                                  usize_division_rounded)
+
+
+def test_median():
+    assert median([]) == 0
+    assert median([5]) == 5
+    assert median([1, 2, 3]) == 2
+    assert median([1, 2, 3, 4]) == 2
+    assert median([4, 1, 3, 2]) == 2
+    assert median([10, 0, 0]) == 0
+
+
+def test_mad():
+    assert mad([]) == 0
+    assert mad([1, 1, 2, 2, 4, 6, 9]) == 1
+    assert mad([3, 3, 3]) == 0
+
+
+def test_format_duration():
+    assert format_duration(0.0) == "0:00:00.000000"
+    assert format_duration(1.234567) == "0:00:01.234567"
+    assert format_duration(3661.5) == "1:01:01.500000"
+
+
+def test_format_float():
+    assert format_float(1.0) == "1"
+    assert format_float(1.10) == "1.1"
+    assert format_float(0.123456789) == "0.123457"
+
+
+def test_format_float_sigfigs():
+    assert format_float_sigfigs(0.0, 3) == "0.00"
+    assert format_float_sigfigs(1234.5678, 3) == "1230"
+    assert format_float_sigfigs(0.0012345, 2) == "0.0012"
+
+
+def test_usize_division_rounded():
+    assert usize_division_rounded(10, 3) == 3
+    assert usize_division_rounded(11, 3) == 4
+    with pytest.raises(ZeroDivisionError):
+        usize_division_rounded(1, 0)
+
+
+def test_signed_helpers():
+    assert sign_at_end(42) == "42+"
+    assert sign_at_end(-42) == "42-"
+    assert sign_at_end_vec([1, -2, 3]) == "1+,2-,3+"
+    assert reverse_signed_path([1, -2, 3]) == [-3, 2, -1]
+
+
+def test_load_fasta(tmp_path):
+    p = tmp_path / "a.fasta"
+    p.write_text(">c1 some description\nacgt\nACGT\n>c2\nGGCC\n")
+    records = load_fasta(p)
+    assert records == [("c1", "c1 some description", "ACGTACGT"), ("c2", "c2", "GGCC")]
+
+
+def test_load_fasta_gzipped(tmp_path):
+    p = tmp_path / "a.fasta.gz"
+    with gzip.open(p, "wt") as f:
+        f.write(">c1\nACGT\n")
+    assert load_fasta(p) == [("c1", "c1", "ACGT")]
+
+
+def test_load_fasta_errors(tmp_path):
+    empty = tmp_path / "empty.fasta"
+    empty.write_text("")
+    with pytest.raises(AutocyclerError):
+        load_fasta(empty)
+    dup = tmp_path / "dup.fasta"
+    dup.write_text(">c1\nACGT\n>c1\nACGT\n")
+    with pytest.raises(AutocyclerError):
+        load_fasta(dup)
+    bad = tmp_path / "bad.fasta"
+    bad.write_text("ACGT\n")
+    with pytest.raises(AutocyclerError):
+        load_fasta(bad)
+
+
+def test_find_all_assemblies(tmp_path):
+    (tmp_path / "a.fasta").write_text(">c\nA\n")
+    (tmp_path / "b.fna").write_text(">c\nA\n")
+    (tmp_path / "c.fa").write_text(">c\nA\n")
+    (tmp_path / "d.fasta.gz").write_bytes(gzip.compress(b">c\nA\n"))
+    (tmp_path / "ignore.txt").write_text("x")
+    names = [p.name for p in find_all_assemblies(tmp_path)]
+    assert names == ["a.fasta", "b.fna", "c.fa", "d.fasta.gz"]
+    with pytest.raises(AutocyclerError):
+        find_all_assemblies(tmp_path / "missing")
