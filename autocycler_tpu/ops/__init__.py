@@ -1,0 +1,3 @@
+from .encode import (ALPHABET, encode_bytes, decode_codes, revcomp_codes,
+                     CODE_DOT, CODE_A, CODE_C, CODE_G, CODE_T)
+from .kmers import KmerIndex, build_kmer_index

@@ -1,0 +1,198 @@
+"""Unitig chain construction over the k-mer index.
+
+Replaces the reference's sequential greedy walk (unitig_graph.rs:176-226:
+per-k-mer graph walk with hash probes and a `seen` set) with a vectorised,
+order-independent formulation:
+
+An edge A->B is *unitig-internal* iff
+    out_count(A) == 1  and  not first_pos(rev(A))      (A may extend right)
+    and in_count(B) == 1  and  not first_pos(B)        (B may be entered)
+which is exactly the conjunction of break conditions in the reference's
+extension loops (unitig_graph.rs:192-205 forward, :210-223 backward) and is
+strand-symmetric: internal(A->B) <=> internal(rev B->rev A). Chains under
+this relation are therefore well-defined without any walk order, and are
+computed by pointer-doubling (O(U log U) gathers, device- or numpy-side).
+
+The reference's remaining walk behaviours are reproduced exactly:
+- chains come in reverse-complement pairs; the one containing the globally
+  smallest k-mer (= smallest id, ids are lexicographic ranks) is emitted,
+  matching the sorted iteration order of the walk (kmer_graph.rs:168-173);
+- cycles are rotated to start at their smallest k-mer (the walk starts
+  there and goes around until it meets the start's `seen` mark);
+- self-mirror chains (a chain that is its own reverse complement) split at
+  the centre, keeping the half containing the smallest k-mer — the effect
+  of the walk's `seen` check hitting the mirror half;
+- self-mirror cycles fall back to a literal simulation of the walk.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List
+
+import numpy as np
+
+from .kmers import KmerIndex
+
+
+@dataclass
+class Chains:
+    """Emitted unitig chains: ordered k-mer ids, concatenated."""
+    members: np.ndarray    # (T,) kmer ids in chain order, all chains concatenated
+    chain_off: np.ndarray  # (C+1,) boundaries into members
+    is_cycle: np.ndarray   # (C,) bool
+
+    @property
+    def count(self) -> int:
+        return len(self.chain_off) - 1
+
+    def chain(self, c: int) -> np.ndarray:
+        return self.members[self.chain_off[c]:self.chain_off[c + 1]]
+
+
+def internal_edges(index: KmerIndex) -> np.ndarray:
+    """next_int[g] = unitig-internal successor of k-mer g, or -1."""
+    U = index.num_kmers
+    can_extend = (index.out_count == 1) & ~index.first_pos[index.rev_kid]
+    succ = np.where(can_extend, index.succ, -1)
+    ok = succ >= 0
+    tgt = succ[ok]
+    accept = (index.in_count[tgt] == 1) & ~index.first_pos[tgt]
+    result = np.full(U, -1, np.int64)
+    result[np.flatnonzero(ok)[accept]] = tgt[accept]
+    return result
+
+
+def _pointer_double_heads(prev_int: np.ndarray):
+    """For a forest of in-trees that are simple paths, find each node's head
+    (the node with no predecessor) and its distance from it."""
+    U = len(prev_int)
+    node = np.arange(U, dtype=np.int64)
+    P = np.where(prev_int < 0, node, prev_int)
+    R = (prev_int >= 0).astype(np.int64)
+    steps = max(1, int(np.ceil(np.log2(max(U, 2)))) + 1)
+    for _ in range(steps):
+        R = R + R[P]
+        P = P[P]
+    return P, R
+
+
+def build_chains(index: KmerIndex) -> Chains:
+    U = index.num_kmers
+    if U == 0:
+        return Chains(np.zeros(0, np.int64), np.zeros(1, np.int64), np.zeros(0, bool))
+
+    next_int = internal_edges(index)
+    prev_int = np.full(U, -1, np.int64)
+    has_next = next_int >= 0
+    prev_int[next_int[has_next]] = np.flatnonzero(has_next)
+
+    # ---- component minima (for cycle detection and representatives) ----
+    node = np.arange(U, dtype=np.int64)
+    P = np.where(prev_int < 0, node, prev_int)
+    N = np.where(next_int < 0, node, next_int)
+    comp = node.copy()
+    steps = max(1, int(np.ceil(np.log2(max(U, 2)))) + 1)
+    for _ in range(steps):
+        comp = np.minimum(comp, np.minimum(comp[P], comp[N]))
+        P, N = P[P], N[N]
+
+    # a component is a cycle iff it has no head
+    head_nodes = prev_int < 0
+    comp_has_head = np.zeros(U, bool)
+    np.logical_or.at(comp_has_head, comp, head_nodes)
+    in_cycle = ~comp_has_head[comp]
+
+    # break each cycle at its representative (= smallest member id; the
+    # reference's walk starts there because iteration is lexicographic)
+    cycle_reps = np.unique(comp[in_cycle])
+    prev_broken = prev_int.copy()
+    next_broken = next_int.copy()
+    if len(cycle_reps):
+        tails = prev_int[cycle_reps]          # cycle predecessor of each rep
+        prev_broken[cycle_reps] = -1
+        next_broken[tails] = -1
+
+    # ---- heads and ranks over the (now acyclic) path forest ----
+    head, rank = _pointer_double_heads(prev_broken)
+
+    # order members by (head, rank)
+    order = np.lexsort((rank, head))
+    heads_sorted = head[order]
+    boundaries = np.flatnonzero(np.concatenate([[True], heads_sorted[1:] != heads_sorted[:-1]]))
+    chain_off = np.concatenate([boundaries, [U]]).astype(np.int64)
+    members = order  # node ids in (chain, rank) order
+    C = len(boundaries)
+    chain_of = np.zeros(U, np.int64)
+    chain_of[heads_sorted[boundaries]] = np.arange(C)
+    chain_id = chain_of[head]  # chain index of every node
+
+    sizes = np.diff(chain_off)
+    chain_head = members[chain_off[:-1]]
+    chain_tail = members[chain_off[1:] - 1]
+    chain_is_cycle = in_cycle[chain_head]
+
+    # per-chain minima, own and mirror
+    min_own = np.full(C, U, np.int64)
+    np.minimum.at(min_own, chain_id, node)
+    min_mirror = np.full(C, U, np.int64)
+    np.minimum.at(min_mirror, chain_id, index.rev_kid)
+    mirror_chain = chain_id[index.rev_kid[chain_head]]
+    self_mirror = mirror_chain == np.arange(C)
+
+    out_members: List[np.ndarray] = []
+    out_is_cycle: List[bool] = []
+    for c in range(C):
+        if self_mirror[c]:
+            mem = members[chain_off[c]:chain_off[c + 1]]
+            if chain_is_cycle[c]:
+                out_members.append(_simulate_walk_cycle(index, next_int, mem, int(min_own[c])))
+                out_is_cycle.append(False)  # walk result is not a full cycle
+            else:
+                n = len(mem)
+                half = n // 2
+                pos_of_min = int(np.argmin(mem))
+                out_members.append(mem[:half] if pos_of_min < half else mem[half:])
+                out_is_cycle.append(False)
+            continue
+        if min_own[c] > min_mirror[c]:
+            continue  # the mirror chain is emitted instead
+        out_members.append(members[chain_off[c]:chain_off[c + 1]])
+        out_is_cycle.append(bool(chain_is_cycle[c]))
+
+    if out_members:
+        flat = np.concatenate(out_members)
+        off = np.concatenate([[0], np.cumsum([len(m) for m in out_members])]).astype(np.int64)
+    else:
+        flat = np.zeros(0, np.int64)
+        off = np.zeros(1, np.int64)
+    return Chains(flat, off, np.array(out_is_cycle, dtype=bool))
+
+
+def _simulate_walk_cycle(index: KmerIndex, next_int: np.ndarray,
+                         cycle_members: np.ndarray, start: int) -> np.ndarray:
+    """Literal reproduction of the reference walk for a self-mirror cycle
+    (unitig_graph.rs:188-223): extend right then left, stopping when the
+    next k-mer (or its reverse complement) was already taken."""
+    seen = {start, int(index.rev_kid[start])}
+    chain = [start]
+    cur = start
+    while True:
+        nxt = int(next_int[cur])
+        if nxt < 0 or nxt in seen:
+            break
+        chain.append(nxt)
+        seen.add(nxt)
+        seen.add(int(index.rev_kid[nxt]))
+        cur = nxt
+    prev_map = {int(next_int[m]): int(m) for m in cycle_members if next_int[m] >= 0}
+    cur = start
+    while True:
+        prv = prev_map.get(cur, -1)
+        if prv < 0 or prv in seen:
+            break
+        chain.insert(0, prv)
+        seen.add(prv)
+        seen.add(int(index.rev_kid[prv]))
+        cur = prv
+    return np.array(chain, dtype=np.int64)

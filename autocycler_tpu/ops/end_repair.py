@@ -1,0 +1,120 @@
+"""Sequence-end repair: replace dot padding with matching real sequence.
+
+Parity target: reference compress.rs:202-270. Each padded sequence starts and
+ends with half_k dots followed/preceded by half_k real bases; the reference
+regex-matches that (k-1)-char dotted pattern against every sequence (both
+strands) and substitutes the best match, defined as (1) fewest dots,
+(2) highest occurrence count, (3) lexicographically first
+(find_best_match, compress.rs:239-270). Regex ``find_iter`` yields
+non-overlapping matches left-to-right, which we reproduce exactly.
+
+TPU formulation: a pattern of h dots + h real bases matches text at offset j
+iff text[j+h : j+2h] equals the h real bases — i.e. every match is an
+occurrence of an h-gram. So one sort-based grouping of ALL h-grams of all
+padded sequences (ops.kmers.group_windows) answers every pattern query at
+once; candidate windows are then gathered from the byte buffer.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from ..models import Sequence
+from ..utils import reverse_complement_bytes
+from .encode import encode_bytes
+from .kmers import group_windows
+
+
+def _find_best_match(candidates: List[bytes]) -> bytes:
+    """(fewest dots, most frequent, lexicographically first)
+    (reference compress.rs:239-270)."""
+    counts: Dict[bytes, int] = {}
+    for c in candidates:
+        counts[c] = counts.get(c, 0) + 1
+    return min(candidates, key=lambda c: (c.count(b"."), -counts[c], c))
+
+
+def sequence_end_repair(sequences: List[Sequence], k_size: int) -> None:
+    """In-place repair of every sequence's dotted ends (compress.rs:202-236).
+
+    Matches are searched in the ORIGINAL (pre-repair) sequences, like the
+    reference's cloned all_seqs snapshot (compress.rs:209).
+    """
+    if not sequences:
+        return
+    h = k_size // 2
+    if h == 0:
+        return  # k=1: no padding, nothing to repair
+    overlap = k_size - 1  # == 2h
+
+    # text layout: per sequence, forward then reverse padded strands
+    bufs = []
+    text_off = []
+    total = 0
+    for s in sequences:
+        for strand_seq in (s.forward_seq, s.reverse_seq):
+            text_off.append(total)
+            bufs.append(strand_seq)
+            total += len(strand_seq)
+    buf = np.concatenate(bufs)
+    codes = encode_bytes(buf)
+    text_len = np.array([len(b) for b in bufs], dtype=np.int64)
+    text_off = np.array(text_off, dtype=np.int64)
+
+    # all h-gram windows of every text
+    win_count = text_len - h + 1
+    woff = np.zeros(len(bufs), np.int64)
+    woff[1:] = np.cumsum(win_count)[:-1]
+    W = int(win_count.sum())
+    wocc = np.arange(W, dtype=np.int64)
+    wtext = np.searchsorted(woff, wocc, side="right") - 1
+    wpos = wocc - woff[wtext]
+    wstarts = text_off[wtext] + wpos
+
+    order, gid_sorted = group_windows(codes, wstarts, h)
+    win_gid = np.zeros(W, np.int64)
+    win_gid[order] = gid_sorted
+    G = int(gid_sorted[-1]) + 1 if W else 0
+    gstart = np.zeros(G + 1, np.int64)
+    np.add.at(gstart, gid_sorted + 1, 1)
+    gstart = np.cumsum(gstart)
+
+    def candidates_for(core_window: int, core_offset: int) -> List[bytes]:
+        """All non-overlapping (k-1)-byte candidate windows containing the
+        given core h-gram at ``core_offset`` within the pattern (h for the
+        start pattern's trailing real bases, 0 for the end pattern's leading
+        real bases)."""
+        gid = win_gid[core_window]
+        occ = order[gstart[gid]:gstart[gid + 1]]  # ascending => text asc, pos asc
+        t = wtext[occ]
+        p = wpos[occ]
+        j = p - core_offset  # pattern start within the text
+        valid = (j >= 0) & (j + overlap <= text_len[t])
+        t, j = t[valid], j[valid]
+        out: List[bytes] = []
+        prev_text, prev_end = -1, -1
+        for ti, ji in zip(t, j):
+            if ti == prev_text and ji < prev_end:
+                continue  # regex find_iter skips overlapping matches
+            prev_text, prev_end = ti, ji + overlap
+            start = text_off[ti] + ji
+            out.append(buf[start:start + overlap].tobytes())
+        return out
+
+    for i, s in enumerate(sequences):
+        fwd_text = 2 * i
+        P = len(s.forward_seq)
+        # start pattern: dots at [0,h), real core at [h,2h)
+        start_core = woff[fwd_text] + h
+        best_start = _find_best_match(candidates_for(int(start_core), h))
+        # end pattern: real core at [P-2h, P-h), dots at [P-h, P)
+        end_core = woff[fwd_text] + (P - 2 * h)
+        best_end = _find_best_match(candidates_for(int(end_core), 0))
+
+        repaired = s.forward_seq.copy()
+        repaired[:overlap] = np.frombuffer(best_start, dtype=np.uint8)
+        repaired[P - overlap:] = np.frombuffer(best_end, dtype=np.uint8)
+        s.forward_seq = repaired
+        s.reverse_seq = reverse_complement_bytes(repaired)

@@ -1,0 +1,106 @@
+"""Assemble a host UnitigGraph from the device k-mer index + chains.
+
+This is the TPU-side replacement for UnitigGraph::from_kmer_graph
+(reference unitig_graph.rs:36-48): chains come from ops.debruijn, unitig
+sequences are gathered straight out of the padded input byte buffer (the
+moral equivalent of the reference's raw-pointer k-mers, kmer_graph.rs:26-33,
+without the unsafe), links are found by (k-1)-gram id equality instead of
+hash-map joins (unitig_graph.rs:234-287), and overlap trimming
+(unitig_graph.rs:289-293) happens implicitly by slicing half_k off both ends.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ..models import Position, Sequence, Unitig, UnitigGraph, UnitigStrand
+from ..utils import FORWARD, REVERSE, reverse_complement_bytes
+from .debruijn import Chains, build_chains
+from .kmers import KmerIndex, build_kmer_index
+
+
+def _positions_for_kmer(index: KmerIndex, kid: int) -> List[Position]:
+    occ = index.kmer_occurrences(kid)
+    seq_idx, strand, pos = index.occ_coords(occ)
+    ids = index.seq_ids[seq_idx]
+    return [Position(int(i), bool(s), int(p)) for i, s, p in zip(ids, strand, pos)]
+
+
+def unitig_graph_from_chains(index: KmerIndex, chains: Chains) -> UnitigGraph:
+    graph = UnitigGraph(k_size=index.k)
+    k, h = index.k, index.half_k
+
+    # last byte of each unique k-mer's window (for chain sequence assembly)
+    first_occ_byte = index.occ_byte_start(index.first_occ)
+    last_byte = index.buf[first_occ_byte + k - 1]
+
+    C = chains.count
+    fwd_start_gram = np.zeros(C, np.int64)
+    fwd_end_gram = np.zeros(C, np.int64)
+    rev_start_gram = np.zeros(C, np.int64)
+
+    for c in range(C):
+        members = chains.chain(c)
+        head, tail = int(members[0]), int(members[-1])
+        n = len(members)
+
+        # untrimmed chain sequence: head k-mer bytes + last byte of each
+        # following k-mer; trimming removes half_k from both ends
+        head_bytes = index.buf[first_occ_byte[head]:first_occ_byte[head] + k]
+        untrimmed = np.concatenate([head_bytes, last_byte[members[1:]]])
+        trimmed = untrimmed[h:h + n].copy()
+
+        unitig = Unitig(number=c + 1, forward_seq=trimmed)
+        unitig.depth = float(index.depth[members].mean())
+        unitig.forward_positions = _positions_for_kmer(index, head)
+        unitig.reverse_positions = _positions_for_kmer(index, int(index.rev_kid[tail]))
+        graph.unitigs.append(unitig)
+
+        fwd_start_gram[c] = index.prefix_gid[head]
+        fwd_end_gram[c] = index.suffix_gid[tail]
+        rev_start_gram[c] = index.prefix_gid[index.rev_kid[tail]]
+
+    # rev_end_gram is the strand mirror of fwd_start_gram's matching rule;
+    # matching uses the same three joins as the reference (unitig_graph.rs:253-285)
+    by_fwd_start: dict = {}
+    by_rev_start: dict = {}
+    for c in range(C):
+        by_fwd_start.setdefault(int(fwd_start_gram[c]), []).append(c)
+        by_rev_start.setdefault(int(rev_start_gram[c]), []).append(c)
+    rev_end_gram = [int(index.suffix_gid[index.rev_kid[int(chains.chain(c)[0])]])
+                    for c in range(C)]
+
+    for c in range(C):
+        a = graph.unitigs[c]
+        # a+ -> b+ (and strand twin b- -> a-)
+        for j in by_fwd_start.get(int(fwd_end_gram[c]), []):
+            b = graph.unitigs[j]
+            a.forward_next.append(UnitigStrand(b, FORWARD))
+            b.forward_prev.append(UnitigStrand(a, FORWARD))
+            b.reverse_next.append(UnitigStrand(a, REVERSE))
+            a.reverse_prev.append(UnitigStrand(b, REVERSE))
+        # a+ -> b-
+        for j in by_rev_start.get(int(fwd_end_gram[c]), []):
+            b = graph.unitigs[j]
+            a.forward_next.append(UnitigStrand(b, REVERSE))
+            b.reverse_prev.append(UnitigStrand(a, FORWARD))
+        # a- -> b+
+        for j in by_fwd_start.get(rev_end_gram[c], []):
+            b = graph.unitigs[j]
+            a.reverse_next.append(UnitigStrand(b, FORWARD))
+            b.forward_prev.append(UnitigStrand(a, REVERSE))
+
+    graph.build_index()
+    graph.renumber_unitigs()
+    graph.check_links()
+    return graph
+
+
+def build_unitig_graph(sequences: List[Sequence], k: int,
+                       use_jax=None) -> UnitigGraph:
+    """Sequences (padded, end-repaired) -> compacted unitig graph."""
+    index = build_kmer_index(sequences, k, use_jax=use_jax)
+    chains = build_chains(index)
+    return unitig_graph_from_chains(index, chains)

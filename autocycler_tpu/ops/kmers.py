@@ -1,0 +1,282 @@
+"""Exact k-mer grouping as a sort-based device kernel.
+
+This replaces the reference's FxHashMap De Bruijn graph — the #1 hot loop
+(reference kmer_graph.rs:86-134: two hash upserts per base, both strands) —
+with a TPU-friendly formulation:
+
+1. encode all padded sequences (both strands) as 5-symbol codes,
+2. pack every k-window into ceil(k/10) int32 words (3 bits/symbol, most
+   significant first, zero-filled tail) so word-tuple comparison equals
+   byte-lexicographic k-mer comparison,
+3. one stable lexsort groups identical k-mers; group ids ARE the
+   lexicographic ranks (so the reference's sorted iteration,
+   kmer_graph.rs:168-173, falls out for free),
+4. (k-1)-gram ids, computed the same way, give De Bruijn adjacency by
+   integer equality instead of hash probes (kmer_graph.rs:136-166).
+
+Everything is exact — no fingerprint collisions — and deterministic. The
+packing/sort runs through jax.numpy on the configured default device (TPU
+when present); small inputs fall back to numpy to skip dispatch overhead.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .encode import encode_bytes
+
+SYMS_PER_WORD = 10  # 3 bits per symbol in an int32
+_JAX_THRESHOLD = 1_000_000  # windows; below this numpy beats device dispatch
+
+
+def _num_words(k: int) -> int:
+    return (k + SYMS_PER_WORD - 1) // SYMS_PER_WORD
+
+
+def _pack_and_rank_numpy(codes: np.ndarray, starts: np.ndarray, k: int):
+    words = []
+    for j in range(_num_words(k)):
+        w = np.zeros(len(starts), dtype=np.int32)
+        for t in range(SYMS_PER_WORD):
+            idx = j * SYMS_PER_WORD + t
+            w <<= 3
+            if idx < k:
+                w |= codes[starts + idx].astype(np.int32)
+        words.append(w)
+    order = np.lexsort(tuple(reversed(words)))  # last key is primary in lexsort
+    sorted_words = [w[order] for w in words]
+    new_group = np.zeros(len(starts), dtype=bool)
+    if len(starts):
+        new_group[0] = True
+        for w in sorted_words:
+            new_group[1:] |= w[1:] != w[:-1]
+    gid_sorted = np.cumsum(new_group, dtype=np.int64) - 1
+    return order, gid_sorted
+
+
+def _pack_and_rank_jax(codes: np.ndarray, starts: np.ndarray, k: int):
+    import jax.numpy as jnp
+
+    codes_d = jnp.asarray(codes)
+    starts_d = jnp.asarray(starts.astype(np.int32))
+    words = []
+    for j in range(_num_words(k)):
+        w = jnp.zeros(len(starts), dtype=jnp.int32)
+        for t in range(SYMS_PER_WORD):
+            idx = j * SYMS_PER_WORD + t
+            w = w << 3
+            if idx < k:
+                w = w | codes_d[starts_d + idx].astype(jnp.int32)
+        words.append(w)
+    order = jnp.lexsort(tuple(reversed(words)))
+    sorted_words = [w[order] for w in words]
+    new_group = jnp.zeros(len(starts), dtype=bool).at[0].set(True)
+    for w in sorted_words:
+        new_group = new_group.at[1:].set(new_group[1:] | (w[1:] != w[:-1]))
+    gid_sorted = jnp.cumsum(new_group) - 1
+    return np.asarray(order), np.asarray(gid_sorted)
+
+
+def group_windows(codes: np.ndarray, starts: np.ndarray, k: int,
+                  use_jax: Optional[bool] = None) -> Tuple[np.ndarray, np.ndarray]:
+    """Group length-k windows of ``codes`` beginning at ``starts``.
+
+    Returns (order, gid_sorted): ``order`` is the stable permutation sorting
+    windows lexicographically; ``gid_sorted[i]`` is the dense group id of
+    window ``order[i]``. Group ids are lexicographic ranks.
+    """
+    if len(starts) == 0:
+        return np.zeros(0, np.int64), np.zeros(0, np.int64)
+    if k == 0:
+        # zero-length windows are all identical (k=1's (k-1)-grams)
+        return np.arange(len(starts), dtype=np.int64), np.zeros(len(starts), np.int64)
+    if use_jax is None:
+        use_jax = len(starts) >= _JAX_THRESHOLD
+    if use_jax:
+        try:
+            return _pack_and_rank_jax(codes, starts, k)
+        except Exception:
+            pass
+    return _pack_and_rank_numpy(codes, starts, k)
+
+
+@dataclass
+class KmerIndex:
+    """Struct-of-arrays replacement for the reference's KmerGraph
+    (kmer_graph.rs:73-182), built by :func:`build_kmer_index`.
+
+    Occurrence layout: per input sequence, first its L forward windows
+    (window start p = Position.pos on the padded forward strand), then its
+    L reverse windows. The partner of forward window p is reverse window
+    L-1-p (and vice versa), mirroring how the reference adds each k-mer on
+    both strands (kmer_graph.rs:103-133).
+    """
+
+    k: int
+    half_k: int
+    # concatenated padded byte buffer: per sequence, forward then reverse
+    buf: np.ndarray
+    seq_ids: np.ndarray          # (S,) external sequence ids
+    seq_len: np.ndarray          # (S,) unpadded lengths
+    fwd_byte_off: np.ndarray     # (S,) offset of forward padded seq in buf
+    rev_byte_off: np.ndarray     # (S,)
+    occ_off: np.ndarray          # (S,) occurrence-index base (2*L per seq)
+    # per occurrence (M = 2 * sum(L)):
+    occ_kid: np.ndarray          # (M,) unique-kmer id (lexicographic rank)
+    # per unique k-mer (U,):
+    depth: np.ndarray            # occurrence count
+    first_occ: np.ndarray        # smallest occurrence index in the group
+    occ_sorted: np.ndarray       # (M,) occurrence indices grouped by kid,
+    group_start: np.ndarray      # (U+1,) boundaries into occ_sorted
+    rev_kid: np.ndarray          # (U,) id of the reverse-complement k-mer
+    prefix_gid: np.ndarray       # (U,) (k-1)-gram id of the first k-1 bases
+    suffix_gid: np.ndarray       # (U,) (k-1)-gram id of the last k-1 bases
+    out_count: np.ndarray        # (U,) number of unique k-mers overlapping on the right
+    in_count: np.ndarray         # (U,) ... on the left
+    succ: np.ndarray             # (U,) the unique right-neighbour when out_count==1
+    first_pos: np.ndarray        # (U,) bool: any occurrence at window 0
+
+    # ---- occurrence coordinate helpers (vectorised) ----
+
+    def occ_coords(self, occ: np.ndarray):
+        """occurrence indices -> (seq_index, strand(bool), local window pos)."""
+        seq_idx = np.searchsorted(self.occ_off, occ, side="right") - 1
+        rel = occ - self.occ_off[seq_idx]
+        L = self.seq_len[seq_idx]
+        strand = rel < L
+        pos = np.where(strand, rel, rel - L)
+        return seq_idx, strand, pos
+
+    def occ_byte_start(self, occ: np.ndarray) -> np.ndarray:
+        seq_idx, strand, pos = self.occ_coords(occ)
+        base = np.where(strand, self.fwd_byte_off[seq_idx], self.rev_byte_off[seq_idx])
+        return base + pos
+
+    def partner_occ(self, occ: np.ndarray) -> np.ndarray:
+        seq_idx, strand, pos = self.occ_coords(occ)
+        L = self.seq_len[seq_idx]
+        mirrored = L - 1 - pos
+        return self.occ_off[seq_idx] + np.where(strand, L + mirrored, mirrored)
+
+    def kmer_occurrences(self, kid: int) -> np.ndarray:
+        return self.occ_sorted[self.group_start[kid]:self.group_start[kid + 1]]
+
+    @property
+    def num_kmers(self) -> int:
+        return len(self.depth)
+
+
+def build_kmer_index(sequences, k: int, use_jax: Optional[bool] = None) -> KmerIndex:
+    """Build the k-mer index from Sequence objects (padded, with bytes).
+
+    Parity notes: every k-window of every padded sequence on both strands is
+    an occurrence (reference kmer_graph.rs:103-133 — exactly L windows per
+    strand because the padding is half_k per side); k-mers that would start a
+    sequence are flagged (Kmer::first_position, kmer_graph.rs:57-60); right
+    and left neighbour counts replace next_kmers/prev_kmers probing
+    (kmer_graph.rs:136-166).
+    """
+    half_k = k // 2
+    S = len(sequences)
+    seq_ids = np.array([s.id for s in sequences], dtype=np.int32)
+    seq_len = np.array([s.length for s in sequences], dtype=np.int64)
+
+    bufs, fwd_off, rev_off = [], np.zeros(S, np.int64), np.zeros(S, np.int64)
+    total = 0
+    for i, s in enumerate(sequences):
+        fwd_off[i] = total
+        bufs.append(s.forward_seq)
+        total += len(s.forward_seq)
+        rev_off[i] = total
+        bufs.append(s.reverse_seq)
+        total += len(s.reverse_seq)
+    buf = np.concatenate(bufs) if bufs else np.zeros(0, np.uint8)
+    codes = encode_bytes(buf)
+
+    occ_off = np.zeros(S, np.int64)
+    if S > 1:
+        occ_off[1:] = np.cumsum(2 * seq_len)[:-1]
+    M = int(2 * seq_len.sum())
+
+    # byte start of every occurrence window
+    occ = np.arange(M, dtype=np.int64)
+    seq_idx = np.searchsorted(occ_off, occ, side="right") - 1
+    rel = occ - occ_off[seq_idx]
+    L = seq_len[seq_idx]
+    strand = rel < L
+    pos = np.where(strand, rel, rel - L)
+    starts = np.where(strand, fwd_off[seq_idx], rev_off[seq_idx]) + pos
+
+    # ---- k-mer grouping ----
+    order, gid_sorted = group_windows(codes, starts, k, use_jax)
+    U = int(gid_sorted[-1]) + 1 if M else 0
+    occ_kid = np.zeros(M, np.int64)
+    occ_kid[order] = gid_sorted
+    # occurrences grouped by kid; stable lexsort keeps occurrence order inside
+    # each group ascending
+    group_start = np.zeros(U + 1, np.int64)
+    np.add.at(group_start, gid_sorted + 1, 1)
+    group_start = np.cumsum(group_start)
+    depth = np.diff(group_start).astype(np.int64)
+    first_occ = order[group_start[:-1]] if U else np.zeros(0, np.int64)
+
+    # first-position flag: any occurrence with local window pos == 0
+    first_pos = np.zeros(U, bool)
+    np.logical_or.at(first_pos, occ_kid, pos == 0)
+
+    # reverse-complement partner: partner occurrence of the first occurrence
+    seq_idx_f = np.searchsorted(occ_off, first_occ, side="right") - 1
+    rel_f = first_occ - occ_off[seq_idx_f]
+    L_f = seq_len[seq_idx_f]
+    strand_f = rel_f < L_f
+    pos_f = np.where(strand_f, rel_f, rel_f - L_f)
+    partner = occ_off[seq_idx_f] + np.where(strand_f, L_f + (L_f - 1 - pos_f),
+                                            L_f - 1 - pos_f)
+    rev_kid = occ_kid[partner]
+
+    # ---- (k-1)-gram grouping for adjacency ----
+    # gram windows: per strand, L+1 windows (starts 0..L); the gram starting
+    # at window p is the k-mer-at-p's prefix, at p+1 its suffix.
+    g_count = 2 * (seq_len + 1)
+    gocc_off = np.zeros(S, np.int64)
+    if S > 1:
+        gocc_off[1:] = np.cumsum(g_count)[:-1]
+    GM = int(g_count.sum())
+    gocc = np.arange(GM, dtype=np.int64)
+    gseq = np.searchsorted(gocc_off, gocc, side="right") - 1
+    grel = gocc - gocc_off[gseq]
+    gL = seq_len[gseq]
+    gstrand = grel < gL + 1
+    gpos = np.where(gstrand, grel, grel - (gL + 1))
+    gstarts = np.where(gstrand, fwd_off[gseq], rev_off[gseq]) + gpos
+
+    gorder, ggid_sorted = group_windows(codes, gstarts, k - 1, use_jax)
+    gocc_gid = np.zeros(GM, np.int64)
+    gocc_gid[gorder] = ggid_sorted
+    G = int(ggid_sorted[-1]) + 1 if GM else 0
+
+    def gram_occ_index(seq_i, strand_b, p):
+        return gocc_off[seq_i] + np.where(strand_b, p, (seq_len[seq_i] + 1) + p)
+
+    prefix_gid = gocc_gid[gram_occ_index(seq_idx_f, strand_f, pos_f)]
+    suffix_gid = gocc_gid[gram_occ_index(seq_idx_f, strand_f, pos_f + 1)]
+
+    # neighbour counts over UNIQUE k-mers (next_kmers/prev_kmers semantics)
+    cnt_prefix = np.bincount(prefix_gid, minlength=G)
+    cnt_suffix = np.bincount(suffix_gid, minlength=G)
+    out_count = cnt_prefix[suffix_gid]
+    in_count = cnt_suffix[prefix_gid]
+    succ_by_gram = np.full(G, -1, np.int64)
+    succ_by_gram[prefix_gid] = np.arange(U)
+    succ = succ_by_gram[suffix_gid]  # valid only where out_count == 1
+
+    return KmerIndex(
+        k=k, half_k=half_k, buf=buf, seq_ids=seq_ids, seq_len=seq_len,
+        fwd_byte_off=fwd_off, rev_byte_off=rev_off, occ_off=occ_off,
+        occ_kid=occ_kid, depth=depth, first_occ=first_occ,
+        occ_sorted=order, group_start=group_start, rev_kid=rev_kid,
+        prefix_gid=prefix_gid, suffix_gid=suffix_gid,
+        out_count=out_count, in_count=in_count, succ=succ, first_pos=first_pos)

@@ -1,0 +1,141 @@
+"""Graph simplification tests over the reference's fixture expectations
+(graph_simplification.rs test module)."""
+
+import numpy as np
+
+from autocycler_tpu.models import UnitigGraph, Unitig, UnitigStrand
+from autocycler_tpu.models.simplify import (
+    get_exclusive_inputs, get_exclusive_outputs, merge_linear_paths,
+    simplify_structure, get_fixed_unitig_starts_and_ends, _fix_circular_loops,
+    _common_start_seq, _common_end_seq, _cannot_merge_start, _cannot_merge_end)
+from autocycler_tpu.utils import FORWARD, REVERSE
+
+from fixtures_gfa import (TEST_GFA_1, TEST_GFA_2, TEST_GFA_3, TEST_GFA_4, TEST_GFA_5,
+                          TEST_GFA_14, gfa_lines)
+
+
+def useg(line):
+    return Unitig.from_segment_line(line)
+
+
+def uvec_str(unitigs):
+    out = sorted(((u.number, u.strand) for u in unitigs),
+                 key=lambda t: (t[0], not t[1]))
+    # reference order: number asc, then reverse strand before forward
+    out = sorted(((u.number, u.strand) for u in unitigs), key=lambda t: (t[0], t[1]))
+    return ",".join(f"{n}{'+' if s else '-'}" for n, s in out)
+
+
+def test_common_start_seq():
+    a, b, c = (useg("S\t1\tACGATCAGC\tDP:f:1"), useg("S\t2\tACTATCAGC\tDP:f:1"),
+               useg("S\t3\tACTACGACT\tDP:f:1"))
+    us = [UnitigStrand(a, FORWARD), UnitigStrand(b, FORWARD), UnitigStrand(c, FORWARD)]
+    assert _common_start_seq(us).tobytes() == b"AC"
+    us = [UnitigStrand(a, FORWARD), UnitigStrand(b, FORWARD), UnitigStrand(c, REVERSE)]
+    assert _common_start_seq(us).tobytes() == b"A"
+    us = [UnitigStrand(a, FORWARD), UnitigStrand(b, REVERSE), UnitigStrand(c, REVERSE)]
+    assert _common_start_seq(us).tobytes() == b""
+
+
+def test_common_end_seq():
+    a, b, c = (useg("S\t1\tACGATCAGC\tDP:f:1"), useg("S\t2\tACTATCAGC\tDP:f:1"),
+               useg("S\t3\tACTACGACT\tDP:f:1"))
+    us = [UnitigStrand(a, FORWARD), UnitigStrand(b, FORWARD), UnitigStrand(c, FORWARD)]
+    assert _common_end_seq(us).tobytes() == b""
+    us = [UnitigStrand(a, REVERSE), UnitigStrand(b, REVERSE), UnitigStrand(c, FORWARD)]
+    assert _common_end_seq(us).tobytes() == b"T"
+    us = [UnitigStrand(a, REVERSE), UnitigStrand(b, REVERSE), UnitigStrand(c, REVERSE)]
+    assert _common_end_seq(us).tobytes() == b"GT"
+
+
+def test_exclusive_inputs_outputs():
+    graph, _ = UnitigGraph.from_gfa_lines(gfa_lines(TEST_GFA_1))
+    expect = {
+        1: ("2+,3-", ""), 2: ("", ""), 3: ("", ""), 4: ("", "7-,8+"), 5: ("", ""),
+        6: ("", ""), 7: ("9-,9+", ""), 8: ("", "10-"), 9: ("", ""), 10: ("", "8-"),
+    }
+    for i, (ins, outs) in expect.items():
+        u = graph.unitigs[i - 1]
+        got_ins = uvec_str(get_exclusive_inputs(u))
+        got_outs = uvec_str(get_exclusive_outputs(u))
+        assert got_ins == ins, (i, got_ins, ins)
+        assert got_outs == outs, (i, got_outs, outs)
+
+
+def test_simplify_structure_1():
+    graph, _ = UnitigGraph.from_gfa_lines(gfa_lines(TEST_GFA_1))
+    simplify_structure(graph, [])
+    seqs = [u.seq_str() for u in graph.unitigs]
+    assert seqs == ["GCATTCGCTGCGCTCGCTTCGCTTT", "TGCCGTCGTCGCTGT", "CTGAATCGCCTA",
+                    "GCTCGGCTCGA", "CGAACCAT", "TACTTGT", "GCCT", "TCT", "GC", "T"]
+
+
+def test_simplify_structure_2():
+    graph, _ = UnitigGraph.from_gfa_lines(gfa_lines(TEST_GFA_2))
+    simplify_structure(graph, [])
+    seqs = [u.seq_str() for u in graph.unitigs]
+    assert seqs == ["CACCGCTGCGCTCGCTTCGCTCTAT", "CG", "G"]
+
+
+def test_can_merge_fixed_sets():
+    graph, seqs = UnitigGraph.from_gfa_lines(gfa_lines(TEST_GFA_14))
+    fixed_starts, fixed_ends = get_fixed_unitig_starts_and_ends(graph, seqs)
+    _fix_circular_loops(graph, fixed_starts)
+    assert fixed_starts == {5, 8, 12, 19, 22}
+    assert fixed_ends == {8, 17, 19, 22, 37}
+    for num, strand in [(5, FORWARD), (8, FORWARD), (8, REVERSE), (12, FORWARD),
+                        (17, REVERSE), (19, FORWARD), (19, REVERSE), (22, FORWARD),
+                        (22, REVERSE), (37, REVERSE)]:
+        assert _cannot_merge_start(num, strand, fixed_starts, fixed_ends)
+    for num, strand in [(12, REVERSE), (21, FORWARD), (21, REVERSE), (37, FORWARD)]:
+        assert not _cannot_merge_start(num, strand, fixed_starts, fixed_ends)
+    for num, strand in [(5, REVERSE), (8, FORWARD), (8, REVERSE), (12, REVERSE),
+                        (17, FORWARD), (19, FORWARD), (19, REVERSE), (22, FORWARD),
+                        (22, REVERSE), (37, FORWARD)]:
+        assert _cannot_merge_end(num, strand, fixed_starts, fixed_ends)
+    for num, strand in [(12, FORWARD), (21, FORWARD), (21, REVERSE), (37, REVERSE)]:
+        assert not _cannot_merge_end(num, strand, fixed_starts, fixed_ends)
+
+
+def test_merge_linear_paths_1():
+    graph, seqs = UnitigGraph.from_gfa_lines(gfa_lines(TEST_GFA_3))
+    assert len(graph.unitigs) == 7
+    merge_linear_paths(graph, seqs)
+    assert len(graph.unitigs) == 3
+    assert graph.index[8].seq_str() == \
+        "TTCGCTGCGCTCGCTTCGCTTTTGCACAGCGACGACGGCATGCCTGAATCGCCTA"
+    assert graph.index[9].seq_str() == "GCTCGGCTCGATGGTTCG"
+    assert graph.index[10].seq_str() == "TACTTGTAAGGC"
+    links = sorted(graph.links_for_gfa())
+    expected = sorted([(8, "+", 9, "+"), (9, "-", 8, "-"), (9, "+", 9, "-"),
+                       (8, "+", 10, "+"), (10, "-", 8, "-"), (10, "+", 10, "+"),
+                       (10, "-", 10, "-")])
+    assert links == expected
+
+
+def test_merge_linear_paths_2():
+    graph, seqs = UnitigGraph.from_gfa_lines(gfa_lines(TEST_GFA_4))
+    assert len(graph.unitigs) == 5
+    merge_linear_paths(graph, seqs)
+    assert len(graph.unitigs) == 2
+    assert graph.index[6].seq_str() == "ACGACTACGAGCACGAGTCGTCGTCGTAACTGACT"
+    assert graph.index[7].seq_str() == "GCTCGGTG"
+    links = sorted(graph.links_for_gfa())
+    expected = sorted([(6, "+", 6, "+"), (6, "-", 6, "-"),
+                       (7, "+", 7, "+"), (7, "-", 7, "-")])
+    assert links == expected
+
+
+def test_merge_linear_paths_3():
+    graph, seqs = UnitigGraph.from_gfa_lines(gfa_lines(TEST_GFA_5))
+    assert len(graph.unitigs) == 6
+    merge_linear_paths(graph, seqs)
+    assert len(graph.unitigs) == 5
+    assert graph.index[7].seq_str() == "AAATGCGACTGTG"
+
+
+def test_merge_linear_paths_4():
+    graph, seqs = UnitigGraph.from_gfa_lines(gfa_lines(TEST_GFA_14))
+    assert len(graph.unitigs) == 13
+    merge_linear_paths(graph, seqs)
+    assert len(graph.unitigs) == 11
