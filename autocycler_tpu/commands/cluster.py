@@ -1,0 +1,620 @@
+"""`autocycler cluster`: group contigs into replicon clusters.
+
+Parity target: reference cluster.rs. Pipeline: load input_assemblies.gfa,
+compute the asymmetric pairwise distance matrix (one device matmul,
+ops.distance), symmetrize by max, build a UPGMA tree, normalise the root to
+0.5, cut at --cutoff with hill-climb refinement against the clustering score
+(balance + tightness), QC the clusters (min_assemblies / containment /
+trusted overrides), and write per-cluster 1_untrimmed.gfa checkpoints plus
+PHYLIP, Newick, TSV and YAML outputs.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from ..metrics import ClusteringMetrics, UntrimmedClusterMetrics
+from ..models import Sequence, UnitigGraph
+from ..models.simplify import merge_linear_paths
+from ..ops.distance import pairwise_contig_distances
+from ..utils import (format_float, load_file_lines, log, median, quit_with_error,
+                     usize_division_rounded)
+
+
+# ---------------- tree ----------------
+
+class TreeNode:
+    """UPGMA tree node (reference cluster.rs:195-348). ``distance`` is the
+    node-to-tip distance; tips carry sequence ids, internal nodes get fresh
+    ids above the largest sequence id."""
+
+    __slots__ = ("id", "left", "right", "distance")
+
+    def __init__(self, id: int, left=None, right=None, distance: float = 0.0):
+        self.id = id
+        self.left = left
+        self.right = right
+        self.distance = distance
+
+    def is_tip(self) -> bool:
+        return self.left is None
+
+    def max_pairwise_distance(self, node_num: int) -> float:
+        if self.id == node_num:
+            return self.distance * 2.0
+        if self.is_tip():
+            return -1.0
+        return max(self.left.max_pairwise_distance(node_num),
+                   self.right.max_pairwise_distance(node_num))
+
+    def automatic_clustering(self, cutoff: float) -> List[int]:
+        clusters: List[int] = []
+        self._collect_clusters(cutoff / 2.0, [], clusters)
+        return sorted(clusters)
+
+    def manual_clustering(self, cutoff: float, manual_clusters: List[int]) -> List[int]:
+        clusters: List[int] = []
+        self._check_consistency(manual_clusters)
+        self._collect_clusters(cutoff / 2.0, manual_clusters, clusters)
+        return sorted(clusters)
+
+    def _collect_clusters(self, cutoff: float, manual: List[int],
+                          clusters: List[int]) -> None:
+        if self.id in manual or (self.distance <= cutoff
+                                 and not self._has_manual_child(manual)):
+            clusters.append(self.id)
+        elif not self.is_tip():
+            self.left._collect_clusters(cutoff, manual, clusters)
+            self.right._collect_clusters(cutoff, manual, clusters)
+
+    def _has_manual_child(self, manual: List[int]) -> bool:
+        if self.id in manual:
+            return True
+        if not self.is_tip():
+            return (self.left._has_manual_child(manual)
+                    or self.right._has_manual_child(manual))
+        return False
+
+    def _check_consistency(self, manual: List[int]) -> None:
+        if not self.is_tip():
+            if self.id in manual and (self.left._has_manual_child(manual)
+                                      or self.right._has_manual_child(manual)):
+                quit_with_error("manual clusters cannot be nested")
+            self.left._check_consistency(manual)
+            self.right._check_consistency(manual)
+
+    def get_tips(self, node_num: int) -> List[int]:
+        node = self.find_node(node_num)
+        if node is None:
+            return []
+        tips: List[int] = []
+        node._collect_tips(tips)
+        return tips
+
+    def _collect_tips(self, tips: List[int]) -> None:
+        if self.is_tip():
+            tips.append(self.id)
+        else:
+            self.left._collect_tips(tips)
+            self.right._collect_tips(tips)
+
+    def check_complete_coverage(self, clusters: List[int]) -> None:
+        all_tips = set(self.get_tips(self.id))
+        covered = set()
+        for c in clusters:
+            for tip in self.get_tips(c):
+                if tip in covered:
+                    raise AssertionError("overlap detected")
+                covered.add(tip)
+        if covered != all_tips:
+            raise AssertionError("incomplete coverage")
+
+    def split_clusters(self, clusters: List[int]) -> List[List[int]]:
+        """All clusterings reachable by splitting one splittable cluster into
+        its two children (reference cluster.rs:311-334)."""
+        self.check_complete_coverage(clusters)
+        result = []
+        for cluster in clusters:
+            node = self.find_node(cluster)
+            if node is not None and not node.is_tip():
+                alt = [c for c in clusters if c != cluster]
+                alt.extend([node.left.id, node.right.id])
+                result.append(sorted(alt))
+        result.sort()
+        return result
+
+    def find_node(self, node_num: int) -> Optional["TreeNode"]:
+        if self.id == node_num:
+            return self
+        if self.is_tip():
+            return None
+        found = self.left.find_node(node_num)
+        if found is not None:
+            return found
+        return self.right.find_node(node_num)
+
+
+def upgma(distances: Dict[Tuple[int, int], float], sequences: List[Sequence]) -> TreeNode:
+    """UPGMA over the symmetric distance map; merged clusters keep the id
+    min(a, b); internal node ids count up from the largest sequence id; ties
+    broken by the first pair in sorted-id order (reference cluster.rs:395-458)."""
+    clusters: Dict[int, set] = {s.id: {s.id} for s in sequences}
+    cluster_distances = dict(distances)
+    nodes: Dict[int, TreeNode] = {s.id: TreeNode(s.id) for s in sequences}
+    internal_node_num = max(s.id for s in sequences)
+
+    while len(clusters) > 1:
+        a, b, a_b_distance = _get_closest_pair(cluster_distances)
+        cluster_a = clusters.pop(a)
+        cluster_b = clusters.pop(b)
+        new_id = min(a, b)
+        new_cluster = cluster_a | cluster_b
+        clusters[new_id] = new_cluster
+
+        internal_node_num += 1
+        nodes[new_id] = TreeNode(internal_node_num, nodes.pop(a), nodes.pop(b),
+                                 a_b_distance / 2.0)
+
+        new_distances = {}
+        for (x, y), dist in cluster_distances.items():
+            if x in clusters and y in clusters:
+                new_distances[(x, y)] = dist
+        for other_id, other_members in clusters.items():
+            if other_id == new_id:
+                continue
+            total, count = 0.0, 0
+            for id1 in new_cluster:
+                for id2 in other_members:
+                    d = distances.get((id1, id2), distances.get((id2, id1)))
+                    total += d
+                    count += 1
+            avg = total / count
+            new_distances[(new_id, other_id)] = avg
+            new_distances[(other_id, new_id)] = avg
+        cluster_distances = new_distances
+
+    return next(iter(nodes.values()))
+
+
+def _get_closest_pair(distances: Dict[Tuple[int, int], float]) -> Tuple[int, int, float]:
+    unique_keys = sorted({k for pair in distances for k in pair})
+    min_distance = float("inf")
+    closest = (0, 0)
+    for i, a in enumerate(unique_keys):
+        for b in unique_keys[i + 1:]:
+            d = distances.get((a, b), distances.get((b, a)))
+            if d is not None and d < min_distance:
+                min_distance = d
+                closest = (a, b)
+    return closest[0], closest[1], min_distance
+
+
+def normalise_tree(root: TreeNode) -> None:
+    """Scale so root-to-tip distance is at most 0.5 (reference cluster.rs:483-494)."""
+    if root.distance > 0.5:
+        _scale(root, 0.5 / root.distance)
+
+
+def _scale(node: TreeNode, factor: float) -> None:
+    node.distance *= factor
+    if node.left is not None:
+        _scale(node.left, factor)
+        _scale(node.right, factor)
+
+
+def _fmt(x: float) -> str:
+    """Shortest round-trip float representation, integral values without a
+    decimal point (Rust `{}` Display semantics, which the reference uses for
+    Newick branch lengths)."""
+    s = repr(float(x))
+    return s[:-2] if s.endswith(".0") else s
+
+
+def tree_to_newick(node: TreeNode, index: Dict[int, Sequence]) -> str:
+    if node.left is not None and node.right is not None:
+        left = tree_to_newick(node.left, index)
+        right = tree_to_newick(node.right, index)
+        return (f"({left}:{_fmt(node.distance - node.left.distance)},"
+                f"{right}:{_fmt(node.distance - node.right.distance)}){node.id}")
+    return index[node.id].string_for_newick()
+
+
+def save_tree_to_newick(root: TreeNode, sequences: List[Sequence], file_path) -> None:
+    """Newick with a root branch padding root-to-tip distances to 0.5
+    (reference cluster.rs:363-380)."""
+    index = {s.id: s for s in sequences}
+    newick = tree_to_newick(root, index)
+    with open(file_path, "w") as f:
+        if root.distance < 0.5:
+            f.write(f"({newick}:{_fmt(0.5 - root.distance)});\n")
+        else:
+            f.write(f"{newick};\n")
+
+
+# ---------------- QC ----------------
+
+class ClusterQC:
+    __slots__ = ("failure_reasons", "cluster_dist")
+
+    def __init__(self, cluster_dist: float = 0.0):
+        self.failure_reasons: List[str] = []
+        self.cluster_dist = cluster_dist
+
+    def passed(self) -> bool:
+        return not self.failure_reasons
+
+
+def make_symmetrical_distances(asym: Dict[Tuple[int, int], float],
+                               sequences: List[Sequence]) -> Dict[Tuple[int, int], float]:
+    """max(A->B, B->A) per pair (reference cluster.rs:177-192)."""
+    sym = {}
+    for a in sequences:
+        for b in sequences:
+            sym[(a.id, b.id)] = max(asym[(a.id, b.id)], asym[(b.id, a.id)])
+    return sym
+
+
+def generate_clusters(tree: TreeNode, sequences: List[Sequence],
+                      distances: Dict[Tuple[int, int], float], cutoff: float,
+                      min_assemblies: int, manual_clusters: List[int]
+                      ) -> Dict[int, ClusterQC]:
+    if not manual_clusters:
+        auto = tree.automatic_clustering(cutoff)
+        clusters = refine_auto_clusters(tree, sequences, distances, auto, cutoff,
+                                        min_assemblies)
+    else:
+        clusters = tree.manual_clustering(cutoff, manual_clusters)
+    tree.check_complete_coverage(clusters)
+    return qc_clusters(tree, sequences, distances, clusters, manual_clusters, cutoff,
+                       min_assemblies)
+
+
+def qc_clusters(tree: TreeNode, sequences: List[Sequence],
+                distances: Dict[Tuple[int, int], float], cluster_nodes: List[int],
+                manual_clusters: List[int], cutoff: float, min_assemblies: int
+                ) -> Dict[int, ClusterQC]:
+    """Assign cluster numbers and decide pass/fail: too-few-assemblies and
+    containment failures, with trusted contigs exempting their cluster
+    (reference cluster.rs:511-570)."""
+    qc_results: Dict[int, ClusterQC] = {}
+    current = 0
+    for n in cluster_nodes:
+        node = tree.find_node(n)
+        if node is None:
+            quit_with_error(f"clustering tree does not contain a node with id {n}")
+        current += 1
+        _assign_cluster_to_node(node, sequences, current)
+        qc = ClusterQC(tree.max_pairwise_distance(n))
+        if manual_clusters and n not in manual_clusters:
+            qc.failure_reasons.append("not included in manual clusters")
+        qc_results[current] = qc
+
+    old_to_new = reorder_clusters(sequences)
+    qc_results = {old_to_new[old]: qc for old, qc in qc_results.items()}
+
+    if not manual_clusters:
+        max_cluster = get_max_cluster(sequences)
+        for c in range(1, max_cluster + 1):
+            count = cluster_assembly_count(sequences, c)
+            if count < min_assemblies and not cluster_is_trusted(sequences, c):
+                qc_results[c].failure_reasons.append("present in too few assemblies")
+        for c in range(1, max_cluster + 1):
+            container = cluster_is_contained_in_another(c, sequences, distances, cutoff,
+                                                        qc_results)
+            if container > 0 and not cluster_is_trusted(sequences, c):
+                qc_results[c].failure_reasons.append(
+                    f"contained within cluster {container}")
+    return qc_results
+
+
+def _assign_cluster_to_node(node: TreeNode, sequences: List[Sequence],
+                            cluster: int) -> None:
+    for s in sequences:
+        if s.id == node.id:
+            s.cluster = cluster
+    if node.left is not None:
+        _assign_cluster_to_node(node.left, sequences, cluster)
+        _assign_cluster_to_node(node.right, sequences, cluster)
+
+
+def cluster_assembly_count(sequences: List[Sequence], c: int) -> int:
+    """Assemblies represented in the cluster, scaled by the max cluster-weight
+    directive per file (reference cluster.rs:572-585)."""
+    weights: Dict[str, int] = {}
+    for seq in sequences:
+        if seq.cluster != c:
+            continue
+        w = seq.cluster_weight()
+        if seq.filename not in weights or w > weights[seq.filename]:
+            weights[seq.filename] = w
+    return sum(weights.values())
+
+
+def cluster_is_trusted(sequences: List[Sequence], c: int) -> bool:
+    return any(s.cluster == c and s.is_trusted() for s in sequences)
+
+
+def cluster_is_contained_in_another(cluster_num: int, sequences: List[Sequence],
+                                    distances: Dict[Tuple[int, int], float],
+                                    cutoff: float, qc_results: Dict[int, ClusterQC]
+                                    ) -> int:
+    """A cluster is contained in a passing cluster when the majority of
+    cross-pair distances are asymmetric and below the cutoff
+    (reference cluster.rs:692-723)."""
+    passed = [c for c, qc in qc_results.items() if qc.passed()]
+    for other in passed:
+        if other == cluster_num:
+            continue
+        contain, total = 0, 0
+        for a in sequences:
+            if a.cluster != cluster_num:
+                continue
+            for b in sequences:
+                if b.cluster != other:
+                    continue
+                total += 1
+                d_ab = distances[(a.id, b.id)]
+                d_ba = distances[(b.id, a.id)]
+                if d_ab < d_ba and d_ab < cutoff:
+                    contain += 1
+        if total and contain / total > 0.5:
+            return other
+    return 0
+
+
+def score_clustering(tree: TreeNode, sequences: List[Sequence],
+                     distances: Dict[Tuple[int, int], float], clusters: List[int],
+                     cutoff: float, min_assemblies: int) -> float:
+    qc = qc_clusters(tree, sequences, distances, clusters, [], cutoff, min_assemblies)
+    return clustering_metrics(sequences, qc).overall_clustering_score
+
+
+def refine_auto_clusters(tree: TreeNode, sequences: List[Sequence],
+                         distances: Dict[Tuple[int, int], float], clusters: List[int],
+                         cutoff: float, min_assemblies: int) -> List[int]:
+    """Hill-climb: split any cluster whose split improves the overall score
+    (reference cluster.rs:607-630)."""
+    best = list(clusters)
+    best_score = score_clustering(tree, sequences, distances, best, cutoff,
+                                  min_assemblies)
+    improved = True
+    while improved:
+        improved = False
+        for alt in tree.split_clusters(best):
+            alt_score = score_clustering(tree, sequences, distances, alt, cutoff,
+                                         min_assemblies)
+            if alt_score > best_score + 1e-12:
+                best, best_score = alt, alt_score
+                improved = True
+    return best
+
+
+def reorder_clusters(sequences: List[Sequence]) -> Dict[int, int]:
+    """Renumber clusters by median sequence length, descending; ties by old
+    number (reference cluster.rs:882-903)."""
+    cluster_lengths = {}
+    for c in range(1, get_max_cluster(sequences) + 1):
+        lengths = [s.length for s in sequences if s.cluster == c]
+        cluster_lengths[c] = median(lengths)
+    ordered = sorted(cluster_lengths.items(), key=lambda kv: (-kv[1], kv[0]))
+    old_to_new = {old: i + 1 for i, (old, _) in enumerate(ordered)}
+    for s in sequences:
+        if s.cluster >= 1:
+            s.cluster = old_to_new[s.cluster]
+    return old_to_new
+
+
+def get_assembly_count(sequences: List[Sequence]) -> int:
+    return len({s.filename for s in sequences})
+
+
+def get_max_cluster(sequences: List[Sequence]) -> int:
+    return max(s.cluster for s in sequences)
+
+
+def set_min_assemblies(min_assemblies_option: Optional[int],
+                       sequences: List[Sequence]) -> int:
+    """Auto --min_assemblies: assemblies/4 rounded, min 2 (1 when there is a
+    single assembly) (reference cluster.rs:645-661)."""
+    if min_assemblies_option is not None:
+        return min_assemblies_option
+    count = get_assembly_count(sequences)
+    if count == 1:
+        return 1
+    return max(2, usize_division_rounded(count, 4))
+
+
+def parse_manual_clusters(manual: Optional[str]) -> List[int]:
+    if not manual:
+        return []
+    out = []
+    for token in manual.replace(" ", "").split(","):
+        try:
+            out.append(int(token))
+        except ValueError:
+            quit_with_error(f"failed to parse '{token}' as a node number")
+    return sorted(out)
+
+
+def clustering_metrics(sequences: List[Sequence], qc_results: Dict[int, ClusterQC]
+                       ) -> ClusteringMetrics:
+    metrics = ClusteringMetrics()
+    cluster_filenames: Dict[int, List[str]] = {}
+    for seq in sequences:
+        qc = qc_results[seq.cluster]
+        cluster_filenames.setdefault(seq.cluster, []).append(seq.filename)
+        if qc.passed():
+            metrics.pass_contig_count += 1
+        else:
+            metrics.fail_contig_count += 1
+    pass_cluster_stats = []
+    for c in range(1, get_max_cluster(sequences) + 1):
+        qc = qc_results[c]
+        if qc.passed():
+            metrics.pass_cluster_count += 1
+            size = len(cluster_filenames.get(c, []))
+            pass_cluster_stats.append((qc.cluster_dist, size))
+        else:
+            metrics.fail_cluster_count += 1
+    metrics.calculate_fractions()
+    metrics.calculate_scores(cluster_filenames, pass_cluster_stats)
+    return metrics
+
+
+# ---------------- outputs ----------------
+
+def save_distance_matrix(distances: Dict[Tuple[int, int], float],
+                         sequences: List[Sequence], file_path) -> None:
+    """PHYLIP matrix with display names (reference cluster.rs:160-174)."""
+    with open(file_path, "w") as f:
+        f.write(f"{len(sequences)}\n")
+        for a in sequences:
+            f.write(str(a))
+            for b in sequences:
+                f.write(f"\t{distances[(a.id, b.id)]:.8f}")
+            f.write("\n")
+
+
+def filter_gfa_lines(gfa_lines: List[str], paths_to_remove: List[int]) -> List[str]:
+    """Drop the P-lines of other clusters (reference cluster.rs:806-821)."""
+    removed = set(paths_to_remove)
+    out = []
+    for line in gfa_lines:
+        if line.startswith("P\t"):
+            name = line.split("\t")[1]
+            try:
+                if int(name) in removed:
+                    continue
+            except ValueError:
+                pass
+        out.append(line)
+    return out
+
+
+def save_cluster_gfa(sequences: List[Sequence], cluster_num: int,
+                     gfa_lines: List[str], out_gfa) -> None:
+    """Per-cluster graph: filter P-lines, re-load, recalc depths, drop
+    zero-depth unitigs, merge linear paths (reference cluster.rs:794-822)."""
+    cluster_seqs = [_clone_seq(s) for s in sequences if s.cluster == cluster_num]
+    to_remove = [s.id for s in sequences if s.cluster != cluster_num]
+    filtered = filter_gfa_lines(gfa_lines, to_remove)
+    cluster_graph, _ = UnitigGraph.from_gfa_lines(filtered)
+    cluster_graph.recalculate_depths()
+    cluster_graph.remove_zero_depth_unitigs()
+    merge_linear_paths(cluster_graph, cluster_seqs)
+    cluster_graph.save_gfa(out_gfa, cluster_seqs)
+
+
+def _clone_seq(s: Sequence) -> Sequence:
+    return Sequence(s.id, s.forward_seq, s.reverse_seq, s.filename, s.contig_header,
+                    s.length, s.cluster)
+
+
+def save_clusters(sequences: List[Sequence], qc_results: Dict[int, ClusterQC],
+                  clustering_dir, gfa_lines: List[str]) -> None:
+    for c in range(1, get_max_cluster(sequences) + 1):
+        qc = qc_results[c]
+        sub = "qc_pass" if qc.passed() else "qc_fail"
+        cluster_dir = Path(clustering_dir) / sub / f"cluster_{c:03d}"
+        os.makedirs(cluster_dir, exist_ok=True)
+        log.message(f"Cluster {c:03d}:")
+        lengths = [s.length for s in sequences if s.cluster == c]
+        for s in sequences:
+            if s.cluster == c:
+                log.message(f"  {s}")
+        if len(lengths) > 1:
+            log.message(f"  cluster distance: {format_float(qc.cluster_dist)}")
+        if qc.passed():
+            log.message("  passed QC")
+        else:
+            for reason in qc.failure_reasons:
+                log.message(f"  failed QC: {reason}")
+        save_cluster_gfa(sequences, c, gfa_lines, cluster_dir / "1_untrimmed.gfa")
+        UntrimmedClusterMetrics.new(lengths, qc.cluster_dist).save_to_yaml(
+            cluster_dir / "1_untrimmed.yaml")
+        log.message()
+
+
+def save_data_to_tsv(sequences: List[Sequence], qc_results: Dict[int, ClusterQC],
+                     file_path) -> None:
+    with open(file_path, "w") as f:
+        f.write("node_name\tpassing_clusters\tall_clusters\tsequence_id\tfile_name\t"
+                "contig_name\tlength\ttrusted\tcluster_weight\tconsensus_weight\n")
+        for seq in sequences:
+            assert seq.cluster != 0
+            qc = qc_results[seq.cluster]
+            pass_cluster = str(seq.cluster) if qc.passed() else "none"
+            f.write(f"{seq.string_for_newick()}\t{pass_cluster}\t{seq.cluster}\t"
+                    f"{seq.id}\t{seq.filename}\t{seq.contig_name()}\t{seq.length}\t"
+                    f"{str(seq.is_trusted()).lower()}\t{seq.cluster_weight()}\t"
+                    f"{seq.consensus_weight()}\n")
+
+
+# ---------------- entry point ----------------
+
+def cluster(autocycler_dir, cutoff: float = 0.2, min_assemblies: Optional[int] = None,
+            max_contigs: int = 25, manual: Optional[str] = None, use_jax=None) -> None:
+    autocycler_dir = Path(autocycler_dir)
+    gfa = autocycler_dir / "input_assemblies.gfa"
+    clustering_dir = autocycler_dir / "clustering"
+    if not autocycler_dir.is_dir():
+        quit_with_error(f"directory does not exist: {autocycler_dir}")
+    if not gfa.is_file():
+        quit_with_error(f"file does not exist: {gfa}")
+    if cutoff <= 0.0 or cutoff >= 1.0:
+        quit_with_error("--cutoff must be between 0 and 1 (exclusive)")
+    if min_assemblies is not None and min_assemblies < 1:
+        quit_with_error("--min_assemblies must be 1 or greater")
+    if clustering_dir.is_dir():
+        shutil.rmtree(clustering_dir)
+    os.makedirs(clustering_dir)
+
+    log.section_header("Starting autocycler cluster")
+    log.explanation("This command takes a unitig graph (made by autocycler compress) and "
+                    "clusters the sequences based on their similarity. Ideally, each "
+                    "cluster will then contain sequences which can be combined into a "
+                    "consensus.")
+    gfa_lines = load_file_lines(gfa)
+    graph, sequences = UnitigGraph.from_gfa_lines(gfa_lines)
+    min_asm = set_min_assemblies(min_assemblies, sequences)
+    manual_clusters = parse_manual_clusters(manual)
+
+    if not sequences:
+        quit_with_error("no sequences found in input_assemblies.gfa")
+    mean = len(sequences) / get_assembly_count(sequences)
+    if mean > max_contigs:
+        quit_with_error(
+            f"the mean number of contigs per input assembly ({mean:.1f}) exceeds the "
+            f"allowed threshold ({max_contigs}). Are your input assemblies fragmented "
+            "or contaminated?")
+
+    log.section_header("Pairwise distances")
+    log.explanation("Every pairwise distance between contigs is calculated based on the "
+                    "similarity of their paths through the graph.")
+    asym = pairwise_contig_distances(graph, sequences, use_jax=use_jax)
+    save_distance_matrix(asym, sequences, clustering_dir / "pairwise_distances.phylip")
+
+    log.section_header("Clustering sequences")
+    log.explanation("Contigs are organised into a tree using UPGMA. Then clusters are "
+                    "defined from the tree using the distance cutoff.")
+    sym = make_symmetrical_distances(asym, sequences)
+    tree = upgma(sym, sequences)
+    normalise_tree(tree)
+    save_tree_to_newick(tree, sequences, clustering_dir / "clustering.newick")
+
+    qc_results = generate_clusters(tree, sequences, asym, cutoff, min_asm,
+                                   manual_clusters)
+    save_clusters(sequences, qc_results, clustering_dir, gfa_lines)
+    save_data_to_tsv(sequences, qc_results, clustering_dir / "clustering.tsv")
+    clustering_metrics(sequences, qc_results).save_to_yaml(
+        clustering_dir / "clustering.yaml")
+
+    log.section_header("Finished!")
+    log.explanation("You can now run autocycler trim on each cluster.")
+    log.message(f"Pairwise distances:         {clustering_dir / 'pairwise_distances.phylip'}")
+    log.message(f"Clustering tree (Newick):   {clustering_dir / 'clustering.newick'}")
+    log.message(f"Clustering tree (metadata): {clustering_dir / 'clustering.tsv'}")
+    log.message()

@@ -1,0 +1,48 @@
+"""Synthetic multi-assembly test data: rotated/mutated copies of shared
+replicons, mimicking what different assemblers produce from one isolate."""
+
+import random
+
+
+def random_genome(rng: random.Random, length: int) -> str:
+    return "".join(rng.choice("ACGT") for _ in range(length))
+
+
+def rotate(seq: str, offset: int) -> str:
+    offset %= len(seq)
+    return seq[offset:] + seq[:offset]
+
+
+def revcomp(seq: str) -> str:
+    comp = {"A": "T", "T": "A", "C": "G", "G": "C"}
+    return "".join(comp[c] for c in reversed(seq))
+
+
+def mutate(rng: random.Random, seq: str, n_snps: int) -> str:
+    seq = list(seq)
+    for _ in range(n_snps):
+        i = rng.randrange(len(seq))
+        seq[i] = rng.choice([b for b in "ACGT" if b != seq[i]])
+    return "".join(seq)
+
+
+def make_assemblies(tmp_path, n_assemblies=4, chromosome_len=6000, plasmid_len=800,
+                    n_snps=0, seed=42, rotate_contigs=True):
+    """Write n FASTA files, each containing a rotated (and optionally lightly
+    mutated) copy of a shared chromosome and plasmid. Returns the directory."""
+    rng = random.Random(seed)
+    chromosome = random_genome(rng, chromosome_len)
+    plasmid = random_genome(rng, plasmid_len)
+    asm_dir = tmp_path / "assemblies"
+    asm_dir.mkdir(parents=True, exist_ok=True)
+    for i in range(n_assemblies):
+        chrom = rotate(chromosome, rng.randrange(chromosome_len)) if rotate_contigs \
+            else chromosome
+        plas = rotate(plasmid, rng.randrange(plasmid_len)) if rotate_contigs else plasmid
+        if i % 2 == 1:
+            plas = revcomp(plas)
+        if n_snps:
+            chrom = mutate(rng, chrom, n_snps)
+        (asm_dir / f"assembly_{i + 1}.fasta").write_text(
+            f">chromosome_{i + 1}\n{chrom}\n>plasmid_{i + 1}\n{plas}\n")
+    return asm_dir

@@ -1,0 +1,202 @@
+"""Clustering tests: UPGMA (Wikipedia example), tree operations, QC helpers
+(porting the expectations of the reference's cluster.rs test module)."""
+
+import pytest
+
+from autocycler_tpu.commands.cluster import (
+    TreeNode, cluster_assembly_count, normalise_tree, parse_manual_clusters,
+    reorder_clusters, set_min_assemblies, tree_to_newick, upgma)
+from autocycler_tpu.models import Sequence
+from autocycler_tpu.utils import AutocyclerError
+
+
+def mkseq(id, filename, header, length=1):
+    s = Sequence.with_seq(id, "A", filename, header, 1)
+    s.length = length
+    return s
+
+
+def test_upgma_wikipedia():
+    sequences = [mkseq(i, n, n) for i, n in zip(range(1, 6), "abcde")]
+    d = {(1, 2): 17.0, (1, 3): 21.0, (1, 4): 31.0, (1, 5): 23.0,
+         (2, 3): 30.0, (2, 4): 34.0, (2, 5): 21.0,
+         (3, 4): 28.0, (3, 5): 39.0, (4, 5): 43.0}
+    distances = {}
+    for i in range(1, 6):
+        distances[(i, i)] = 0.0
+        for j in range(1, 6):
+            if i != j:
+                distances[(i, j)] = d.get((i, j), d.get((j, i)))
+    root = upgma(distances, sequences)
+    assert root.distance == pytest.approx(16.5, abs=1e-8)
+    index = {s.id: s for s in sequences}
+    assert tree_to_newick(root, index) == \
+        "(((1__a__a__1_bp:8.5,2__b__b__1_bp:8.5)6:2.5,5__e__e__1_bp:11)7:5.5," \
+        "(3__c__c__1_bp:14,4__d__d__1_bp:14)8:2.5)9"
+    normalise_tree(root)
+    assert root.distance == pytest.approx(0.5, abs=1e-8)
+
+
+def test_upgma_2():
+    sequences = [mkseq(i, n, n) for i, n in zip(range(1, 5), "abcd")]
+    vals = {(1, 2): 0.1, (1, 3): 0.5, (1, 4): 0.5, (2, 3): 0.5, (2, 4): 0.5,
+            (3, 4): 0.2}
+    distances = {}
+    for i in range(1, 5):
+        distances[(i, i)] = 0.0
+        for j in range(1, 5):
+            if i != j:
+                distances[(i, j)] = vals.get((i, j), vals.get((j, i)))
+    root = upgma(distances, sequences)
+    normalise_tree(root)
+    assert root.distance == pytest.approx(0.25, abs=1e-8)
+    index = {s.id: s for s in sequences}
+    assert tree_to_newick(root, index) == \
+        "((1__a__a__1_bp:0.05,2__b__b__1_bp:0.05)5:0.2," \
+        "(3__c__c__1_bp:0.1,4__d__d__1_bp:0.1)6:0.15)7"
+
+
+def _test_tree_1() -> TreeNode:
+    n1, n2, n3, n4, n5 = (TreeNode(i) for i in range(1, 6))
+    n6 = TreeNode(6, n4, n5, 0.1)
+    n7 = TreeNode(7, n3, n6, 0.2)
+    n8 = TreeNode(8, n2, n7, 0.3)
+    return TreeNode(9, n1, n8, 0.5)
+
+
+def _test_tree_2() -> TreeNode:
+    n1, n2, n3, n4, n5, n6 = (TreeNode(i) for i in range(1, 7))
+    n7 = TreeNode(7, n2, n3, 0.1)
+    n8 = TreeNode(8, n5, n6, 0.1)
+    n9 = TreeNode(9, n4, n8, 0.2)
+    n10 = TreeNode(10, n7, n9, 0.3)
+    return TreeNode(11, n1, n10, 0.5)
+
+
+def test_automatic_clustering():
+    tree = _test_tree_1()
+    assert tree.automatic_clustering(0.8) == [1, 8]
+    assert tree.automatic_clustering(0.5) == [1, 2, 7]
+    assert tree.automatic_clustering(0.3) == [1, 2, 3, 6]
+    assert tree.automatic_clustering(0.1) == [1, 2, 3, 4, 5]
+
+
+def test_manual_clustering():
+    tree = _test_tree_1()
+    assert tree.manual_clustering(0.5, []) == [1, 2, 7]
+    assert tree.manual_clustering(0.5, [1]) == [1, 2, 7]
+    assert tree.manual_clustering(0.5, [3]) == [1, 2, 3, 6]
+    assert tree.manual_clustering(0.5, [4]) == [1, 2, 3, 4, 5]
+    assert tree.manual_clustering(0.8, []) == [1, 8]
+    assert tree.manual_clustering(0.8, [2]) == [1, 2, 7]
+    assert tree.manual_clustering(0.8, [6]) == [1, 2, 3, 6]
+    assert tree.manual_clustering(0.8, [8]) == [1, 8]
+
+
+def test_check_consistency():
+    tree = _test_tree_1()
+    tree._check_consistency([1, 2, 3, 4, 5])
+    tree._check_consistency([9])
+    with pytest.raises(AutocyclerError):
+        tree._check_consistency([5, 6])
+    with pytest.raises(AutocyclerError):
+        tree._check_consistency([6, 8])
+    with pytest.raises(AutocyclerError):
+        tree._check_consistency([1, 9])
+
+
+def test_max_pairwise_distance():
+    tree = _test_tree_1()
+    expect = {1: 0.0, 2: 0.0, 3: 0.0, 4: 0.0, 5: 0.0, 6: 0.2, 7: 0.4, 8: 0.6,
+              9: 1.0, 10: -1.0, 11: -1.0}
+    for n, e in expect.items():
+        assert tree.max_pairwise_distance(n) == pytest.approx(e, abs=1e-8)
+
+
+def test_get_tips():
+    tree = _test_tree_1()
+    assert tree.get_tips(6) == [4, 5]
+    assert tree.get_tips(7) == [3, 4, 5]
+    assert tree.get_tips(8) == [2, 3, 4, 5]
+    assert tree.get_tips(9) == [1, 2, 3, 4, 5]
+
+
+def test_check_complete_coverage():
+    tree = _test_tree_1()
+    for clusters in ([1, 2, 3, 4, 5], [1, 2, 3, 6], [1, 2, 7], [1, 8], [9]):
+        tree.check_complete_coverage(clusters)
+    for clusters in ([1, 2, 3, 4, 5, 6], [1, 2, 3, 4], [1, 6, 7]):
+        with pytest.raises(AssertionError):
+            tree.check_complete_coverage(clusters)
+
+
+def test_split_clusters():
+    tree = _test_tree_1()
+    assert tree.split_clusters([1, 2, 3, 6]) == [[1, 2, 3, 4, 5]]
+    assert tree.split_clusters([1, 2, 7]) == [[1, 2, 3, 6]]
+    assert tree.split_clusters([1, 8]) == [[1, 2, 7]]
+    assert tree.split_clusters([9]) == [[1, 8]]
+    tree = _test_tree_2()
+    assert tree.split_clusters([1, 4, 5, 6, 7]) == [[1, 2, 3, 4, 5, 6]]
+    assert tree.split_clusters([1, 2, 3, 4, 8]) == [[1, 2, 3, 4, 5, 6]]
+    assert tree.split_clusters([1, 4, 7, 8]) == [[1, 2, 3, 4, 8], [1, 4, 5, 6, 7]]
+
+
+def test_find_node():
+    tree = _test_tree_1()
+    for n in range(1, 10):
+        assert tree.find_node(n).id == n
+    for n in (10, 11, 12):
+        assert tree.find_node(n) is None
+
+
+def test_parse_manual_clusters():
+    assert parse_manual_clusters("1,2,3") == [1, 2, 3]
+    assert parse_manual_clusters("4, 5, 6") == [4, 5, 6]
+    assert parse_manual_clusters(None) == []
+    with pytest.raises(AutocyclerError):
+        parse_manual_clusters("x,y,z")
+
+
+def test_set_min_assemblies():
+    seqs = [mkseq(i, f"assembly_{i}.fasta", "contig_1") for i in range(1, 13)]
+    assert set_min_assemblies(2, seqs) == 2
+    assert set_min_assemblies(321, seqs) == 321
+    assert set_min_assemblies(None, seqs) == 3       # 12 assemblies
+    assert set_min_assemblies(None, seqs[:9]) == 2   # 9 assemblies
+    assert set_min_assemblies(None, seqs[:2]) == 2   # 2 assemblies
+    assert set_min_assemblies(None, seqs[:1]) == 1   # 1 assembly
+
+
+def test_reorder_clusters():
+    seqs = [mkseq(1, "a1.fasta", "c2", 5), mkseq(2, "a1.fasta", "c3", 1),
+            mkseq(3, "a1.fasta", "c1", 10), mkseq(4, "a2.fasta", "c2", 5),
+            mkseq(5, "a2.fasta", "c3", 1), mkseq(6, "a2.fasta", "c1", 10)]
+    for i, c in enumerate([1, 2, 3, 1, 2, 3]):
+        seqs[i].cluster = c
+    reorder_clusters(seqs)
+    assert [s.cluster for s in seqs] == [2, 3, 1, 2, 3, 1]
+    reorder_clusters(seqs)  # idempotent
+    assert [s.cluster for s in seqs] == [2, 3, 1, 2, 3, 1]
+
+
+def test_cluster_assembly_count():
+    seqs = [mkseq(1, "a1.fasta", "c1"), mkseq(2, "a1.fasta", "c2"),
+            mkseq(3, "a1.fasta", "c3"), mkseq(4, "a2.fasta", "c1"),
+            mkseq(5, "a2.fasta", "c2")]
+    for i, c in enumerate([1, 2, 3, 1, 3]):
+        seqs[i].cluster = c
+    assert cluster_assembly_count(seqs, 1) == 2
+    assert cluster_assembly_count(seqs, 2) == 1
+    assert cluster_assembly_count(seqs, 3) == 2
+    # weighted variants
+    seqs = [mkseq(1, "a1.fasta", "c1 Autocycler_cluster_weight=3 other"),
+            mkseq(2, "a1.fasta", "c2 other autocycler_cluster_weight=6"),
+            mkseq(3, "a1.fasta", "c3"),
+            mkseq(4, "a2.fasta", "c1"),
+            mkseq(5, "a2.fasta", "c2 AuToCyCleR_cluster_weight=0")]
+    for i, c in enumerate([1, 2, 3, 1, 3]):
+        seqs[i].cluster = c
+    assert cluster_assembly_count(seqs, 1) == 4
+    assert cluster_assembly_count(seqs, 2) == 6
+    assert cluster_assembly_count(seqs, 3) == 1

@@ -1,0 +1,39 @@
+"""Integration tests over the staged pipeline: compress -> cluster (-> trim ->
+resolve -> combine as those stages land), on synthetic multi-replicon data."""
+
+from pathlib import Path
+
+from autocycler_tpu.commands.compress import compress
+from autocycler_tpu.commands.cluster import cluster
+from autocycler_tpu.models import UnitigGraph
+
+from synthetic import make_assemblies
+
+
+def test_compress_then_cluster(tmp_path):
+    asm_dir = make_assemblies(tmp_path, n_assemblies=4, chromosome_len=3000,
+                              plasmid_len=600, seed=7)
+    out_dir = tmp_path / "autocycler_out"
+    compress(asm_dir, out_dir, k_size=51, use_jax=False)
+    assert (out_dir / "input_assemblies.gfa").is_file()
+    assert (out_dir / "input_assemblies.yaml").is_file()
+
+    cluster(out_dir, use_jax=False)
+    clustering = out_dir / "clustering"
+    assert (clustering / "pairwise_distances.phylip").is_file()
+    assert (clustering / "clustering.newick").is_file()
+    assert (clustering / "clustering.tsv").is_file()
+    assert (clustering / "clustering.yaml").is_file()
+
+    # the chromosome and plasmid must separate into two QC-pass clusters
+    pass_dirs = sorted((clustering / "qc_pass").iterdir())
+    assert [d.name for d in pass_dirs] == ["cluster_001", "cluster_002"]
+    for d in pass_dirs:
+        gfa = d / "1_untrimmed.gfa"
+        assert gfa.is_file()
+        graph, seqs = UnitigGraph.from_gfa_file(gfa)
+        assert len(seqs) == 4  # one contig from each of the 4 assemblies
+    # cluster 1 = chromosome (longer), cluster 2 = plasmid
+    _, seqs1 = UnitigGraph.from_gfa_file(pass_dirs[0] / "1_untrimmed.gfa")
+    _, seqs2 = UnitigGraph.from_gfa_file(pass_dirs[1] / "1_untrimmed.gfa")
+    assert min(s.length for s in seqs1) > max(s.length for s in seqs2)
