@@ -1,0 +1,65 @@
+"""`autocycler clean`: manual graph surgery on the final assembly graph.
+
+Parity target: reference clean.rs — remove user-specified tigs, duplicate
+tigs (requires exactly two non-self links), drop low-depth tigs when no dead
+end results, then merge linear paths and renumber.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from ..models import UnitigGraph
+from ..models.simplify import merge_linear_paths
+from ..utils import log, quit_with_error
+
+
+def parse_tig_numbers(tig_num_str: Optional[str]) -> List[int]:
+    """'1, 2,3' -> [1, 2, 3], sorted (reference clean.rs:142-152)."""
+    if not tig_num_str:
+        return []
+    out = []
+    for token in tig_num_str.replace(" ", "").split(","):
+        try:
+            out.append(int(token))
+        except ValueError:
+            quit_with_error(f"failed to parse '{token}' as a tig number")
+    return sorted(out)
+
+
+def clean(in_gfa, out_gfa, remove: Optional[str] = None, duplicate: Optional[str] = None,
+          min_depth: Optional[float] = None) -> None:
+    if not os.path.isfile(in_gfa):
+        quit_with_error(f"file does not exist: {in_gfa}")
+    log.section_header("Starting autocycler clean")
+    log.explanation("This command removes user-specified tigs from a combined Autocycler "
+                    "graph and then merges all linear paths to produce a clean output "
+                    "graph.")
+    remove_nums = parse_tig_numbers(remove)
+    duplicate_nums = parse_tig_numbers(duplicate)
+    graph, _ = UnitigGraph.from_gfa_file(in_gfa)
+    graph.print_basic_graph_info()
+    _check_tig_numbers_are_valid(in_gfa, graph, remove_nums)
+    _check_tig_numbers_are_valid(in_gfa, graph, duplicate_nums)
+    if remove_nums:
+        graph.remove_unitigs_by_number(set(remove_nums))
+        graph.print_basic_graph_info()
+    for tig in duplicate_nums:
+        graph.duplicate_unitig_by_number(tig)
+    if min_depth is not None:
+        graph.remove_low_depth_unitigs(min_depth)
+    merge_linear_paths(graph, [])
+    graph.renumber_unitigs()
+    graph.print_basic_graph_info()
+    graph.save_gfa(out_gfa, [], use_other_colour=True)
+    log.section_header("Finished!")
+    log.message(f"Cleaned graph: {out_gfa}")
+    log.message()
+
+
+def _check_tig_numbers_are_valid(in_gfa, graph: UnitigGraph, tig_numbers: List[int]) -> None:
+    existing = {u.number for u in graph.unitigs}
+    for tig in tig_numbers:
+        if tig not in existing:
+            quit_with_error(f"{in_gfa} does not contain tig {tig}")

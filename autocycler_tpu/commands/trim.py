@@ -1,0 +1,231 @@
+"""`autocycler trim`: remove start-end (circular) and hairpin (linear)
+overlaps from each contig's unitig path.
+
+Parity target: reference trim.rs. The weighted path-overlap DP lives in
+ops.align (row-vectorised, exact); this module owns the trimming policy:
+start-end trim cuts at the alignment's weighted midpoint, hairpin trims use
+reverse-path alignment, the more successful trim type wins, length outliers
+beyond --mad MADs are excluded, and the graph is rebuilt.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from ..metrics import TrimmedClusterMetrics
+from ..models import Sequence, UnitigGraph
+from ..models.simplify import merge_linear_paths
+from ..ops.align import GAP, AlignmentPiece, find_midpoint, overlap_alignment
+from ..utils import (format_float, log, mad as mad_fn, median, quit_with_error,
+                     reverse_signed_path)
+
+TrimResult = Optional[Tuple[List[int], int]]
+
+
+def trim(cluster_dir, min_identity: float = 0.75, max_unitigs: int = 5000,
+         mad: float = 5.0) -> None:
+    cluster_dir = Path(cluster_dir)
+    untrimmed_gfa = cluster_dir / "1_untrimmed.gfa"
+    trimmed_gfa = cluster_dir / "2_trimmed.gfa"
+    trimmed_yaml = cluster_dir / "2_trimmed.yaml"
+    if not cluster_dir.is_dir():
+        quit_with_error(f"directory does not exist: {cluster_dir}")
+    if not untrimmed_gfa.is_file():
+        quit_with_error(f"file does not exist: {untrimmed_gfa}")
+    if not 0.0 <= min_identity <= 1.0:
+        quit_with_error("--min_identity must be between 0.0 and 1 (inclusive)")
+    if mad < 0.0:
+        quit_with_error("--mad cannot be less than 0")
+
+    log.section_header("Starting autocycler trim")
+    log.explanation("This command takes a single-cluster unitig graph (made by autocycler "
+                    "cluster) and trims any overlaps. It looks for both start-end overlaps "
+                    "(can occur with circular sequences) and hairpin overlaps (can occur "
+                    "with linear sequences).")
+    graph, sequences = UnitigGraph.from_gfa_file(untrimmed_gfa)
+    graph.print_basic_graph_info()
+    weights = {u.number: u.length() for u in graph.unitigs}
+
+    start_end = trim_start_end_overlap(graph, sequences, weights, min_identity,
+                                       max_unitigs)
+    hairpin = trim_hairpin_overlap(graph, sequences, weights, min_identity, max_unitigs)
+    sequences = choose_trim_type(start_end, hairpin, graph, sequences)
+    sequences = exclude_outliers_in_length(graph, sequences, mad)
+    clean_up_graph(graph, sequences)
+    graph.save_gfa(trimmed_gfa, sequences)
+    TrimmedClusterMetrics.new([s.length for s in sequences]).save_to_yaml(trimmed_yaml)
+    log.section_header("Finished!")
+    log.message(f"Unitig graph of trimmed sequences: {trimmed_gfa}")
+    log.message()
+
+
+def trim_start_end_overlap(graph: UnitigGraph, sequences: List[Sequence],
+                           weights: Dict[int, int], min_identity: float,
+                           max_unitigs: int) -> List[TrimResult]:
+    """Per-sequence circular start-end trimming (reference trim.rs:113-136).
+    A max_unitigs of 0 disables trimming."""
+    if max_unitigs == 0:
+        return [None] * len(sequences)
+    results: List[TrimResult] = []
+    for seq in sequences:
+        path = graph.get_unitig_path_for_sequence_i32(seq)
+        trimmed = trim_path_start_end(path, weights, min_identity, max_unitigs)
+        if trimmed is not None:
+            length = sum(weights[abs(u)] for u in trimmed)
+            results.append((trimmed, length))
+            log.message(f"{seq}: trimmed to {length} bp")
+        else:
+            results.append(None)
+            log.message(f"{seq}: not trimmed")
+    log.message()
+    return results
+
+
+def trim_hairpin_overlap(graph: UnitigGraph, sequences: List[Sequence],
+                         weights: Dict[int, int], min_identity: float,
+                         max_unitigs: int) -> List[TrimResult]:
+    """Per-sequence hairpin trimming at both path ends (reference trim.rs:139-186)."""
+    if max_unitigs == 0:
+        return [None] * len(sequences)
+    results: List[TrimResult] = []
+    for seq in sequences:
+        path = graph.get_unitig_path_for_sequence_i32(seq)
+        trimmed_start = trimmed_end = False
+        p2 = trim_path_hairpin_start(path, weights, min_identity, max_unitigs)
+        if p2 is not None:
+            trimmed_start = True
+        else:
+            p2 = list(path)
+        p3 = trim_path_hairpin_end(p2, weights, min_identity, max_unitigs)
+        if p3 is not None:
+            trimmed_end = True
+        else:
+            p3 = p2
+        if not trimmed_start and not trimmed_end:
+            results.append(None)
+            log.message(f"{seq}: not trimmed")
+        else:
+            length = sum(weights[abs(u)] for u in p3)
+            results.append((p3, length))
+            where = ("start and end" if trimmed_start and trimmed_end
+                     else "start" if trimmed_start else "end")
+            log.message(f"{seq}: trimmed from {where} to {length} bp")
+    log.message()
+    return results
+
+
+def choose_trim_type(start_end_results: List[TrimResult],
+                     hairpin_results: List[TrimResult], graph: UnitigGraph,
+                     sequences: List[Sequence]) -> List[Sequence]:
+    """Keep whichever trim type succeeded on more sequences, rebuild trimmed
+    sequences' positions in the graph (reference trim.rs:189-226)."""
+    start_end_count = sum(r is not None for r in start_end_results)
+    hairpin_count = sum(r is not None for r in hairpin_results)
+    if start_end_count == 0 and hairpin_count == 0:
+        return list(sequences)
+    results = start_end_results if start_end_count >= hairpin_count else hairpin_results
+    trimmed_sequences = []
+    for seq, result in zip(sequences, results):
+        if result is None:
+            trimmed_sequences.append(seq)
+        else:
+            graph.remove_sequence_from_graph(seq.id)
+            path, length = result
+            trimmed_sequences.append(graph.create_sequence_and_positions(
+                seq.id, length, seq.filename, seq.contig_header, seq.cluster,
+                [(abs(u), u > 0) for u in path]))
+    return trimmed_sequences
+
+
+def exclude_outliers_in_length(graph: UnitigGraph, sequences: List[Sequence],
+                               mad_threshold: float) -> List[Sequence]:
+    """Exclude sequences outside median ± mad_threshold·MAD
+    (reference trim.rs:229-257); 0 disables."""
+    if mad_threshold == 0.0:
+        return list(sequences)
+    lengths = [s.length for s in sequences]
+    med = median(lengths)
+    deviation = mad_fn(lengths)
+    min_length = round(med - deviation * mad_threshold)
+    max_length = round(med + deviation * mad_threshold)
+    log.message(f"Median sequence length:    {med} bp")
+    log.message(f"Median absolute deviation: {deviation} bp")
+    log.message(f"Allowed length range:      {min_length}-{max_length} bp")
+    log.message()
+    kept = []
+    for seq in sequences:
+        if min_length <= seq.length <= max_length:
+            kept.append(seq)
+            log.message(f"{seq}: kept")
+        else:
+            log.message(f"{seq}: excluded")
+            graph.remove_sequence_from_graph(seq.id)
+    log.message()
+    return kept
+
+
+def clean_up_graph(graph: UnitigGraph, sequences: List[Sequence]) -> None:
+    """Recalculate depths, drop zero-depth unitigs, merge linear paths and
+    renumber (reference trim.rs:260-269)."""
+    graph.recalculate_depths()
+    graph.remove_zero_depth_unitigs()
+    merge_linear_paths(graph, sequences)
+    graph.print_basic_graph_info()
+    graph.renumber_unitigs()
+
+
+# ---------------- path-level trimming ----------------
+
+def trim_path_start_end(path: List[int], weights: Dict[int, int], min_identity: float,
+                        max_unitigs: int) -> Optional[List[int]]:
+    """Detect a start-end overlap by aligning the path against itself (off-
+    diagonal) and cut at the weighted midpoint (reference trim.rs:288-296)."""
+    alignment = overlap_alignment(path, path, weights, min_identity, max_unitigs, True)
+    if not alignment:
+        return None
+    midpoint = find_midpoint(alignment, weights)
+    start = alignment[midpoint].a_index
+    end = alignment[midpoint].b_index
+    return list(path[start:end])
+
+
+def trim_path_hairpin_end(path: List[int], weights: Dict[int, int],
+                          min_identity: float, max_unitigs: int
+                          ) -> Optional[List[int]]:
+    """Detect a hairpin overlap at the path end by aligning the reverse path
+    against the path (reference trim.rs:299-317)."""
+    rev_path = reverse_signed_path(path)
+    alignment = overlap_alignment(rev_path, path, weights, min_identity, max_unitigs,
+                                  False)
+    if not alignment:
+        return None
+    end = 0
+    while alignment:
+        while alignment and alignment[0].a_unitig == GAP:
+            alignment.pop(0)
+        while alignment and alignment[-1].b_unitig == GAP:
+            alignment.pop()
+        if not alignment:
+            break
+        back = alignment.pop()
+        if alignment:
+            assert back.b_unitig == -alignment[0].a_unitig
+        if back.a_unitig != GAP:
+            end = back.b_index
+        if alignment:
+            alignment.pop(0)
+    return list(path[:end])
+
+
+def trim_path_hairpin_start(path: List[int], weights: Dict[int, int],
+                            min_identity: float, max_unitigs: int
+                            ) -> Optional[List[int]]:
+    """Hairpin trim at the path start = hairpin-end trim of the reverse path
+    (reference trim.rs:320-326)."""
+    rev_path = reverse_signed_path(path)
+    trimmed = trim_path_hairpin_end(rev_path, weights, min_identity, max_unitigs)
+    if trimmed is None:
+        return None
+    return reverse_signed_path(trimmed)

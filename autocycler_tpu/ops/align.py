@@ -1,0 +1,192 @@
+"""Weighted path-overlap alignment (the trim DP) and global alignment
+distance (the resolve DP), as vectorised kernels.
+
+Parity target: reference trim.rs:366-507 and resolve.rs:387-418. Both DPs
+run over unitig-ID paths (ints), weighted by unitig length.
+
+Vectorisation note: weights are integers, so every DP score is a multiple of
+0.5 and f64 arithmetic on them is exact (no rounding). That lets the
+row-sequential insert recurrence
+
+    S[i][j] = max(base[i][j], S[i][j-1] - w_j)
+
+be rewritten with column-weight prefix sums W as
+
+    S[i][j] + W[j] = running_max(base[i][j] + W[j])
+
+i.e. one cumulative-max per row — identical results to the reference's
+cell-by-cell loops, but each row is a single vector op (numpy here; the same
+formulation maps to a lax.scan over rows on TPU).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+GAP = 0
+NONE = -1  # the reference uses usize::MAX; -1 is the Python stand-in
+
+
+class AlignmentPiece:
+    """One column of the overlap alignment (reference trim.rs:329-349)."""
+
+    __slots__ = ("a_unitig", "a_index", "b_unitig", "b_index")
+
+    def __init__(self, a_unitig: int, a_index: int, b_unitig: int, b_index: int):
+        self.a_unitig = a_unitig
+        self.a_index = a_index
+        self.b_unitig = b_unitig
+        self.b_index = b_index
+
+    def __eq__(self, other):
+        return (self.a_unitig, self.a_index, self.b_unitig, self.b_index) == \
+            (other.a_unitig, other.a_index, other.b_unitig, other.b_index)
+
+    def __repr__(self):
+        a_u = "GAP" if self.a_unitig == GAP else str(self.a_unitig)
+        b_u = "GAP" if self.b_unitig == GAP else str(self.b_unitig)
+        a_i = "NONE" if self.a_index == NONE else str(self.a_index)
+        b_i = "NONE" if self.b_index == NONE else str(self.b_index)
+        return f"{a_u},{a_i},{b_u},{b_i}"
+
+
+def overlap_alignment(path_a: Sequence[int], path_b: Sequence[int],
+                      weights: Dict[int, int], min_identity: float,
+                      max_unitigs: int, skip_diagonal: bool) -> List[AlignmentPiece]:
+    """Find an overlap alignment from the right edge to the top edge of the
+    (first k of a) × (last k of b) scoring matrix (reference trim.rs:366-479).
+
+    Matches score +w, mismatches -(w_a+w_b)/2, indels -w; the matrix is
+    capped at max_unitigs² and, for path-vs-itself alignment, the main
+    diagonal is skipped to avoid the trivial whole-vs-whole alignment.
+    Returns [] when no alignment reaches the top edge with positive score
+    and sufficient identity.
+    """
+    assert len(path_a) == len(path_b)
+    n = len(path_a)
+    k = min(max_unitigs, n)
+    if k == 0:
+        return []
+
+    pa = np.asarray(path_a, dtype=np.int64)
+    pb = np.asarray(path_b, dtype=np.int64)
+    wa = np.array([weights[abs(int(u))] for u in pa], dtype=np.float64)
+    wb = np.array([weights[abs(int(u))] for u in pb], dtype=np.float64)
+
+    b_glob = n - k + np.arange(1, k + 1) - 1       # global b index per column j=1..k
+    wcol = wb[b_glob]
+    Wcum = np.concatenate([[0.0], np.cumsum(wcol)])  # indexed by j=0..k
+    a_vals = pa
+    b_vals = pb[b_glob]
+
+    matrix = np.full((k + 1, k + 1), -np.inf)
+    matrix[0, :] = 0.0
+    matrix[:, 0] = 0.0
+
+    for i in range(1, k + 1):
+        gi = i - 1
+        wi = wa[gi]
+        prev = matrix[i - 1]
+        match_add = np.where(a_vals[gi] == b_vals, wi, -(wi + wcol) / 2.0)
+        base = np.maximum(prev[:k] + match_add, prev[1:] - wi)
+        # diagonal skip leaves that cell at -inf and restarts the insert chain
+        jd = gi - (n - k) + 1 if skip_diagonal else 0
+        T = base + Wcum[1:]
+        if 1 <= jd <= k:
+            run = np.empty(k)
+            run[:jd - 1] = np.maximum.accumulate(np.concatenate([[0.0], T[:jd - 1]]))[1:]
+            if jd < k:
+                run[jd:] = np.maximum.accumulate(T[jd:])
+            row = run - Wcum[1:]
+            row[jd - 1] = -np.inf
+        else:
+            row = np.maximum.accumulate(np.concatenate([[0.0], T]))[1:] - Wcum[1:]
+        matrix[i, 1:] = row
+
+    # best score on the right edge (smallest row wins ties, like the
+    # reference's strict > scan)
+    right = matrix[1:, k]
+    max_i = int(np.argmax(right)) + 1
+    max_score = matrix[max_i, k]
+    if not max_score > 0.0:
+        return []
+
+    # traceback (reference trim.rs:426-461)
+    pieces: List[AlignmentPiece] = []
+    i, j = max_i, k
+    while i > 0 and j > 0:
+        gi, gj = i - 1, n - k + j - 1
+        if pa[gi] == pb[gj]:
+            pieces.append(AlignmentPiece(int(pa[gi]), gi, int(pb[gj]), gj))
+            i -= 1
+            j -= 1
+        elif matrix[i - 1, j] >= matrix[i, j - 1]:
+            pieces.append(AlignmentPiece(int(pa[gi]), gi, GAP, NONE))
+            i -= 1
+        else:
+            pieces.append(AlignmentPiece(GAP, NONE, int(pb[gj]), gj))
+            j -= 1
+    if i > 0:
+        return []  # traceback must reach the top edge, not the left edge
+    pieces.reverse()
+
+    a_len = sum(weights[abs(p.a_unitig)] for p in pieces if p.a_unitig != GAP)
+    b_len = sum(weights[abs(p.b_unitig)] for p in pieces if p.b_unitig != GAP)
+    mean_length = (a_len + b_len) / 2.0
+    matches = sum(weights[abs(p.a_unitig)] for p in pieces
+                  if p.a_unitig == p.b_unitig)
+    if mean_length == 0 or matches / mean_length < min_identity:
+        return []
+    return pieces
+
+
+def find_midpoint(alignment: List[AlignmentPiece], weights: Dict[int, int]) -> int:
+    """Index of the match column whose cumulative weight is closest to the
+    alignment's weighted midpoint (reference trim.rs:482-507)."""
+    total = 0
+    for p in alignment:
+        if p.a_unitig != GAP:
+            total += weights[abs(p.a_unitig)]
+        if p.b_unitig != GAP:
+            total += weights[abs(p.b_unitig)]
+    cumulative = 0
+    best_index, best_closeness = 0, 1.0
+    for i, p in enumerate(alignment):
+        if p.a_unitig != GAP:
+            cumulative += weights[abs(p.a_unitig)]
+        if p.b_unitig != GAP:
+            cumulative += weights[abs(p.b_unitig)]
+        closeness = abs(0.5 - cumulative / total)
+        if p.a_unitig == p.b_unitig and closeness < best_closeness:
+            best_index, best_closeness = i, closeness
+    return best_index
+
+
+def global_alignment_distance(path_a: Sequence[int], path_b: Sequence[int],
+                              weights: Dict[int, int]) -> int:
+    """Weighted global alignment (Needleman-Wunsch) distance between two
+    paths (reference resolve.rs:387-418): match 0, mismatch max(w_a, w_b)
+    (the longer tig), indel w; returns the minimum total distance. Row-
+    vectorised with the min-plus prefix-scan form of the insert recurrence
+    (integer arithmetic, exact)."""
+    a = np.asarray(path_a, dtype=np.int64)
+    b = np.asarray(path_b, dtype=np.int64)
+    n, m = len(a), len(b)
+    wa = np.array([weights[abs(int(u))] for u in a], dtype=np.int64) if n else np.zeros(0, np.int64)
+    wb = np.array([weights[abs(int(u))] for u in b], dtype=np.int64) if m else np.zeros(0, np.int64)
+    Wb = np.concatenate([[0], np.cumsum(wb)])      # top edge: gaps in A
+    prev = Wb.copy()                               # row 0
+    for i in range(n):
+        wi = wa[i]
+        mismatch = np.where(a[i] == b, 0, np.maximum(wi, wb))
+        base = np.minimum(prev[:m] + mismatch, prev[1:] + wi)
+        left_edge = prev[0] + wi
+        # S[j] = min(base[j], S[j-1] + wb[j])  ->  min-plus prefix scan
+        run = np.minimum.accumulate(np.concatenate([[left_edge], base - Wb[1:]]))
+        row = np.empty(m + 1, dtype=np.int64)
+        row[0] = left_edge
+        row[1:] = run[1:] + Wb[1:]
+        prev = row
+    return int(prev[m])

@@ -1,0 +1,55 @@
+"""gfa2fasta topology-annotation tests (reference gfa2fasta.rs test module)."""
+
+from autocycler_tpu.commands.gfa2fasta import save_graph_to_fasta
+from autocycler_tpu.models import UnitigGraph
+
+from fixtures_gfa import (TEST_GFA_1, TEST_GFA_2, TEST_GFA_5, TEST_GFA_8, TEST_GFA_9,
+                          TEST_GFA_10, TEST_GFA_13, gfa_lines)
+
+
+def run(text, tmp_path):
+    graph, _ = UnitigGraph.from_gfa_lines(gfa_lines(text))
+    out = tmp_path / "temp.fasta"
+    save_graph_to_fasta(graph, out)
+    return out.read_text()
+
+
+def test_gfa2fasta_1(tmp_path):
+    assert run(TEST_GFA_1, tmp_path) == (
+        ">1 length=22\nTTCGCTGCGCTCGCTTCGCTTT\n>2 length=18\nTGCCGTCGTCGCTGTGCA\n"
+        ">3 length=15\nTGCCTGAATCGCCTA\n>4 length=10\nGCTCGGCTCG\n>5 length=8\nCGAACCAT\n"
+        ">6 length=7\nTACTTGT\n>7 length=5\nGCCTT\n>8 length=4\nATCT\n>9 length=2\nGC\n"
+        ">10 length=1\nT\n")
+
+
+def test_gfa2fasta_2(tmp_path):
+    assert run(TEST_GFA_2, tmp_path) == (
+        ">1 length=22\nACCGCTGCGCTCGCTTCGCTCT\n>2 length=5\nATGAT\n>3 length=4\nGCGC\n")
+
+
+def test_gfa2fasta_5(tmp_path):
+    assert run(TEST_GFA_5, tmp_path) == (
+        ">1 length=19\nAGCATCGACATCGACTACG\n"
+        ">2 length=15 circular=false topology=linear\nAGCATCAGCATCAGC\n"
+        ">3 length=9\nGTCGCATTT\n"
+        ">4 length=7 circular=true topology=circular\nTCGCGAA\n"
+        ">5 length=6\nTTAAAC\n>6 length=4\nCACA\n")
+
+
+def test_gfa2fasta_8(tmp_path):
+    assert run(TEST_GFA_8, tmp_path) == \
+        ">1 length=19 circular=true topology=circular\nAGCATCGACATCGACTACG\n"
+
+
+def test_gfa2fasta_9(tmp_path):
+    assert run(TEST_GFA_9, tmp_path) == \
+        ">1 length=19 circular=false topology=linear\nAGCATCGACATCGACTACG\n"
+
+
+def test_gfa2fasta_10(tmp_path):
+    assert run(TEST_GFA_10, tmp_path) == \
+        ">1 length=19 circular=false topology=linear\nAGCATCGACATCGACTACG\n"
+
+
+def test_gfa2fasta_13(tmp_path):
+    assert run(TEST_GFA_13, tmp_path) == ">1 length=19\nAGCATCGACATCGACTACG\n"

@@ -5,7 +5,12 @@ from pathlib import Path
 
 from autocycler_tpu.commands.compress import compress
 from autocycler_tpu.commands.cluster import cluster
+from autocycler_tpu.commands.trim import trim
+from autocycler_tpu.commands.resolve import resolve
+from autocycler_tpu.commands.combine import combine
+from autocycler_tpu.commands.gfa2fasta import gfa2fasta
 from autocycler_tpu.models import UnitigGraph
+from autocycler_tpu.utils import load_fasta
 
 from synthetic import make_assemblies
 
@@ -37,3 +42,42 @@ def test_compress_then_cluster(tmp_path):
     _, seqs1 = UnitigGraph.from_gfa_file(pass_dirs[0] / "1_untrimmed.gfa")
     _, seqs2 = UnitigGraph.from_gfa_file(pass_dirs[1] / "1_untrimmed.gfa")
     assert min(s.length for s in seqs1) > max(s.length for s in seqs2)
+
+
+def test_full_pipeline_to_consensus(tmp_path):
+    """compress -> cluster -> trim -> resolve -> combine on clean synthetic
+    data must produce a fully-resolved consensus: one circular contig per
+    replicon, sequence matching a rotation of the true genome."""
+    asm_dir = make_assemblies(tmp_path, n_assemblies=4, chromosome_len=3000,
+                              plasmid_len=600, seed=11)
+    out_dir = tmp_path / "autocycler_out"
+    compress(asm_dir, out_dir, k_size=51, use_jax=False)
+    cluster(out_dir, use_jax=False)
+
+    cluster_dirs = sorted((out_dir / "clustering" / "qc_pass").iterdir())
+    assert len(cluster_dirs) == 2
+    for cluster_dir in cluster_dirs:
+        trim(cluster_dir)
+        assert (cluster_dir / "2_trimmed.gfa").is_file()
+        resolve(cluster_dir)
+        assert (cluster_dir / "5_final.gfa").is_file()
+
+    combine(out_dir, [d / "5_final.gfa" for d in cluster_dirs])
+    fasta = out_dir / "consensus_assembly.fasta"
+    assert fasta.is_file()
+    records = load_fasta(fasta)
+    assert len(records) == 2
+    # each record should be circular and match a rotation of a true replicon
+    import synthetic, random
+    rng = random.Random(11)
+    chromosome = synthetic.random_genome(rng, 3000)
+    plasmid = synthetic.random_genome(rng, 600)
+    for name, header, seq in records:
+        assert "circular=true" in header
+        truth = chromosome if len(seq) > 1500 else plasmid
+        assert len(seq) == len(truth)
+        doubled = truth + truth
+        assert seq in doubled or synthetic.revcomp(seq) in doubled
+
+    gfa2fasta(out_dir / "consensus_assembly.gfa", out_dir / "via_gfa2fasta.fasta")
+    assert (out_dir / "via_gfa2fasta.fasta").is_file()

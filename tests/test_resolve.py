@@ -1,0 +1,101 @@
+"""Resolve tests: anchor-to-anchor path cutting, bridge grouping, medoid
+selection, ambiguity detection (reference resolve.rs test module)."""
+
+from autocycler_tpu.commands.resolve import (Bridge, determine_ambiguity,
+                                             get_anchor_to_anchor_paths,
+                                             group_paths_by_start_end)
+
+
+def test_get_anchor_to_anchor_paths():
+    sequence_paths = [[1, -10, 4, 6, -5, -2, -9, 3, 8, -7],
+                      [-2, -9, 12, 8, -7, 1, -10, 4, 6, -5],
+                      [7, -8, -3, 9, 2, 11, -6, -4, 10, -1]]
+    anchor_set = {1, 2, 6, 8}
+    assert get_anchor_to_anchor_paths(sequence_paths, anchor_set) == [
+        [1, -10, 4, 6], [6, -5, -2], [-2, -9, 3, 8], [-2, -9, 12, 8], [8, -7, 1],
+        [1, -10, 4, 6], [-2, -9, 3, 8], [6, -11, -2], [1, -10, 4, 6]]
+
+
+def test_group_paths_by_start_end():
+    paths = [[1, -10, 4, 6], [6, -5, -2], [-2, -9, 3, 8], [-2, -9, 12, 8],
+             [8, -7, 1], [1, -10, 4, 6], [-2, -9, 3, 8], [6, -11, -2], [1, -10, 4, 6]]
+    grouped = group_paths_by_start_end(paths)
+    assert grouped == {
+        (1, 6): [[1, -10, 4, 6], [1, -10, 4, 6], [1, -10, 4, 6]],
+        (6, -2): [[6, -5, -2], [6, -11, -2]],
+        (-2, 8): [[-2, -9, 3, 8], [-2, -9, 12, 8], [-2, -9, 3, 8]],
+        (8, 1): [[8, -7, 1]]}
+
+
+W10 = {n: 10 for n in (1, 12, 23, 8, 41, 2, 17, 123)}
+
+
+def test_bridge_unitig_nums():
+    paths = [[1, 12, -23, -8, 41, 2]] * 3 + [[1, 12, 17, 123, 41, 2]]
+    bridge = Bridge(1, 2, paths, W10)
+    assert bridge.rev_start() == -2
+    assert bridge.rev_end() == -1
+    assert bridge.depth() == 4
+
+
+def test_determine_ambiguity_no_conflicts():
+    w = {n: 10 for n in (1, 2, 4, 5, 6, 11, 12)}
+    bridges = [Bridge(1, -2, [[1, 12, 2]], w), Bridge(-2, 5, [[-2, 6, 5]], w),
+               Bridge(4, -5, [[4, -5]], w), Bridge(-4, 6, [[-4, 12, 6]], w),
+               Bridge(-1, -6, [[-1, 11, -6]], w)]
+    determine_ambiguity(bridges)
+    assert [b.conflicting for b in bridges] == [False] * 5
+
+
+def test_determine_ambiguity_conflicts():
+    w = {n: 10 for n in (1, 2, 4, 5, 6, 7, 8, 9, 11, 12, 13, 14)}
+    bridges = [Bridge(1, -2, [[1, 12, 2]], w), Bridge(-2, 5, [[-2, 6, 5]], w),
+               Bridge(4, -5, [[4, -5]], w), Bridge(-4, 6, [[-4, 12, 6]], w),
+               Bridge(-1, -6, [[-1, 11, -6]], w), Bridge(-4, 7, [[-4, 13, 7]], w),
+               Bridge(1, 8, [[1, 14, 8]], w), Bridge(4, -8, [[4, 9, -8]], w)]
+    determine_ambiguity(bridges)
+    assert [b.conflicting for b in bridges] == \
+        [True, False, True, True, False, True, True, True]
+
+
+def test_best_path_majority():
+    paths = [[1, 12, -23, -8, 41, 2]] * 3 + [[1, 12, 17, 123, 41, 2]]
+    assert Bridge(1, 2, paths, W10).best_path == [12, -23, -8, 41]
+
+
+def test_best_path_tie_lexicographic():
+    paths = [[1, 12, 17, 123, 41, 2], [1, 12, -23, -8, 41, 2],
+             [1, 12, -23, -8, 41, 2], [1, 12, 17, 123, 41, 2]]
+    assert Bridge(1, 2, paths, W10).best_path == [12, -23, -8, 41]
+
+
+def test_best_path_medoid_beats_mode():
+    """The most common path is not the best: the medoid minimises the total
+    distance (reference resolve.rs:634-657)."""
+    w = {n: 10 for n in range(1, 22)}
+    paths = [[1, 2, 3, 4, 5, 6, 7, 8, 20, 10, 11, 12],
+             [1, 13, 12],
+             [1, 2, 3, 4, 16, 6, 7, 8, 9, 10, 11, 12],
+             [1, 2, 3, 4, 5, 6, 7, 8, 9, 21, 11, 12],
+             [1, 2, 3, 4, 5, 17, 7, 8, 9, 10, 11, 12],
+             [1, 13, 12],
+             [1, 2, 3, 4, 5, 6, 18, 8, 9, 10, 11, 12],
+             [1, 2, 14, 4, 5, 6, 7, 8, 9, 10, 11, 12],
+             [1, 2, 3, 15, 5, 6, 7, 8, 9, 10, 11, 12],
+             [1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12],
+             [1, 2, 3, 4, 5, 6, 7, 19, 9, 10, 11, 12]]
+    assert Bridge(1, 2, paths, w).best_path == [2, 3, 4, 5, 6, 7, 8, 9, 10, 11]
+
+
+def test_global_alignment_distance_reference_cases():
+    from autocycler_tpu.ops.align import global_alignment_distance
+    w = {1: 10, 2: 1, 3: 2, 4: 3, 5: 4, 6: 10}
+    assert global_alignment_distance([1, 2, 3, 4, 5, 6], [1, 2, 3, 4, 5, 6], w) == 0
+    assert global_alignment_distance([], [], w) == 0
+    assert global_alignment_distance([1, 2, 3, 4, 5, 6], [1, 2, 3, 4, 6], w) == 4
+    assert global_alignment_distance([1, 2, 3, 4, 6], [1, 2, 3, 4, 5, 6], w) == 4
+    assert global_alignment_distance([1, 2, 4, 5, 6], [1, 2, 3, 4, 5, 6], w) == 2
+    assert global_alignment_distance([1, 3, 4, 5, 6], [1, 2, 3, 5, 6], w) == 4
+    assert global_alignment_distance([1, 2, 3, 4, 5, 6], [], w) == 30
+    assert global_alignment_distance([], [1, 2, 3, 4, 5, 6], w) == 30
+    assert global_alignment_distance([1, 2, 3, 5, 6], [1, 2, 4, 5, 6], w) == 3

@@ -1,0 +1,167 @@
+"""Trim tests, including the reference's real-scale ~90-element unitig paths
+with genuine weights (trim.rs test module)."""
+
+from autocycler_tpu.commands.trim import (trim_path_hairpin_end, trim_path_hairpin_start,
+                                          trim_path_start_end)
+from autocycler_tpu.ops.align import (GAP, NONE, AlignmentPiece, overlap_alignment,
+                                      global_alignment_distance)
+
+
+def test_overlap_alignment_basics():
+    w10 = {1: 10, 2: 10, 3: 10, 4: 10, 5: 10}
+    # no alignment
+    assert overlap_alignment([1, -2, 3, -4, 5], [1, -2, 3, -4, 5], w10, 0.9, 100, True) == []
+    # exact overlap of two unitigs, various max_unitigs
+    path = [1, -2, 3, -4, 5, 1, -2]
+    expected = [AlignmentPiece(1, 0, 1, 5), AlignmentPiece(-2, 1, -2, 6)]
+    for max_unitigs in (100, 4, 2):
+        assert overlap_alignment(path, path, w10, 0.9, max_unitigs, True) == expected
+    assert overlap_alignment(path, path, w10, 0.9, 1, True) == []
+    # inexact overlap of three unitigs
+    path = [1, -2, 3, -4, 5, 1, 6, 3]
+    w = {1: 30, 2: 1, 3: 10, 4: 10, 5: 10, 6: 1}
+    expected = [AlignmentPiece(1, 0, 1, 5), AlignmentPiece(GAP, NONE, 6, 6),
+                AlignmentPiece(-2, 1, GAP, NONE), AlignmentPiece(3, 2, 3, 7)]
+    assert overlap_alignment(path, path, w, 0.9, 100, True) == expected
+    assert overlap_alignment(path, path, w, 0.99, 100, True) == []
+    assert overlap_alignment(path, path, w, 0.9, 2, True) == []
+
+
+W1 = {653: 541, 728: 413, 757: 366, 977: 185, 1010: 170, 1058: 153, 1105: 138,
+      1133: 133, 1492: 79, 1552: 74, 1637: 68, 1667: 65, 1913: 51, 1943: 50,
+      1949: 50, 1952: 50, 1967: 50, 1982: 50, 1993: 50, 2012: 49, 2018: 48,
+      2065: 45, 2070: 45, 2110: 42, 2148: 39, 2276: 32, 2289: 32, 2499: 25,
+      2640: 21, 2826: 15, 2937: 11, 3148: 6, 3208: 5, 3456: 2, 3578: 2,
+      4216: 1, 4238: 1, 4575: 1, 4875: 1, 4876: 1, 5191: 1}
+
+
+def test_trim_path_start_end_real_scale():
+    path = [-653, 4876, -3456, 2018, -1913, -1492, -977, 1993, -757, -2640, 4216,
+            -2640, 4216, -2640, 728, 1967, -4238, -1552, -4575, -2289, 4875, 1982,
+            1637, -1010, 2826, -1667, -1949, -1133, 1105, 2499, 1952, -5191, -2276,
+            2937, -3148, 2110, 3578, -2065, 2012, -2148, 2070, 1058]
+    assert trim_path_start_end(path, W1, 0.95, 1000) is None
+
+    path = [-1133, 1105, 2499, 1952, -5191, -2276, 2937, -3148, 2110, 3578, -2065,
+            2012, -2148, 2070, 1058, 1943, -653, 4876, -3456, 2018, -1913, -1492,
+            -977, 1993, -757, -2640, 4216, -2640, 4216, -2640, 728, 1967, -4238,
+            -1552, -4575, -2289, 4875, 1982, 1637, -1010, 2826, -1667]
+    assert trim_path_start_end(path, W1, 0.95, 1000) is None
+
+    path = [-728, 2640, -4216, 2640, -4216, 2640, 757, -1993, 977, 1492, 1913,
+            -2018, 3456, -4876, 653, -1943, -1058, -2070, 2148, -2012, 2065, -3578,
+            -2110, 3148, -2937, 2276, 5191, -1952, -2499, -1105, 1133, 1949, 1667,
+            -2826, 1010, -1637, -1982, -4875, 2289, 4575, 1552, 4238, -1967, -728,
+            2640, -4216, 2640, -4216, 2640, 757, -1993, 977, 1492, 1913, -2018,
+            3456, -4876, 653, -1943, -1058, -2070, 2148, -2012, 2065, -3578, -2110,
+            3148, -2937, 2276, 5191, -1952, -2499, -1105, 1133, 1949, 1667, -2826,
+            1010, -1637, -1982, -4875, 2289, 4575, 1552, 4238]
+    assert trim_path_start_end(path, W1, 0.95, 1000) == \
+        [653, -1943, -1058, -2070, 2148, -2012, 2065, -3578, -2110, 3148, -2937,
+         2276, 5191, -1952, -2499, -1105, 1133, 1949, 1667, -2826, 1010, -1637,
+         -1982, -4875, 2289, 4575, 1552, 4238, -1967, -728, 2640, -4216, 2640,
+         -4216, 2640, 757, -1993, 977, 1492, 1913, -2018, 3456, -4876]
+
+    path = [-977, 1993, -757, -2640, 4216, -2640, 4216, -2640, 728, 1967, -4238,
+            -1552, -4575, -2289, 4875, 1982, 1637, -1010, 2826, -1667, -1949, -1133,
+            1105, 2499, 1952, -5191, -2276, 2937, -3148, 2110, 3578, -2065, 2012,
+            -2148, 2070, 1058, 1943, -653, 4876, -3456, 2018, -1913, -1492, -977,
+            1993, -757, -2640, 4216, -2640, 4216, -2640, 728, 1967, -4238, -1552,
+            -4575, -2289, 4875, 1982, 1637, -1010, 2826, -1667, -1949, -1133, 1105,
+            2499, 1952, -5191, -2276, 2937, -3148, 2110, 3578, -2065, 2012, -2148,
+            2070, 1058, 1943, -653, -3208, 2018, -1913]
+    assert trim_path_start_end(path, W1, 0.95, 1000) == \
+        [2826, -1667, -1949, -1133, 1105, 2499, 1952, -5191, -2276, 2937, -3148,
+         2110, 3578, -2065, 2012, -2148, 2070, 1058, 1943, -653, 4876, -3456, 2018,
+         -1913, -1492, -977, 1993, -757, -2640, 4216, -2640, 4216, -2640, 728,
+         1967, -4238, -1552, -4575, -2289, 4875, 1982, 1637, -1010]
+
+
+W10 = {i: 10 for i in range(1, 11)}
+W_MIX = {1: 100, 2: 100, 3: 10, 4: 100, 5: 100, 6: 1, 7: 1, 8: 1, 9: 1, 10: 1}
+
+
+def test_trim_path_hairpin_end_exact():
+    assert trim_path_hairpin_end([1, 2, 3, 4, 5], W10, 0.95, 1000) is None
+    assert trim_path_hairpin_end([1, 2, 3, 4, 5, -5], W10, 0.95, 1000) == [1, 2, 3, 4, 5]
+    assert trim_path_hairpin_end([1, 2, 3, 4, 5, -5, -4], W10, 0.95, 1000) == [1, 2, 3, 4, 5]
+    assert trim_path_hairpin_end([1, 2, 3, 4, 5, -5, -4, -3, -2, -1], W10, 0.95, 1000) \
+        == [1, 2, 3, 4, 5]
+    assert trim_path_hairpin_end([7, 8, 9, 10, -10, -9, -8], W10, 0.95, 1000) \
+        == [7, 8, 9, 10]
+    assert trim_path_hairpin_end(
+        [7, 8, 9, 10, -10, -9, -8, -7, -6, -5, -4, -3, -2, -1], W10, 0.95, 1000) is None
+
+
+def test_trim_path_hairpin_end_inexact():
+    assert trim_path_hairpin_end([1, 2, 3, 6, 4, 5, -5, 7, -4, -3, -2, -1],
+                                 W_MIX, 0.95, 1000) == [1, 2, 3, 6, 4, 5]
+    assert trim_path_hairpin_end([1, 2, 3, 6, 4, 7, 5, -5, 8, 9, 10, -4, -3, -2, -1],
+                                 W_MIX, 0.95, 1000) == [1, 2, 3, 6, 4, 7, 5]
+    assert trim_path_hairpin_end([1, 2, 3, 6, 7, 4, 8, 9, 5, -5, -4, -3, -2, 10, -1],
+                                 W_MIX, 0.95, 1000) == [1, 2, 3, 6, 7, 4, 8, 9, 5]
+    assert trim_path_hairpin_end([1, 2, 3, 4, 6, -4, -3], W_MIX, 0.95, 1000) \
+        == [1, 2, 3, 4, 6]
+    assert trim_path_hairpin_end([1, 2, 3, 4, -4, -3, 6, 7, 8, 9, 10], W_MIX, 0.95, 1000) \
+        == [1, 2, 3, 4]
+    assert trim_path_hairpin_end([6, 5, 4, 3, 2, 1, -1, -2, -3, 9], W_MIX, 0.95, 1000) \
+        == [6, 5, 4, 3, 2, 1]
+
+
+def test_trim_path_hairpin_end_low_identity_guard():
+    path = [-5, -4, -3, -2, -1, 1, 2, 3, 4, 5, 6, 7, 8]
+    assert trim_path_hairpin_end(path, W10, 0.2, 1000) is None
+
+
+def test_trim_path_hairpin_start_exact():
+    assert trim_path_hairpin_start([1, 2, 3, 4, 5], W10, 0.95, 1000) is None
+    assert trim_path_hairpin_start([-1, 1, 2, 3, 4, 5], W10, 0.95, 1000) == [1, 2, 3, 4, 5]
+    assert trim_path_hairpin_start([-2, -1, 1, 2, 3, 4, 5], W10, 0.95, 1000) \
+        == [1, 2, 3, 4, 5]
+    assert trim_path_hairpin_start([-5, -4, -3, -2, -1, 1, 2, 3, 4, 5], W10, 0.95, 1000) \
+        == [1, 2, 3, 4, 5]
+    assert trim_path_hairpin_start(
+        [-10, -9, -8, -7, -6, -5, -4, -3, -2, -1, 1, 2, 3, 4, 5], W10, 0.95, 1000) is None
+
+
+def test_trim_path_hairpin_start_inexact():
+    assert trim_path_hairpin_start([-5, 7, -4, -3, -2, -1, 1, 2, 3, 6, 4, 5],
+                                   W_MIX, 0.95, 1000) == [1, 2, 3, 6, 4, 5]
+    assert trim_path_hairpin_start([-5, 8, 9, 10, -4, -3, -2, -1, 1, 2, 3, 6, 4, 7, 5],
+                                   W_MIX, 0.95, 1000) == [1, 2, 3, 6, 4, 7, 5]
+    assert trim_path_hairpin_start([-5, -4, -3, -2, 10, -1, 1, 2, 3, 6, 7, 4, 8, 9, 5],
+                                   W_MIX, 0.95, 1000) == [1, 2, 3, 6, 7, 4, 8, 9, 5]
+    assert trim_path_hairpin_start([-2, -1, 6, 1, 2, 3, 4], W_MIX, 0.95, 1000) \
+        == [6, 1, 2, 3, 4]
+    assert trim_path_hairpin_start([6, 7, 8, 9, 10, -2, -1, 1, 2, 3, 4], W_MIX, 0.95, 1000) \
+        == [1, 2, 3, 4]
+    assert trim_path_hairpin_start([-9, 3, 2, 1, -1, -2, -3, -4, -5, -6], W_MIX, 0.95, 1000) \
+        == [-1, -2, -3, -4, -5, -6]
+
+
+def test_trim_path_hairpin_start_low_identity_guard():
+    path = [-8, -7, -6, -5, -4, -3, -2, -1, 1, 2, 3, 4, 5]
+    assert trim_path_hairpin_start(path, W10, 0.2, 1000) is None
+
+
+def test_trim_path_hairpin_both_ends():
+    cases = [
+        [-1, 1, 2, 3, 4, 5, -5],
+        [-2, -1, 1, 2, 3, 4, 5, -5, -4],
+        [-3, -2, -1, 1, 2, 3, 4, 5, -5, -4, -3],
+        [-4, -3, -2, -1, 1, 2, 3, 4, 5, -5, -4, -3, -2],
+        [-5, -4, -3, -2, -1, 1, 2, 3, 4, 5, -5, -4, -3, -2, -1],
+    ]
+    for path in cases:
+        p = trim_path_hairpin_start(path, W10, 0.95, 1000)
+        p = trim_path_hairpin_end(p, W10, 0.95, 1000)
+        assert p == [1, 2, 3, 4, 5]
+
+
+def test_global_alignment_distance():
+    w = {1: 10, 2: 20, 3: 30, 4: 40}
+    assert global_alignment_distance([1, 2, 3], [1, 2, 3], w) == 0
+    assert global_alignment_distance([1, 2, 3], [1, 3], w) == 20      # delete 2
+    assert global_alignment_distance([1, 2, 3], [1, 4, 3], w) == 40   # mismatch max(20,40)
+    assert global_alignment_distance([], [1, 2], w) == 30
+    assert global_alignment_distance([1, -1], [1, 1], w) == 10        # strand mismatch
