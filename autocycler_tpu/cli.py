@@ -1,0 +1,176 @@
+"""Command-line interface: the twelve Autocycler subcommands.
+
+Parity target: reference main.rs:44-370 — same subcommand names, flags,
+defaults and validation ranges, dispatching to commands/*.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import __version__
+from .utils import AutocyclerError
+
+BANNER = r"""                _                        _
+     /\        | |                      | |
+    /  \  _   _| |_ ___   ___ _   _  ___| | ___ _ __
+   / /\ \| | | | __/ _ \ / __| | | |/ __| |/ _ \ '__|
+  / ____ \ |_| | || (_) | (__| |_| | (__| |  __/ |
+ /_/    \_\__,_|\__\___/ \___|\__, |\___|_|\___|_|
+                               __/ |
+                              |___/        (TPU-native)"""
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="autocycler",
+        description="a tool for generating consensus bacterial genome assemblies "
+                    "(TPU-native implementation)")
+    parser.add_argument("--version", action="version",
+                        version=f"Autocycler-TPU v{__version__}")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("clean",
+                       help="manual manipulation of the final consensus assembly graph")
+    p.add_argument("-i", "--in_gfa", required=True)
+    p.add_argument("-o", "--out_gfa", required=True)
+    p.add_argument("-r", "--remove")
+    p.add_argument("-d", "--duplicate")
+    p.add_argument("-m", "--min_depth", type=float)
+
+    p = sub.add_parser("cluster",
+                       help="cluster contigs in the unitig graph based on similarity")
+    p.add_argument("-a", "--autocycler_dir", required=True)
+    p.add_argument("--cutoff", type=float, default=0.2)
+    p.add_argument("--min_assemblies", type=int)
+    p.add_argument("--max_contigs", type=int, default=25)
+    p.add_argument("--manual")
+
+    p = sub.add_parser("combine", help="combine Autocycler GFAs into one assembly")
+    p.add_argument("-a", "--autocycler_dir", required=True)
+    p.add_argument("-i", "--in_gfas", required=True, nargs="+")
+
+    p = sub.add_parser("compress", help="compress input contigs into a unitig graph")
+    p.add_argument("-i", "--assemblies_dir", required=True)
+    p.add_argument("-a", "--autocycler_dir", required=True)
+    p.add_argument("--kmer", type=int, default=51)
+    p.add_argument("--max_contigs", type=int, default=25)
+    p.add_argument("-t", "--threads", type=int, default=8)
+
+    p = sub.add_parser("decompress", help="decompress contigs from a unitig graph")
+    p.add_argument("-i", "--in_gfa", required=True)
+    p.add_argument("-o", "--out_dir")
+    p.add_argument("-f", "--out_file")
+
+    p = sub.add_parser("dotplot",
+                       help="generate an all-vs-all dotplot from a unitig graph")
+    p.add_argument("-i", "--input", required=True)
+    p.add_argument("-o", "--out_png", required=True)
+    p.add_argument("--res", type=int, default=2000)
+    p.add_argument("--kmer", type=int, default=32)
+
+    p = sub.add_parser("gfa2fasta",
+                       help="convert an Autocycler GFA file to FASTA format")
+    p.add_argument("-i", "--in_gfa", required=True)
+    p.add_argument("-o", "--out_fasta", required=True)
+
+    p = sub.add_parser("helper", help="helper commands for long-read assemblers")
+    p.add_argument("task")
+    p.add_argument("-r", "--reads", required=True)
+    p.add_argument("-o", "--out_prefix")
+    p.add_argument("-g", "--genome_size")
+    p.add_argument("-t", "--threads", type=int, default=8)
+    p.add_argument("-d", "--dir")
+    p.add_argument("--read_type", default="ont_r10",
+                   choices=["ont_r9", "ont_r10", "pacbio_clr", "pacbio_hifi"])
+    p.add_argument("--min_depth_abs", type=float)
+    p.add_argument("--min_depth_rel", type=float)
+    p.add_argument("--args", dest="extra_args", nargs="+", default=[])
+
+    p = sub.add_parser("resolve", help="resolve repeats in the unitig graph")
+    p.add_argument("-c", "--cluster_dir", required=True)
+    p.add_argument("--verbose", action="store_true")
+
+    p = sub.add_parser("subsample", help="subsample a long-read set")
+    p.add_argument("-r", "--reads", required=True)
+    p.add_argument("-o", "--out_dir", required=True)
+    p.add_argument("-g", "--genome_size", required=True)
+    p.add_argument("-c", "--count", type=int, default=4)
+    p.add_argument("-d", "--min_read_depth", type=float, default=25.0)
+    p.add_argument("-s", "--seed", type=int, default=0)
+
+    p = sub.add_parser("table", help="create TSV line from YAML files")
+    p.add_argument("-a", "--autocycler_dir")
+    p.add_argument("-n", "--name", default="")
+    from .commands.table import DEFAULT_FIELDS
+    p.add_argument("-f", "--fields", default=DEFAULT_FIELDS)
+    p.add_argument("-s", "--sigfigs", type=int, default=3)
+
+    p = sub.add_parser("trim", help="trim contigs in a cluster")
+    p.add_argument("-c", "--cluster_dir", required=True)
+    p.add_argument("--min_identity", type=float, default=0.75)
+    p.add_argument("--max_unitigs", type=int, default=5000)
+    p.add_argument("--mad", type=float, default=5.0)
+    p.add_argument("-t", "--threads", type=int, default=8)
+
+    return parser
+
+
+def dispatch(args) -> None:
+    if args.command == "clean":
+        from .commands.clean import clean
+        clean(args.in_gfa, args.out_gfa, args.remove, args.duplicate, args.min_depth)
+    elif args.command == "cluster":
+        from .commands.cluster import cluster
+        cluster(args.autocycler_dir, args.cutoff, args.min_assemblies,
+                args.max_contigs, args.manual)
+    elif args.command == "combine":
+        from .commands.combine import combine
+        combine(args.autocycler_dir, args.in_gfas)
+    elif args.command == "compress":
+        from .commands.compress import compress
+        compress(args.assemblies_dir, args.autocycler_dir, args.kmer, args.max_contigs)
+    elif args.command == "decompress":
+        from .commands.decompress import decompress
+        decompress(args.in_gfa, args.out_dir, args.out_file)
+    elif args.command == "dotplot":
+        from .commands.dotplot import dotplot
+        dotplot(args.input, args.out_png, args.res, args.kmer)
+    elif args.command == "gfa2fasta":
+        from .commands.gfa2fasta import gfa2fasta
+        gfa2fasta(args.in_gfa, args.out_fasta)
+    elif args.command == "helper":
+        from .commands.helper import helper
+        helper(args.task, args.reads, args.out_prefix, args.genome_size, args.threads,
+               args.dir, args.read_type, args.min_depth_abs, args.min_depth_rel,
+               args.extra_args)
+    elif args.command == "resolve":
+        from .commands.resolve import resolve
+        resolve(args.cluster_dir, args.verbose)
+    elif args.command == "subsample":
+        from .commands.subsample import subsample
+        subsample(args.reads, args.out_dir, args.genome_size, args.count,
+                  args.min_read_depth, args.seed)
+    elif args.command == "table":
+        from .commands.table import table
+        table(args.autocycler_dir, args.name, args.fields, args.sigfigs)
+    elif args.command == "trim":
+        from .commands.trim import trim
+        trim(args.cluster_dir, args.min_identity, args.max_unitigs, args.mad)
+
+
+def main(argv=None) -> int:
+    print(BANNER, file=sys.stderr)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    try:
+        dispatch(args)
+    except AutocyclerError as e:
+        print(f"\nError: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,298 @@
+"""`autocycler dotplot`: all-vs-all k-mer dotplot PNG.
+
+Parity target: reference dotplot.rs — input may be an Autocycler GFA
+(sequences reconstructed from paths), a FASTA file or a directory of
+assemblies; layout constants, label auto-scaling with vertical left-side
+text, lightgrey self-vs-self panels, mediumblue forward / firebrick reverse
+dots, drawn in reverse-then-forward order so forward wins overlaps.
+
+The k-mer matching is the sort-based grouping kernel from ops.kmers
+(group_windows) instead of per-pair hash maps: all windows of A (forward and
+reverse-complement) and B are grouped in one shot and matches join on group
+id (SURVEY.md's "vmapped k-mer match grid" north star; ops/dotplot_pallas.py
+holds the brute-force Pallas grid kernel used for benchmarking).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from ..models import UnitigGraph
+from ..ops.encode import encode_bytes
+from ..ops.kmers import group_windows
+from ..utils import (find_all_assemblies, load_fasta, log, quit_with_error,
+                     reverse_complement_bytes)
+
+# layout constants (reference dotplot.rs:28-41)
+INITIAL_TOP_LEFT_GAP = 0.1
+BORDER_GAP = 0.015
+BETWEEN_SEQ_GAP = 0.01
+TOTAL_BETWEEN_SEQ_GAP = 0.1
+TEXT_GAP = 0.0025
+MAX_FONT_SIZE = 0.025
+BACKGROUND = (255, 255, 255)
+SELF_VS_SELF = (211, 211, 211)
+SELF_VS_OTHER = (245, 245, 245)
+TEXT_COLOUR = (0, 0, 0)
+OUTLINE = (0, 0, 0)
+FORWARD_DOT = (0, 0, 205)
+REVERSE_DOT = (178, 34, 34)
+
+
+def dotplot(input_path, out_png, res: int = 2000, kmer: int = 32) -> None:
+    if res < 500:
+        quit_with_error("--res cannot be less than 500")
+    if res > 10000:
+        quit_with_error("--res cannot be greater than 10000")
+    if kmer < 10:
+        quit_with_error("--kmer cannot be less than 10")
+    if kmer > 100:
+        quit_with_error("--kmer cannot be greater than 100")
+    log.section_header("Starting autocycler dotplot")
+    log.explanation("This command will take a unitig graph (either before or after "
+                    "trimming) and generate a dotplot image containing all pairwise "
+                    "comparisons of the sequences.")
+    seqs = load_dotplot_sequences(input_path)
+    create_dotplot(seqs, out_png, res, kmer)
+    log.section_header("Finished!")
+    log.message(f"Pairwise dotplots: {out_png}")
+    log.message()
+
+
+def load_dotplot_sequences(input_path) -> List[Tuple[Tuple[str, str], np.ndarray]]:
+    """((filename, seqname), bytes) records from GFA / FASTA / directory
+    (reference dotplot.rs:107-175)."""
+    input_path = Path(input_path)
+    records: List[Tuple[Tuple[str, str], np.ndarray]] = []
+    if input_path.is_dir():
+        for assembly in find_all_assemblies(input_path):
+            for name, _header, seq in load_fasta(assembly):
+                records.append(((assembly.name, name),
+                                np.frombuffer(seq.encode(), dtype=np.uint8)))
+        return records
+    if not input_path.is_file():
+        quit_with_error("--input is neither a file nor a directory")
+    with open(input_path, "rb") as f:
+        first_char = f.read(1).decode(errors="replace")
+    if first_char == ">":
+        for name, _header, seq in load_fasta(input_path):
+            records.append(((input_path.name, name),
+                            np.frombuffer(seq.encode(), dtype=np.uint8)))
+    elif first_char in ("H", "S"):
+        graph, sequences = UnitigGraph.from_gfa_file(input_path)
+        reconstructed = graph.reconstruct_original_sequences(sequences)
+        flat = []
+        for filename, pairs in reconstructed.items():
+            for header, seq in pairs:
+                flat.append(((filename, header.split()[0]),
+                             np.frombuffer(seq.encode(), dtype=np.uint8)))
+        flat.sort(key=lambda r: r[0])
+        records = flat
+    else:
+        quit_with_error("--input is neither GFA or FASTA")
+    return records
+
+
+def _between_seq_gap(gap: float, max_total_gap: float, seq_count: int) -> float:
+    if seq_count <= 1:
+        return gap
+    if (seq_count - 1) * gap > max_total_gap:
+        return max_total_gap / (seq_count - 1)
+    return gap
+
+
+def get_positions(seqs, res: int, kmer: int, top_left_gap: int, bottom_right_gap: int,
+                  between_seq_gap: int):
+    """Image start/end coordinate per sequence plus bp-per-pixel scale
+    (reference dotplot.rs:224-267)."""
+    names = [name for name, _ in seqs]
+    seq_lengths = {name: max(0, len(seq) - kmer + 1) for name, seq in seqs}
+    all_gaps = top_left_gap + bottom_right_gap + between_seq_gap * (len(seqs) - 1)
+    pixels_for_sequence = max(0, res - all_gaps)
+    if all_gaps > pixels_for_sequence and len(seqs) > 1:
+        between_seq_gap = (res // 2 - top_left_gap - bottom_right_gap) // (len(seqs) - 1)
+        all_gaps = top_left_gap + bottom_right_gap + between_seq_gap * (len(seqs) - 1)
+        pixels_for_sequence = max(0, res - all_gaps)
+    total = sum(seq_lengths.values())
+    bp_per_pixel = total / pixels_for_sequence
+    start_positions: Dict = {}
+    end_positions: Dict = {}
+    pos = top_left_gap
+    for name in names:
+        start_positions[name] = pos
+        pos += round(seq_lengths[name] / bp_per_pixel)
+        end_positions[name] = pos
+        pos += between_seq_gap
+    return start_positions, end_positions, bp_per_pixel
+
+
+def kmer_match_positions(seq_a: np.ndarray, seq_b: np.ndarray, kmer: int
+                         ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """All (i, j) k-mer matches of A-forward vs B and A-reverse vs B, with
+    A-reverse positions mapped like the reference (n_a - i - 1,
+    dotplot.rs:433-450). Returns (fwd_i, fwd_j, rev_i, rev_j)."""
+    rc_a = reverse_complement_bytes(seq_a)
+    n_a = len(seq_a) - kmer + 1
+    n_b = len(seq_b) - kmer + 1
+    if n_a <= 0 or n_b <= 0:
+        z = np.zeros(0, np.int64)
+        return z, z, z, z
+    codes = encode_bytes(np.concatenate([seq_a, rc_a, seq_b]))
+    starts = np.concatenate([
+        np.arange(n_a, dtype=np.int64),
+        len(seq_a) + np.arange(n_a, dtype=np.int64),
+        2 * len(seq_a) + np.arange(n_b, dtype=np.int64)])
+    order, gid_sorted = group_windows(codes, starts, kmer)
+    gid = np.empty(len(starts), np.int64)
+    gid[order] = gid_sorted
+    G = int(gid_sorted[-1]) + 1 if len(starts) else 0
+
+    a_fwd_gid = gid[:n_a]
+    a_rev_gid = gid[n_a:2 * n_a]
+    b_gid = gid[2 * n_a:]
+
+    def join(a_gid, a_pos):
+        order_a = np.argsort(a_gid, kind="stable")
+        sorted_gid = a_gid[order_a]
+        starts_in_a = np.searchsorted(sorted_gid, b_gid, side="left")
+        ends_in_a = np.searchsorted(sorted_gid, b_gid, side="right")
+        counts = ends_in_a - starts_in_a
+        j = np.repeat(np.arange(n_b, dtype=np.int64), counts)
+        take = np.concatenate([np.arange(s, e) for s, e in zip(starts_in_a, ends_in_a)
+                               if e > s]) if counts.sum() else np.zeros(0, np.int64)
+        i = a_pos[order_a][take]
+        return i, j
+
+    fwd_i, fwd_j = join(a_fwd_gid, np.arange(n_a, dtype=np.int64))
+    rev_i, rev_j = join(a_rev_gid, n_a - 1 - np.arange(n_a, dtype=np.int64))
+    return fwd_i, fwd_j, rev_i, rev_j
+
+
+def create_dotplot(seqs, png_filename, res: int, kmer: int) -> None:
+    from PIL import Image, ImageDraw
+
+    log.section_header("Creating dotplot")
+    log.explanation("K-mers common between sequences are now used to build the dotplot "
+                    "image.")
+    rf = float(res)
+    top_left_gap = round(INITIAL_TOP_LEFT_GAP * rf)
+    border_gap = max(2, round(BORDER_GAP * rf))
+    between_gap = max(2, round(_between_seq_gap(BETWEEN_SEQ_GAP, TOTAL_BETWEEN_SEQ_GAP,
+                                               len(seqs)) * rf))
+    text_gap = max(1, round(TEXT_GAP * rf))
+    max_font_size = max(1, round(MAX_FONT_SIZE * rf))
+
+    font_path = _find_font()
+    start_positions, end_positions, _ = get_positions(
+        seqs, res, kmer, top_left_gap, border_gap, between_gap)
+    text_height = _reduce_scale(seqs, start_positions, end_positions, font_path,
+                                max_font_size)
+    top_left_gap = int(2 * text_height) + border_gap
+    start_positions, end_positions, bp_per_pixel = get_positions(
+        seqs, res, kmer, top_left_gap, border_gap, between_gap)
+
+    img = Image.new("RGB", (res, res), BACKGROUND)
+    draw = ImageDraw.Draw(img)
+    _draw_sequence_boxes(draw, seqs, start_positions, end_positions, fill=True)
+    _draw_labels(img, seqs, start_positions, end_positions, text_gap, font_path,
+                 text_height)
+
+    arr = np.array(img)
+    count = 0
+    for name_a, seq_a in seqs:
+        for name_b, seq_b in seqs:
+            fwd_i, fwd_j, rev_i, rev_j = kmer_match_positions(seq_a, seq_b, kmer)
+            a0, b0 = start_positions[name_a], start_positions[name_b]
+            # reverse dots first so forward dots win overlaps, like the
+            # reference's draw order (dotplot.rs:394-423)
+            for ii, jj, colour in ((rev_i, rev_j, REVERSE_DOT),
+                                   (fwd_i, fwd_j, FORWARD_DOT)):
+                if not len(ii):
+                    continue
+                px = np.round(ii / bp_per_pixel).astype(np.int64) + a0
+                py = np.round(jj / bp_per_pixel).astype(np.int64) + b0
+                ok = (px >= 0) & (px < res) & (py >= 0) & (py < res)
+                arr[py[ok], px[ok]] = colour
+            count += 1
+    img = Image.fromarray(arr)
+    draw = ImageDraw.Draw(img)
+    _draw_sequence_boxes(draw, seqs, start_positions, end_positions, fill=False)
+    img.save(png_filename)
+    log.message(f"{count} pairwise dotplot{'' if count == 1 else 's'} drawn to image")
+    log.message()
+
+
+def _find_font():
+    try:
+        import matplotlib
+        path = Path(matplotlib.get_data_path()) / "fonts" / "ttf" / "DejaVuSans.ttf"
+        if path.is_file():
+            return str(path)
+    except Exception:
+        pass
+    return None
+
+
+def _text_width(text: str, font_path, size: float) -> float:
+    from PIL import ImageFont
+    if font_path is None or size < 1:
+        return len(text) * size * 0.6
+    font = ImageFont.truetype(font_path, max(1, int(size)))
+    return font.getlength(text)
+
+
+def _reduce_scale(seqs, start_positions, end_positions, font_path,
+                  max_font_size: int) -> float:
+    """Shrink the font until every label fits its panel width
+    (reference dotplot.rs:308-328)."""
+    text_height = float(max_font_size)
+    for (filename, seqname), _ in seqs:
+        name = (filename, seqname)
+        available = float(end_positions[name] - start_positions[name])
+        width = max(_text_width(filename, font_path, text_height),
+                    _text_width(seqname, font_path, text_height))
+        if width > available and width > 0:
+            text_height *= available / width
+    return text_height
+
+
+def _draw_sequence_boxes(draw, seqs, start_positions, end_positions, fill: bool) -> None:
+    for name_a, _ in seqs:
+        sa, ea = start_positions[name_a] - 1, end_positions[name_a] + 1
+        for name_b, _ in seqs:
+            sb, eb = start_positions[name_b] - 1, end_positions[name_b] + 1
+            if fill:
+                colour = SELF_VS_SELF if name_a == name_b else SELF_VS_OTHER
+                draw.rectangle([sa, sb, ea, eb], fill=colour, outline=OUTLINE)
+            else:
+                draw.rectangle([sa, sb, ea, eb], outline=OUTLINE)
+
+
+def _draw_labels(img, seqs, start_positions, end_positions, text_gap: int, font_path,
+                 text_height: float) -> None:
+    from PIL import Image, ImageDraw, ImageFont
+    if font_path is None or text_height < 1:
+        return
+    font = ImageFont.truetype(font_path, max(1, int(text_height)))
+    draw = ImageDraw.Draw(img)
+    min_pos = min(start_positions.values())
+    h = int(text_height)
+    for (filename, seqname), _ in seqs:
+        name = (filename, seqname)
+        start, end = start_positions[name], end_positions[name]
+        pos_1 = min_pos - text_gap - h
+        pos_2 = pos_1 - h
+        draw.text((start, pos_1), seqname, fill=TEXT_COLOUR, font=font)
+        draw.text((start, pos_2), filename, fill=TEXT_COLOUR, font=font)
+        # vertical labels on the left side, rotated 90° counterclockwise
+        for text, x in ((seqname, pos_1), (filename, pos_2)):
+            w = int(_text_width(text, font_path, text_height)) + 1
+            tmp = Image.new("RGB", (w, h + 2), BACKGROUND)
+            ImageDraw.Draw(tmp).text((0, 0), text, fill=TEXT_COLOUR, font=font)
+            rotated = tmp.rotate(90, expand=True)
+            mask = Image.eval(rotated.convert("L"), lambda v: 255 if v < 250 else 0)
+            img.paste(rotated, (x, end - rotated.height), mask)

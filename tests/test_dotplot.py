@@ -1,0 +1,57 @@
+"""Dotplot tests: k-mer match positions (reference dotplot.rs:465-505) and
+end-to-end PNG rendering."""
+
+import numpy as np
+
+from autocycler_tpu.commands.dotplot import (create_dotplot, dotplot,
+                                             kmer_match_positions,
+                                             load_dotplot_sequences)
+
+
+def b(s):
+    return np.frombuffer(s.encode(), dtype=np.uint8)
+
+
+def test_kmer_match_positions_self():
+    seq = b("ACGACTGACATCAGCACTGA")
+    fwd_i, fwd_j, rev_i, rev_j = kmer_match_positions(seq, seq, 4)
+    # every position matches itself on the forward strand
+    diag = {(i, j) for i, j in zip(fwd_i, fwd_j) if i == j}
+    assert len(diag) == len(seq) - 4 + 1
+    # ACTG appears at positions 3 and 15 -> cross matches
+    pairs = set(zip(fwd_i.tolist(), fwd_j.tolist()))
+    assert (3, 15) in pairs and (15, 3) in pairs
+    # reverse matches are symmetric under the anti-diagonal mapping
+    rpairs = set(zip(rev_i.tolist(), rev_j.tolist()))
+    assert len(rpairs) > 0
+    n = len(seq) - 4 + 1
+    assert all(0 <= i < n and 0 <= j < n for i, j in rpairs)
+
+
+def test_kmer_match_reverse_complement():
+    seq_a = b("ACGTACGTACGTAAAACCCC")
+    seq_b = np.frombuffer(
+        bytes(reversed(b"ACGTACGTACGTAAAACCCC".translate(
+            bytes.maketrans(b"ACGT", b"TGCA")))), dtype=np.uint8)
+    fwd_i, fwd_j, rev_i, rev_j = kmer_match_positions(seq_a, seq_b, 10)
+    # B is the reverse complement of A: all matches are reverse matches
+    assert len(rev_i) >= len(seq_a) - 10 + 1
+    # and the reverse matches form the main anti-diagonal
+    assert any(i == j for i, j in zip(rev_i, rev_j))
+
+
+def test_dotplot_png(tmp_path):
+    fasta = tmp_path / "seqs.fasta"
+    import random
+    rng = random.Random(3)
+    s1 = "".join(rng.choice("ACGT") for _ in range(400))
+    fasta.write_text(f">s1\n{s1}\n>s2\n{s1[200:] + s1[:200]}\n")
+    out = tmp_path / "plot.png"
+    dotplot(fasta, out, res=500, kmer=10)
+    assert out.is_file()
+    from PIL import Image
+    img = Image.open(out)
+    assert img.size == (500, 500)
+    arr = np.array(img)
+    # forward (mediumblue) and reverse-complement (firebrick) dots both exist
+    assert ((arr == np.array([0, 0, 205])).all(axis=2)).sum() > 100

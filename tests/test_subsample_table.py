@@ -1,0 +1,85 @@
+"""Subsample and table tests (reference subsample.rs / table.rs test modules)."""
+
+import pytest
+
+from autocycler_tpu.commands.subsample import (parse_genome_size, subsample,
+                                               subsample_indices)
+from autocycler_tpu.commands.table import parse_fields, table_row
+from autocycler_tpu.utils import AutocyclerError
+
+
+def test_parse_genome_size():
+    assert parse_genome_size("100") == 100
+    assert parse_genome_size("5000") == 5000
+    assert parse_genome_size("5000.1") == 5000
+    assert parse_genome_size("5000.9") == 5001
+    assert parse_genome_size(" 435 ") == 435
+    assert parse_genome_size("1234567890") == 1234567890
+    assert parse_genome_size("12.0k") == 12000
+    assert parse_genome_size("47K") == 47000
+    assert parse_genome_size("2m") == 2000000
+    assert parse_genome_size("13.1M") == 13100000
+    assert parse_genome_size("3g") == 3000000000
+    assert parse_genome_size("1.23456G") == 1234560000
+    for bad in ("abcd", "12q", "m123", "15kg"):
+        with pytest.raises(AutocyclerError):
+            parse_genome_size(bad)
+
+
+def test_subsample_indices():
+    read_order = [4, 2, 3, 1, 0, 5]
+    assert subsample_indices(6, 2, read_order, 0) == {4, 2}
+    assert subsample_indices(6, 2, read_order, 1) == {2, 3}
+    assert subsample_indices(6, 2, read_order, 2) == {3, 1}
+    assert subsample_indices(6, 2, read_order, 3) == {1, 0}
+    assert subsample_indices(6, 2, read_order, 4) == {0, 5}
+    assert subsample_indices(6, 2, read_order, 5) == {5, 4}
+    assert subsample_indices(3, 5, read_order, 0) == {4, 2, 3, 1, 0}
+    assert subsample_indices(3, 5, read_order, 1) == {3, 1, 0, 5, 4}
+    assert subsample_indices(3, 5, read_order, 2) == {0, 5, 4, 2, 3}
+    assert subsample_indices(2, 5, read_order, 0) == {4, 2, 3, 1, 0}
+    assert subsample_indices(2, 5, read_order, 1) == {1, 0, 5, 4, 2}
+
+
+def test_subsample_end_to_end(tmp_path):
+    import random
+    rng = random.Random(1)
+    fastq = tmp_path / "reads.fastq"
+    with open(fastq, "w") as f:
+        for i in range(200):
+            seq = "".join(rng.choice("ACGT") for _ in range(500))
+            f.write(f"@read_{i}\n{seq}\n+\n{'I' * len(seq)}\n")
+    out_dir = tmp_path / "subsets"
+    subsample(fastq, out_dir, "1k", count=4, min_read_depth=25.0, seed=0)
+    files = sorted(out_dir.glob("sample_*.fastq"))
+    assert len(files) == 4
+    assert (out_dir / "subsample.yaml").is_file()
+    for f in files:
+        lines = f.read_text().splitlines()
+        assert len(lines) % 4 == 0 and len(lines) > 0
+
+
+def test_parse_fields():
+    assert parse_fields("input_read_count,pass_cluster_count") == \
+        ["input_read_count", "pass_cluster_count"]
+    with pytest.raises(AutocyclerError):
+        parse_fields("not_a_field")
+
+
+def test_table_row(tmp_path):
+    (tmp_path / "clustering.yaml").write_text(
+        "pass_cluster_count: 2\nfail_cluster_count: 1\n"
+        "overall_clustering_score: 0.87654\n")
+    sub = tmp_path / "qc_pass" / "cluster_001"
+    sub.mkdir(parents=True)
+    (sub / "2_trimmed.yaml").write_text(
+        "trimmed_cluster_size: 4\ntrimmed_cluster_median: 1000\n")
+    fail = tmp_path / "qc_fail" / "cluster_002"
+    fail.mkdir(parents=True)
+    (fail / "2_trimmed.yaml").write_text(
+        "trimmed_cluster_size: 9\ntrimmed_cluster_median: 9\n")
+    row = table_row(tmp_path, "sample1",
+                    ["pass_cluster_count", "overall_clustering_score",
+                     "trimmed_cluster_size"], 3)
+    # qc_fail yaml is excluded from the multi-copy aggregation
+    assert row == "sample1\t2\t0.877\t[4]"
