@@ -1,0 +1,127 @@
+"""Helper-command tests over its pure functions (reference helper.rs:993-1186)."""
+
+import gzip
+
+import pytest
+
+from autocycler_tpu.commands.helper import (copy_fasta, depth_filter, depth_from_header,
+                                            gfa_to_fasta, replace_underscores_with_spaces,
+                                            rotate_plassembler_contigs, trim_canu_contig)
+from autocycler_tpu.utils import AutocyclerError, load_fasta
+
+
+def test_depth_from_header():
+    assert depth_from_header(">contig depth=10.5") == 10.5
+    assert depth_from_header(">contig circular=true depth=5.0") == 5.0
+    assert depth_from_header(">contig") is None
+    assert depth_from_header(">a_len-12_circular-no_depth-37-37-37_mult-2.00") == 37.0
+    assert depth_from_header(">b_len-9_circular-yes_depth-25-24-23_mult-1.00") == 25.0
+    assert depth_from_header(">a len-12 circular-no depth-37-37-37 mult-2.00") == 37.0
+    assert depth_from_header(">ctg15 length=123 coverage=49.70 circular=yes") == 49.7
+
+
+def test_depth_filter(tmp_path):
+    prefix = tmp_path / "test"
+    fasta = tmp_path / "test.fasta"
+    fasta.write_text(">a depth=20\nACGT\n>b depth=120\nCGA\n"
+                     ">c depth=200\nACAGACTACGACTACGACGACGATCAGCGACATCGACGT\n"
+                     ">d depth=100\nCGATCGACTACC\n")
+    depth_filter(prefix, None, None)
+    assert len(load_fasta(fasta)) == 4
+    depth_filter(prefix, None, 0.09)
+    assert len(load_fasta(fasta)) == 4
+    depth_filter(prefix, None, 0.11)
+    assert len(load_fasta(fasta)) == 3
+    depth_filter(prefix, 99.0, None)
+    assert len(load_fasta(fasta)) == 3
+    depth_filter(prefix, 101.0, None)
+    assert len(load_fasta(fasta)) == 2
+    depth_filter(prefix, None, 0.61)
+    assert len(load_fasta(fasta)) == 1
+    depth_filter(prefix, 201.0, None)
+    with pytest.raises(AutocyclerError):
+        load_fasta(fasta)  # file was removed (all contigs failed)
+
+
+def test_trim_canu_contig():
+    seq = "AGTAGCCAAACTATTTAATGCTAGAGATGCTGCATATCAAAAAATAATCAAACAATTATC"
+    header = (">tig00000001 len=60 reads=50 class=contig suggestRepeat=no "
+              "suggestBubble=no suggestCircular=no trim=0-60")
+    assert trim_canu_contig(header, seq) == (header, seq)
+
+    header = (">tig00000001 len=60 reads=50 class=contig suggestRepeat=no "
+              "suggestBubble=no suggestCircular=yes trim=0-50")
+    new_header, new_seq = trim_canu_contig(header, seq)
+    assert new_header == (">tig00000001 len=50 reads=50 class=contig suggestRepeat=no "
+                          "suggestBubble=no suggestCircular=yes trim=0-50")
+    assert new_seq == "AGTAGCCAAACTATTTAATGCTAGAGATGCTGCATATCAAAAAATAATCA"
+
+    header = (">tig00000001 len=60 reads=50 class=contig suggestRepeat=no "
+              "suggestBubble=no suggestCircular=yes trim=10-60")
+    new_header, new_seq = trim_canu_contig(header, seq)
+    assert new_header == (">tig00000001 len=50 reads=50 class=contig suggestRepeat=no "
+                          "suggestBubble=no suggestCircular=yes trim=0-50")
+    assert new_seq == "CTATTTAATGCTAGAGATGCTGCATATCAAAAAATAATCAAACAATTATC"
+
+    header = (">tig00000001 len=60 reads=50 class=contig suggestRepeat=no "
+              "suggestBubble=no suggestCircular=yes trim=10-50")
+    new_header, new_seq = trim_canu_contig(header, seq)
+    assert new_header == (">tig00000001 len=40 reads=50 class=contig suggestRepeat=no "
+                          "suggestBubble=no suggestCircular=yes trim=0-40")
+    assert new_seq == "CTATTTAATGCTAGAGATGCTGCATATCAAAAAATAATCA"
+
+
+def test_rotate_plassembler_contigs(tmp_path):
+    in_fasta = tmp_path / "input.fasta"
+    out_fasta = tmp_path / "output.fasta"
+    in_fasta.write_text(">a\nACGATCGCT\n>b\nCGATCGACTAC\n")
+    rotate_plassembler_contigs(in_fasta, out_fasta)
+    assert [s for _, _, s in load_fasta(in_fasta)] == \
+        [s for _, _, s in load_fasta(out_fasta)]
+
+    in_fasta.write_text(">a circular=True\nACGATCGCT\n>b circular=True\nCGATCGACTAC\n")
+    rotate_plassembler_contigs(in_fasta, out_fasta)
+    assert [s for _, _, s in load_fasta(in_fasta)] != \
+        [s for _, _, s in load_fasta(out_fasta)]
+    # rotations preserve content
+    for (_, _, a), (_, _, b) in zip(load_fasta(in_fasta), load_fasta(out_fasta)):
+        assert sorted(a) == sorted(b) and b in a + a
+
+
+def test_replace_underscores_with_spaces(tmp_path):
+    f = tmp_path / "test.fasta"
+    f.write_text(">a_len-12_circular-no_depth-37-37-37_mult-2.00\nACGATCGCT\n"
+                 ">b_len-9_circular-yes_depth-25-24-23_mult-1.00\nCGATCGACTAC\n")
+    replace_underscores_with_spaces(f)
+    assert f.read_text() == (">a len-12 circular-no depth-37-37-37 mult-2.00\nACGATCGCT\n"
+                             ">b len-9 circular-yes depth-25-24-23 mult-1.00\nCGATCGACTAC\n")
+
+
+def test_copy_fasta(tmp_path):
+    in_fasta = tmp_path / "in.fasta"
+    out_fasta = tmp_path / "out.fasta"
+    in_fasta.write_text("")
+    copy_fasta(in_fasta, out_fasta)
+    assert not out_fasta.exists()
+
+    in_fasta.write_text(">a\nACGA\nTCGC\nT\n>b\nCGAT\nCGAC\nTAC\n")
+    copy_fasta(in_fasta, out_fasta)
+    assert out_fasta.read_text() == ">a\nACGATCGCT\n>b\nCGATCGACTAC\n"
+
+    gz = tmp_path / "in2.fasta.gz"
+    with gzip.open(gz, "wt") as f:
+        f.write(">a\nACGATCGCT\n>b\nCGATCGACTAC\n")
+    copy_fasta(gz, out_fasta)
+    assert out_fasta.read_text() == ">a\nACGATCGCT\n>b\nCGATCGACTAC\n"
+
+
+def test_gfa_to_fasta(tmp_path):
+    gfa = tmp_path / "in.gfa"
+    fasta = tmp_path / "out.fasta"
+    gfa.write_text("S\tctg000001c\tATCAGCTGA\n"
+                   "S\tctg000002l\tGCTCGAGCA\tdp:f:12.3\n"
+                   "S\tctg000003c\tGACTACGAT\trd:i:51\n")
+    gfa_to_fasta(gfa, fasta)
+    assert fasta.read_text() == (">ctg000001c circular=true\nATCAGCTGA\n"
+                                 ">ctg000002l depth=12.3\nGCTCGAGCA\n"
+                                 ">ctg000003c circular=true depth=51\nGACTACGAT\n")
