@@ -1,0 +1,138 @@
+"""Brute-force k-mer match grid as a Pallas TPU kernel.
+
+This is the "vmapped Pallas k-mer match grid" north star (BASELINE.json /
+SURVEY.md §2.2 dotplot row): compare every k-mer of sequence A against every
+k-mer of sequence B — an nA × nB cell grid — and reduce match counts into
+block-resolution tiles. The exact pixel-level dotplot uses the sort-join in
+commands/dotplot.py; this kernel provides (a) a downsampled match-density
+grid and (b) the Gcells/s throughput benchmark.
+
+Formulation: ACGT k-mers are packed 16 bases per int32 word (2 bits/base),
+so a k-mer equality test is W = ceil(k/16) integer compares. Each Pallas
+program loads a [W, TA] tile of A words and a [W, TB] tile of B words into
+VMEM, forms the [TA, TB] equality matrix on the VPU and writes one match
+count — TA*TB cells per program.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import numpy as np
+
+TILE_A = 512
+TILE_B = 512
+
+
+def pack_2bit_words(codes: np.ndarray, k: int) -> np.ndarray:
+    """ACGT codes (1..4 from ops.encode) -> [W, n] int32 k-mer words,
+    16 bases per word, zero-padded tail. n = len(codes) - k + 1."""
+    n = len(codes) - k + 1
+    if n <= 0:
+        return np.zeros(((k + 15) // 16, 0), dtype=np.int32)
+    W = (k + 15) // 16
+    base2 = (codes.astype(np.int32) - 1).clip(0, 3)
+    words = np.zeros((W, n), dtype=np.int32)
+    for w in range(W):
+        acc = np.zeros(n, dtype=np.int32)
+        for t in range(16):
+            idx = w * 16 + t
+            acc <<= 2
+            if idx < k:
+                acc |= base2[idx:idx + n]
+        words[w] = acc
+    return words
+
+
+def _pad_to(words: np.ndarray, tile: int, fill: int) -> np.ndarray:
+    W, n = words.shape
+    padded = -((-n) // tile) * tile
+    if padded == n:
+        return words
+    out = np.full((W, max(padded, tile)), fill, dtype=np.int32)
+    out[:, :n] = words
+    return out
+
+
+def _grid_kernel(a_ref, b_ref, out_ref):
+    eq = a_ref[0, :].reshape(-1, 1) == b_ref[0, :].reshape(1, -1)
+    for w in range(1, a_ref.shape[0]):
+        eq &= a_ref[w, :].reshape(-1, 1) == b_ref[w, :].reshape(1, -1)
+    out_ref[0, 0] = eq.sum(dtype=np.int32)
+
+
+@functools.partial(lambda f: f)
+def match_grid(a_words: np.ndarray, b_words: np.ndarray,
+               tile_a: int = TILE_A, tile_b: int = TILE_B):
+    """[W, nA] × [W, nB] k-mer words -> [ceil(nA/tile), ceil(nB/tile)] match
+    counts. Runs the Pallas kernel on TPU, falling back to interpret mode on
+    CPU backends."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    W, n_a = a_words.shape
+    _, n_b = b_words.shape
+    # pad A with -1 and B with -2 so padding never matches anything
+    a_pad = _pad_to(a_words, tile_a, -1)
+    b_pad = _pad_to(b_words, tile_b, -2)
+    ga = a_pad.shape[1] // tile_a
+    gb = b_pad.shape[1] // tile_b
+
+    interpret = jax.default_backend() != "tpu"
+    counts = pl.pallas_call(
+        _grid_kernel,
+        grid=(ga, gb),
+        in_specs=[
+            pl.BlockSpec((W, tile_a), lambda i, j: (0, i)),
+            pl.BlockSpec((W, tile_b), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((1, 1), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((ga, gb), jnp.int32),
+        interpret=interpret,
+    )(jnp.asarray(a_pad), jnp.asarray(b_pad))
+    return counts
+
+
+def match_grid_reference(a_words: np.ndarray, b_words: np.ndarray,
+                         tile_a: int = TILE_A, tile_b: int = TILE_B) -> np.ndarray:
+    """Plain-numpy oracle for the kernel (used by tests)."""
+    W, n_a = a_words.shape
+    _, n_b = b_words.shape
+    ga = -(-max(n_a, 1) // tile_a)
+    gb = -(-max(n_b, 1) // tile_b)
+    out = np.zeros((ga, gb), dtype=np.int32)
+    for i in range(ga):
+        for j in range(gb):
+            a = a_words[:, i * tile_a:(i + 1) * tile_a]
+            b = b_words[:, j * tile_b:(j + 1) * tile_b]
+            eq = np.ones((a.shape[1], b.shape[1]), dtype=bool)
+            for w in range(W):
+                eq &= a[w][:, None] == b[w][None, :]
+            out[i, j] = eq.sum()
+    return out
+
+
+def benchmark_gcells(n_a: int = 65536, n_b: int = 65536, k: int = 32,
+                     repeats: int = 3) -> Tuple[float, float]:
+    """Time the match grid on random sequences; returns (seconds, Gcells/s)."""
+    import time
+
+    import jax
+
+    rng = np.random.default_rng(0)
+    codes_a = rng.integers(1, 5, size=n_a + k - 1).astype(np.uint8)
+    codes_b = rng.integers(1, 5, size=n_b + k - 1).astype(np.uint8)
+    a_words = pack_2bit_words(codes_a, k)
+    b_words = pack_2bit_words(codes_b, k)
+    out = match_grid(a_words, b_words)
+    jax.block_until_ready(out)  # compile + warm up
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.block_until_ready(match_grid(a_words, b_words))
+        best = min(best, time.perf_counter() - t0)
+    cells = float(n_a) * float(n_b)
+    return best, cells / best / 1e9

@@ -1,0 +1,3 @@
+from .mesh import make_mesh, mesh_axis_sizes
+from .batch import (encode_batch, multi_isolate_distance_step,
+                    sharded_multi_isolate_step)

@@ -1,0 +1,150 @@
+"""Batched multi-isolate pipeline step over a device mesh.
+
+The flagship device computation: for a batch of isolates, each with several
+input assemblies, compute every assembly's k-mer presence sketch and the
+per-isolate all-vs-all contig distance matrix — the device core of
+compress + cluster (reference kmer_graph.rs hot loop + cluster.rs:132-157)
+batched over genomes, i.e. the BASELINE.json "96 genomes × 12 assemblies on
+v5e-8" configuration.
+
+Sharding layout (see parallel.mesh):
+- batch dim  -> 'data'  (independent isolates; no collectives)
+- length dim -> 'seq'   (sequence parallelism: k-mer windows crossing the
+                         shard boundary are completed by a ring halo
+                         exchange via lax.ppermute, then bucket sketches
+                         are combined with one psum over 'seq')
+
+Everything is static-shaped (padded batches) and jit-compiles once; the
+matmul runs on the MXU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional
+
+import numpy as np
+
+from ..ops.encode import encode_bytes
+
+DEFAULT_K = 51
+DEFAULT_BUCKETS = 4096
+
+# multipliers for the word-mixing hash (arbitrary odd constants)
+_MIX = (0x9E3779B1, 0x85EBCA77, 0xC2B2AE3D, 0x27D4EB2F, 0x165667B1, 0x9E3779B9)
+
+
+def encode_batch(seq_strings: List[List[str]], length: Optional[int] = None) -> np.ndarray:
+    """[isolate][assembly] sequence strings -> [B, S, L] uint8 code batch,
+    zero-padded (code 0 = '.', which never matches a real k-mer hash
+    bucket-for-bucket since dot windows are masked out)."""
+    B = len(seq_strings)
+    S = max(len(iso) for iso in seq_strings)
+    if length is None:
+        length = max(len(s) for iso in seq_strings for s in iso)
+    out = np.zeros((B, S, length), dtype=np.uint8)
+    for b, iso in enumerate(seq_strings):
+        for s, seq in enumerate(iso):
+            raw = np.frombuffer(seq[:length].encode(), dtype=np.uint8)
+            out[b, s, :len(raw)] = encode_bytes(raw)
+    return out
+
+
+def _kmer_bucket_sketch(codes, k: int, buckets: int):
+    """[..., L] codes -> [..., buckets] float32 presence sketch.
+
+    Every window of k codes is hashed by mixing ceil(k/10) packed 3-bit
+    words (the same packing as ops.kmers); windows containing padding
+    (code 0) are masked out. Pure jnp, shard-local.
+    """
+    import jax.numpy as jnp
+
+    L = codes.shape[-1]
+    n = L - k + 1
+    W = (k + 9) // 10
+    valid = jnp.ones(codes.shape[:-1] + (n,), dtype=bool)
+    h = jnp.zeros(codes.shape[:-1] + (n,), dtype=jnp.uint32)
+    for w in range(W):
+        word = jnp.zeros(codes.shape[:-1] + (n,), dtype=jnp.uint32)
+        for t in range(10):
+            idx = w * 10 + t
+            if idx >= k:
+                break
+            sym = codes[..., idx:idx + n].astype(jnp.uint32)
+            valid &= sym > 0
+            word = (word << 3) | sym
+        h = h ^ (word * np.uint32(_MIX[w % len(_MIX)]))
+    bucket = (h % np.uint32(buckets)).astype(jnp.int32)
+    lead = codes.shape[:-1]
+    rows = int(np.prod(lead)) if lead else 1
+    flat_bucket = bucket.reshape(rows, n)
+    flat_bucket = flat_bucket + jnp.arange(rows, dtype=jnp.int32)[:, None] * buckets
+    ones = jnp.where(valid, 1.0, 0.0).astype(jnp.float32).reshape(rows, n)
+    presence = jnp.zeros(rows * buckets, dtype=jnp.float32)
+    presence = presence.at[flat_bucket.ravel()].max(ones.ravel())
+    return presence.reshape(lead + (buckets,))
+
+
+def multi_isolate_distance_step(codes, k: int = DEFAULT_K,
+                                buckets: int = DEFAULT_BUCKETS):
+    """Single-device forward step: [B, S, L] codes -> [B, S, S] asymmetric
+    contig distance sketch (1 - |A∩B| / |A| over hashed k-mer buckets —
+    the device formulation of cluster.rs:132-157).
+
+    K-mers are taken circularly (the sequence wraps around), making the
+    sketch rotation-invariant — bacterial replicons are circular — and
+    bit-matching the seq-sharded version, whose ring halo wraps the same
+    way."""
+    import jax.numpy as jnp
+
+    codes = jnp.concatenate([jnp.asarray(codes), jnp.asarray(codes)[..., :k - 1]],
+                            axis=-1)
+    presence = _kmer_bucket_sketch(codes, k, buckets)          # [B, S, K]
+    inter = jnp.einsum("bsk,btk->bst", presence, presence)     # MXU matmul
+    own = jnp.maximum(jnp.sum(presence, axis=-1), 1.0)         # |A| per contig
+    return 1.0 - inter / own[..., :, None]
+
+
+def _sharded_step_body(codes, k: int, buckets: int, seq_axis: str):
+    """shard_map body: halo-exchange the first k-1 codes from the next seq
+    shard, sketch locally, psum sketches over the seq axis, then compute the
+    distance matrix (replicated over seq shards)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n_seq = lax.axis_size(seq_axis)
+    if n_seq > 1:
+        # ring halo: shard i receives the first k-1 codes of shard i+1 so
+        # windows spanning the shard boundary are complete. The last shard
+        # wraps around to shard 0, adding end-to-start junction windows —
+        # semantically right for circular replicons and harmless for the
+        # sketch otherwise.
+        halo = codes[..., :k - 1]
+        perm = [(i, (i - 1) % n_seq) for i in range(n_seq)]
+        halo = lax.ppermute(halo, seq_axis, perm)
+        codes = jnp.concatenate([codes, halo], axis=-1)
+    presence = _kmer_bucket_sketch(codes, k, buckets)
+    presence = lax.pmax(presence, seq_axis)
+    inter = jnp.einsum("bsk,btk->bst", presence, presence)
+    own = jnp.maximum(jnp.sum(presence, axis=-1), 1.0)
+    return 1.0 - inter / own[..., :, None]
+
+
+def sharded_multi_isolate_step(mesh, codes: np.ndarray, k: int = DEFAULT_K,
+                               buckets: int = DEFAULT_BUCKETS):
+    """Jit-compiled mesh-sharded step: batch over 'data', length over 'seq'.
+
+    codes: [B, S, L] with B divisible by the data-axis size and L divisible
+    by the seq-axis size. Returns [B, S, S] distances (sharded over 'data').
+    """
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    body = functools.partial(_sharded_step_body, k=k, buckets=buckets,
+                             seq_axis="seq")
+    step = shard_map(body, mesh=mesh,
+                     in_specs=P("data", None, "seq"),
+                     out_specs=P("data", None, None))
+    return jax.jit(step)(codes)

@@ -1,0 +1,44 @@
+"""Device-mesh construction for batched multi-isolate runs.
+
+The reference has no distributed backend at all (SURVEY.md §2.4 — rayon
+threads plus GNU parallel processes); scaling across TPU chips is a
+greenfield design dimension. The layout here:
+
+- axis ``data``: independent isolates (pure data parallelism over genomes —
+  no cross-isolate communication is algorithmically required),
+- axis ``seq``: sequence length within an isolate (sequence parallelism for
+  the k-mer window kernels; k-mer windows crossing shard boundaries are
+  completed by a ring halo exchange over ICI, see parallel.batch).
+
+Collectives ride the mesh via XLA (psum over ``seq``, nothing over ``data``),
+so multi-host DCN layouts work unchanged by extending the ``data`` axis.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def mesh_axis_sizes(n_devices: int, seq_parallel: Optional[int] = None) -> Tuple[int, int]:
+    """Factorise a device count into (data, seq) axis sizes. Sequence
+    parallelism defaults to 2 when the device count is even (halo exchange
+    is cheap on ICI), otherwise 1."""
+    if seq_parallel is None:
+        seq_parallel = 2 if n_devices % 2 == 0 and n_devices > 1 else 1
+    if n_devices % seq_parallel != 0:
+        raise ValueError(f"{n_devices} devices not divisible by seq={seq_parallel}")
+    return n_devices // seq_parallel, seq_parallel
+
+
+def make_mesh(n_devices: Optional[int] = None, seq_parallel: Optional[int] = None):
+    """Build a 2-D ('data', 'seq') jax.sharding.Mesh."""
+    import jax
+
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    data, seq = mesh_axis_sizes(len(devices), seq_parallel)
+    device_array = np.array(devices).reshape(data, seq)
+    return jax.sharding.Mesh(device_array, ("data", "seq"))

@@ -1,13 +1,19 @@
 """Test configuration: run JAX on a virtual 8-device CPU mesh.
 
 Real TPU hardware is single-chip in CI, so sharding/collective tests run on
-XLA's host-platform device emulation instead (SURVEY.md §2.4). This must run
-before jax is imported anywhere.
+XLA's host-platform device emulation instead (SURVEY.md §2.4). The XLA flag
+must be set before jax initialises; the installed TPU plugin also overrides
+JAX_PLATFORMS from the environment, so the platform is forced via
+jax.config as well.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
