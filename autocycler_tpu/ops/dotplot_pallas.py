@@ -56,10 +56,14 @@ def _pad_to(words: np.ndarray, tile: int, fill: int) -> np.ndarray:
 
 
 def _grid_kernel(a_ref, b_ref, out_ref):
+    import jax.numpy as jnp
+
     eq = a_ref[0, :].reshape(-1, 1) == b_ref[0, :].reshape(1, -1)
     for w in range(1, a_ref.shape[0]):
         eq &= a_ref[w, :].reshape(-1, 1) == b_ref[w, :].reshape(1, -1)
-    out_ref[0, 0] = eq.sum(dtype=np.int32)
+    # each program owns one (8, 128) output tile (minimum aligned store);
+    # the count is broadcast across it and strided back out on the host
+    out_ref[:, :] = jnp.broadcast_to(eq.sum(dtype=jnp.int32), out_ref.shape)
 
 
 @functools.partial(lambda f: f)
@@ -82,18 +86,18 @@ def match_grid(a_words: np.ndarray, b_words: np.ndarray,
     gb = b_pad.shape[1] // tile_b
 
     interpret = jax.default_backend() != "tpu"
-    counts = pl.pallas_call(
+    tiles = pl.pallas_call(
         _grid_kernel,
         grid=(ga, gb),
         in_specs=[
             pl.BlockSpec((W, tile_a), lambda i, j: (0, i)),
             pl.BlockSpec((W, tile_b), lambda i, j: (0, j)),
         ],
-        out_specs=pl.BlockSpec((1, 1), lambda i, j: (i, j)),
-        out_shape=jax.ShapeDtypeStruct((ga, gb), jnp.int32),
+        out_specs=pl.BlockSpec((8, 128), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((ga * 8, gb * 128), jnp.int32),
         interpret=interpret,
     )(jnp.asarray(a_pad), jnp.asarray(b_pad))
-    return counts
+    return tiles[::8, ::128]
 
 
 def match_grid_reference(a_words: np.ndarray, b_words: np.ndarray,
