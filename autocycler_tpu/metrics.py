@@ -43,9 +43,11 @@ def _to_yaml(obj, indent: int = 0) -> List[str]:
                     lines.append(f"{pad}{f.name}:")
                     for item in v:
                         if dataclasses.is_dataclass(item):
+                            # "- " occupies one indent level, so the item's
+                            # remaining keys keep the same column as its first
                             sub = _to_yaml(item, indent + 1)
                             lines.append(f"{pad}- {sub[0].strip()}")
-                            lines.extend("  " + s for s in sub[1:])
+                            lines.extend(sub[1:])
                         else:
                             lines.append(f"{pad}- {_yaml_scalar(item)}")
             else:

@@ -61,8 +61,11 @@ def _grid_kernel(a_ref, b_ref, out_ref):
     eq = a_ref[0, :].reshape(-1, 1) == b_ref[0, :].reshape(1, -1)
     for w in range(1, a_ref.shape[0]):
         eq &= a_ref[w, :].reshape(-1, 1) == b_ref[w, :].reshape(1, -1)
-    # each program owns one (8, 128) output tile (minimum aligned store);
-    # the count is broadcast across it and strided back out on the host
+    # Each program owns one (8, 128) output tile with the count broadcast
+    # across it, strided back out afterwards. Mosaic rejects smaller output
+    # blocks — (1, 1), including in SMEM space, fails its divisible-by-
+    # (8, 128) store constraint — so the 1024x output padding is the price
+    # of scalar-per-program results.
     out_ref[:, :] = jnp.broadcast_to(eq.sum(dtype=jnp.int32), out_ref.shape)
 
 
@@ -119,24 +122,35 @@ def match_grid_reference(a_words: np.ndarray, b_words: np.ndarray,
     return out
 
 
-def benchmark_gcells(n_a: int = 65536, n_b: int = 65536, k: int = 32,
-                     repeats: int = 3) -> Tuple[float, float]:
-    """Time the match grid on random sequences; returns (seconds, Gcells/s)."""
+def benchmark_gcells(n_a: int = 524288, n_b: int = 524288, k: int = 32,
+                     repeats: int = 3, tile: int = 2048,
+                     seed: int = 0) -> Tuple[float, float]:
+    """Time the match grid; returns (best seconds, Gcells/s).
+
+    Honest-measurement rules for remote-execution backends: every trial uses
+    freshly generated inputs (identical requests can be deduplicated
+    upstream) and the result is reduced to a scalar materialized on the
+    host (block_until_ready alone can return before execution finishes
+    through the tunnel)."""
     import time
 
     import jax
+    import jax.numpy as jnp
 
-    rng = np.random.default_rng(0)
-    codes_a = rng.integers(1, 5, size=n_a + k - 1).astype(np.uint8)
-    codes_b = rng.integers(1, 5, size=n_b + k - 1).astype(np.uint8)
-    a_words = pack_2bit_words(codes_a, k)
-    b_words = pack_2bit_words(codes_b, k)
-    out = match_grid(a_words, b_words)
-    jax.block_until_ready(out)  # compile + warm up
+    rng = np.random.default_rng(seed)
+
+    def fresh_words(n):
+        return pack_2bit_words(rng.integers(1, 5, size=n + k - 1).astype(np.uint8), k)
+
+    def run(a_w, b_w):
+        return np.asarray(jnp.sum(match_grid(a_w, b_w, tile_a=tile, tile_b=tile)))
+
+    run(fresh_words(n_a), fresh_words(n_b))  # compile + warm up
     best = float("inf")
     for _ in range(repeats):
+        a_w, b_w = fresh_words(n_a), fresh_words(n_b)
         t0 = time.perf_counter()
-        jax.block_until_ready(match_grid(a_words, b_words))
+        run(a_w, b_w)
         best = min(best, time.perf_counter() - t0)
     cells = float(n_a) * float(n_b)
     return best, cells / best / 1e9

@@ -10,7 +10,6 @@ onto the TPU.
 """
 
 import json
-import sys
 import time
 
 import numpy as np
@@ -26,46 +25,22 @@ def main() -> None:
     except Exception:
         pass
 
-    from autocycler_tpu.ops.dotplot_pallas import (match_grid, match_grid_reference,
+    from autocycler_tpu.ops.dotplot_pallas import (benchmark_gcells,
+                                                   match_grid_reference,
                                                    pack_2bit_words)
 
     k = 32
-    rng = np.random.default_rng(0)
+    n = 524288  # a full all-vs-all plasmid-cluster grid: 512k x 512k k-mers
+    _, tpu_rate = benchmark_gcells(n_a=n, n_b=n, k=k, repeats=5)
 
-    # --- TPU: 512k x 512k k-mers (a full all-vs-all plasmid-cluster grid) ---
-    n = 524288
-    tile = 2048
-
-    def fresh_words():
-        return pack_2bit_words(rng.integers(1, 5, size=n + k - 1).astype(np.uint8), k)
-
-    import jax.numpy as jnp
-
-    def run(a_t, b_t):
-        # materialize a scalar on the host: through the remote-execution
-        # tunnel, block_until_ready alone returns before the computation
-        # finishes, so honest timing needs a host round-trip
-        return np.asarray(jnp.sum(match_grid(a_t, b_t, tile_a=tile, tile_b=tile)))
-
-    a_words = fresh_words()
-    run(a_words, fresh_words())  # compile + warm up
-    best = float("inf")
-    for _ in range(5):
-        # fresh inputs each trial so no layer can reuse a previous result
-        a_t, b_t = fresh_words(), fresh_words()
-        t0 = time.perf_counter()
-        run(a_t, b_t)
-        best = min(best, time.perf_counter() - t0)
-    tpu_rate = float(n) * float(n) / best / 1e9  # Gcells/s
-
-    # --- host baseline: same computation, single-core numpy, smaller grid ---
+    # host baseline: same computation, single-core numpy, smaller grid
+    rng = np.random.default_rng(1)
     m = 16384
-    ah = a_words[:, :m]
-    bh = fresh_words()[:, :m]
+    ah = pack_2bit_words(rng.integers(1, 5, size=m + k - 1).astype(np.uint8), k)
+    bh = pack_2bit_words(rng.integers(1, 5, size=m + k - 1).astype(np.uint8), k)
     t0 = time.perf_counter()
-    match_grid_reference(ah, bh, tile_a=tile, tile_b=tile)
-    host_secs = time.perf_counter() - t0
-    host_rate = float(m) * float(m) / host_secs / 1e9
+    match_grid_reference(ah, bh, tile_a=2048, tile_b=2048)
+    host_rate = float(m) * float(m) / (time.perf_counter() - t0) / 1e9
 
     print(json.dumps({
         "metric": "dotplot_kmer_match_grid",

@@ -1,0 +1,54 @@
+"""Every emitted metrics YAML must parse back with a standard YAML loader
+(autocycler table and external consumers read these files)."""
+
+import yaml
+
+from autocycler_tpu.metrics import (ClusteringMetrics, CombineMetrics,
+                                    InputAssemblyDetails, InputAssemblyMetrics,
+                                    InputContigDetails, ReadSetDetails,
+                                    ResolvedClusterDetails, SubsampleMetrics,
+                                    TrimmedClusterMetrics, UntrimmedClusterMetrics)
+
+
+def roundtrip(metrics, tmp_path):
+    path = tmp_path / "m.yaml"
+    metrics.save_to_yaml(path)
+    loaded = yaml.safe_load(path.read_text())
+    assert isinstance(loaded, dict)
+    return loaded
+
+
+def test_nested_metrics_roundtrip(tmp_path):
+    m = InputAssemblyMetrics(
+        input_assemblies_count=2, input_assemblies_total_contigs=3,
+        input_assemblies_total_length=100, compressed_unitig_count=5,
+        compressed_unitig_total_length=90,
+        input_assembly_details=[
+            InputAssemblyDetails(filename="a/b.fasta", contigs=[
+                InputContigDetails(name="c1", description="", length=50),
+                InputContigDetails(name="c2", description="x: y", length=30),
+            ]),
+            InputAssemblyDetails(filename="c.fasta", contigs=[]),
+        ])
+    loaded = roundtrip(m, tmp_path)
+    assert loaded["input_assembly_details"][0]["filename"] == "a/b.fasta"
+    assert loaded["input_assembly_details"][0]["contigs"][1]["description"] == "x: y"
+    assert loaded["input_assembly_details"][1]["contigs"] == []
+
+
+def test_all_metrics_roundtrip(tmp_path):
+    cases = [
+        SubsampleMetrics(input_read_count=1, output_reads=[
+            ReadSetDetails(count=1, bases=10, n50=10)]),
+        ClusteringMetrics(pass_cluster_count=1, overall_clustering_score=0.5),
+        UntrimmedClusterMetrics.new([5, 6, 7], 0.1),
+        TrimmedClusterMetrics.new([5, 6, 7]),
+        CombineMetrics(consensus_assembly_bases=10,
+                       consensus_assembly_fully_resolved=True,
+                       consensus_assembly_clusters=[
+                           ResolvedClusterDetails(length=10, unitigs=1,
+                                                  topology="circular")]),
+    ]
+    for m in cases:
+        loaded = roundtrip(m, tmp_path)
+        assert loaded
