@@ -1,0 +1,139 @@
+"""ctypes bridge to the native host kernels (native/seqkernel.cpp).
+
+The shared library is built on demand with the system compiler (the image
+has no pybind11; the ABI is plain C). When no compiler is available the
+callers fall back to the numpy implementations transparently.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from pathlib import Path
+from typing import Optional, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = Path(__file__).resolve().parent.parent / "native"
+_LIB_PATH = _NATIVE_DIR / "libseqkernel.so"
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    src = _NATIVE_DIR / "seqkernel.cpp"
+    if not src.is_file():
+        return False
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
+             str(src), "-o", str(_LIB_PATH)],
+            check=True, capture_output=True, timeout=120)
+        return _LIB_PATH.is_file()
+    except Exception:
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded library, building it first if needed; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None:
+        return _lib
+    if _tried:
+        return None
+    _tried = True
+    if not _LIB_PATH.is_file() and not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(str(_LIB_PATH))
+        lib.sk_group_windows.restype = ctypes.c_int64
+        lib.sk_group_windows.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
+        lib.sk_pack_words.restype = None
+        lib.sk_pack_words.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_int32, ctypes.POINTER(ctypes.c_int32)]
+        lib.sk_group_kmers.restype = ctypes.c_int64
+        lib.sk_group_kmers.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
+        _lib = lib
+        return lib
+    except OSError:
+        return None
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def pack_words_native(codes: np.ndarray, starts: np.ndarray,
+                      k: int) -> Optional[np.ndarray]:
+    """codes uint8 (values 0..4) + window starts -> [W, n] int32 packed words
+    (same layout as ops.kmers), or None when the library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    codes = np.ascontiguousarray(codes, dtype=np.uint8)
+    starts = np.ascontiguousarray(starts, dtype=np.int64)
+    n = len(starts)
+    W = (k + 9) // 10
+    out = np.empty((W, n), dtype=np.int32)
+    lib.sk_pack_words(
+        codes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.c_int64(n), ctypes.c_int32(k),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    return out
+
+
+def group_kmers_native(codes: np.ndarray, starts: np.ndarray,
+                       k: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Fused pack + group (the production path): codes uint8 (0..4) and
+    window starts -> (order, gid_sorted), identical contract to the numpy
+    lexsort grouping. None when the library is unavailable or fails."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    codes = np.ascontiguousarray(codes, dtype=np.uint8)
+    starts = np.ascontiguousarray(starts, dtype=np.int64)
+    n = len(starts)
+    gid = np.empty(n, dtype=np.int64)
+    order = np.empty(n, dtype=np.int64)
+    u = lib.sk_group_kmers(
+        codes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.c_int64(n), ctypes.c_int32(k),
+        gid.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        order.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    if u < 0:
+        return None
+    return order, gid[order]
+
+
+def group_windows_native(words: np.ndarray
+                         ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """words: [W, n] int32, most significant word first.
+
+    Returns (order, gid_sorted) with the exact same contract as the numpy
+    lexsort grouping (group ids are lexicographic ranks; order is the stable
+    grouped permutation), or None when the native library is unavailable.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    words = np.ascontiguousarray(words, dtype=np.int32)
+    W, n = words.shape
+    gid = np.empty(n, dtype=np.int64)
+    order = np.empty(n, dtype=np.int64)
+    u = lib.sk_group_windows(
+        words.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.c_int64(n), ctypes.c_int32(W),
+        gid.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        order.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    if u < 0:
+        return None
+    return order, gid[order]

@@ -36,7 +36,7 @@ def _num_words(k: int) -> int:
     return (k + SYMS_PER_WORD - 1) // SYMS_PER_WORD
 
 
-def _pack_and_rank_numpy(codes: np.ndarray, starts: np.ndarray, k: int):
+def _pack_words_numpy(codes: np.ndarray, starts: np.ndarray, k: int):
     words = []
     for j in range(_num_words(k)):
         w = np.zeros(len(starts), dtype=np.int32)
@@ -46,6 +46,11 @@ def _pack_and_rank_numpy(codes: np.ndarray, starts: np.ndarray, k: int):
             if idx < k:
                 w |= codes[starts + idx].astype(np.int32)
         words.append(w)
+    return words
+
+
+def _pack_and_rank_numpy(codes: np.ndarray, starts: np.ndarray, k: int):
+    words = _pack_words_numpy(codes, starts, k)
     order = np.lexsort(tuple(reversed(words)))  # last key is primary in lexsort
     sorted_words = [w[order] for w in words]
     new_group = np.zeros(len(starts), dtype=bool)
@@ -94,12 +99,21 @@ def group_windows(codes: np.ndarray, starts: np.ndarray, k: int,
         # zero-length windows are all identical (k=1's (k-1)-grams)
         return np.arange(len(starts), dtype=np.int64), np.zeros(len(starts), np.int64)
     if use_jax is None:
-        use_jax = len(starts) >= _JAX_THRESHOLD
+        # XLA's variadic sort has multi-minute compile times on the current
+        # TPU platform, so the device path is opt-in; the native hash
+        # grouping below is the fast default at every scale.
+        use_jax = False
     if use_jax:
         try:
             return _pack_and_rank_jax(codes, starts, k)
         except Exception:
             pass
+    # fused native pack + hash-grouping kernel (O(n) vs the comparison sort)
+    from .. import native
+    if native.available():
+        result = native.group_kmers_native(codes, starts, k)
+        if result is not None:
+            return result
     return _pack_and_rank_numpy(codes, starts, k)
 
 

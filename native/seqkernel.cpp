@@ -1,0 +1,328 @@
+// seqkernel: native host kernels for autocycler-tpu.
+//
+// The reference implements its entire runtime in native code (Rust); this
+// library is the native core of OUR host runtime: exact k-mer grouping via
+// open-addressing hashing (replacing comparison sorts that dominate the
+// Python/numpy fallback at hundreds of millions of windows) plus the
+// counting passes around it. The TPU (JAX/Pallas) remains the compute path
+// for device-friendly kernels; this covers the irregular host side
+// (SURVEY.md §2.1: "Replace hash map with sort-based grouping" — here the
+// grouping is hash-based but group ids are still lexicographic ranks, so
+// downstream determinism is identical to the sorted formulation).
+//
+// Build: g++ -O3 -march=native -shared -fPIC seqkernel.cpp -o libseqkernel.so
+// ABI: plain C, driven from Python via ctypes (no pybind11 dependency).
+
+#include <cstdint>
+#include <cstring>
+#include <algorithm>
+#include <vector>
+
+namespace {
+
+// 64-bit mix of the W packed words of one window (splitmix64-style).
+static inline uint64_t hash_window(const int32_t* words, int64_t n,
+                                   int32_t W, int64_t i) {
+    uint64_t h = 0x9E3779B97F4A7C15ull;
+    for (int32_t w = 0; w < W; ++w) {
+        uint64_t x = static_cast<uint32_t>(words[static_cast<int64_t>(w) * n + i]);
+        x ^= h;
+        x *= 0xBF58476D1CE4E5B9ull;
+        x ^= x >> 27;
+        x *= 0x94D049BB133111EBull;
+        x ^= x >> 31;
+        h = x;
+    }
+    return h | 1;  // 0 marks an empty slot
+}
+
+static inline bool window_equal(const int32_t* words, int64_t n, int32_t W,
+                                int64_t a, int64_t b) {
+    for (int32_t w = 0; w < W; ++w) {
+        const int32_t* row = words + static_cast<int64_t>(w) * n;
+        if (row[a] != row[b]) return false;
+    }
+    return true;
+}
+
+// lexicographic compare of two windows (words are most-significant-first)
+static inline bool window_less(const int32_t* words, int64_t n, int32_t W,
+                               int64_t a, int64_t b) {
+    for (int32_t w = 0; w < W; ++w) {
+        const int32_t* row = words + static_cast<int64_t>(w) * n;
+        if (row[a] != row[b]) return row[a] < row[b];
+    }
+    return false;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Group n windows of W int32 words (row-major [W][n], most significant word
+// first) into dense group ids that are LEXICOGRAPHIC RANKS, exactly like a
+// full lexicographic sort would produce.
+//
+// Outputs:
+//   out_gid[n]    group id per window (lexicographic rank of its k-mer)
+//   out_order[n]  window indices grouped by gid, ascending index inside
+//                 each group (== stable sort by gid)
+// Returns the number of distinct windows U, or -1 on allocation failure.
+int64_t sk_group_windows(const int32_t* words, int64_t n, int32_t W,
+                         int64_t* out_gid, int64_t* out_order) {
+    if (n == 0) return 0;
+
+    // --- open-addressing hash table, 16-byte entries (one cache line pair
+    // lookup), grown on load factor > 0.6 so its footprint tracks the number
+    // of DISTINCT windows, not n — typical inputs repeat each k-mer ~2x per
+    // input assembly, so this keeps the table cache-resident ---
+    struct Entry {
+        uint64_t hash;   // 0 = empty
+        uint32_t rep;    // representative (first) window index
+        uint32_t gid;    // provisional first-seen group id
+    };
+    static_assert(sizeof(Entry) == 16, "Entry must be 16 bytes");
+    if (n > UINT32_MAX) return -1;
+
+    uint64_t cap = 1 << 16;
+    std::vector<Entry> table;
+    std::vector<uint32_t> reps;      // provisional gid -> representative index
+    try {
+        table.assign(cap, Entry{0, 0, 0});
+        reps.reserve(1 << 16);
+    } catch (...) {
+        return -1;
+    }
+
+    auto grow = [&]() -> bool {
+        const uint64_t new_cap = cap * 4;
+        std::vector<Entry> bigger;
+        try {
+            bigger.assign(new_cap, Entry{0, 0, 0});
+        } catch (...) {
+            return false;
+        }
+        const uint64_t new_mask = new_cap - 1;
+        for (const Entry& e : table) {
+            if (e.hash == 0) continue;
+            uint64_t s = e.hash & new_mask;
+            while (bigger[s].hash != 0) s = (s + 1) & new_mask;
+            bigger[s] = e;
+        }
+        table.swap(bigger);
+        cap = new_cap;
+        return true;
+    };
+
+    for (int64_t i = 0; i < n; ++i) {
+        if (reps.size() * 5 > cap * 3) {
+            if (!grow()) return -1;
+        }
+        const uint64_t mask = cap - 1;
+        const uint64_t h = hash_window(words, n, W, i);
+        uint64_t s = h & mask;
+        for (;;) {
+            Entry& e = table[s];
+            if (e.hash == 0) {
+                e.hash = h;
+                e.rep = static_cast<uint32_t>(i);
+                e.gid = static_cast<uint32_t>(reps.size());
+                reps.push_back(static_cast<uint32_t>(i));
+                out_gid[i] = e.gid;
+                break;
+            }
+            if (e.hash == h && window_equal(words, n, W, e.rep, i)) {
+                out_gid[i] = e.gid;
+                break;
+            }
+            s = (s + 1) & mask;
+        }
+    }
+
+    const int64_t U = static_cast<int64_t>(reps.size());
+
+    // --- lexicographic ranks for determinism parity with sorted grouping ---
+    // copy representatives into a compact row-major [U][W] layout first so
+    // sort comparisons touch contiguous memory instead of n-strided columns
+    std::vector<int32_t> rep_words(static_cast<size_t>(U) * W);
+    for (int64_t g = 0; g < U; ++g) {
+        const int64_t r = reps[g];
+        for (int32_t w = 0; w < W; ++w)
+            rep_words[static_cast<size_t>(g) * W + w] =
+                words[static_cast<int64_t>(w) * n + r];
+    }
+    std::vector<int64_t> rank_order(U);
+    for (int64_t g = 0; g < U; ++g) rank_order[g] = g;
+    std::sort(rank_order.begin(), rank_order.end(),
+              [&](int64_t a, int64_t b) {
+                  const int32_t* pa = rep_words.data() + static_cast<size_t>(a) * W;
+                  const int32_t* pb = rep_words.data() + static_cast<size_t>(b) * W;
+                  for (int32_t w = 0; w < W; ++w) {
+                      if (pa[w] != pb[w]) return pa[w] < pb[w];
+                  }
+                  return false;
+              });
+    std::vector<int64_t> lex_rank(U);
+    for (int64_t r = 0; r < U; ++r) lex_rank[rank_order[r]] = r;
+    for (int64_t i = 0; i < n; ++i) out_gid[i] = lex_rank[out_gid[i]];
+
+    // --- counting sort of window indices by gid (stable) ---
+    std::vector<int64_t> counts(U + 1, 0);
+    for (int64_t i = 0; i < n; ++i) ++counts[out_gid[i] + 1];
+    for (int64_t g = 0; g < U; ++g) counts[g + 1] += counts[g];
+    for (int64_t i = 0; i < n; ++i) out_order[counts[out_gid[i]]++] = i;
+
+    return U;
+}
+
+// Pack length-k windows of 5-symbol codes into W = ceil(k/10) int32 words,
+// 3 bits per symbol, most significant first, zero-filled tail — the same
+// packing as ops.kmers (word-tuple order == byte-lexicographic order).
+// codes: [n_codes] uint8 (values 0..4); starts: [n] window start offsets;
+// out:   [W][n] int32 row-major.
+void sk_pack_words(const uint8_t* codes, const int64_t* starts, int64_t n,
+                   int32_t k, int32_t* out) {
+    const int32_t W = (k + 9) / 10;
+    for (int64_t i = 0; i < n; ++i) {
+        const uint8_t* p = codes + starts[i];
+        for (int32_t w = 0; w < W; ++w) {
+            int32_t acc = 0;
+            const int32_t base = w * 10;
+            for (int32_t t = 0; t < 10; ++t) {
+                acc <<= 3;
+                const int32_t idx = base + t;
+                if (idx < k) acc |= p[idx];
+            }
+            out[static_cast<int64_t>(w) * n + i] = acc;
+        }
+    }
+}
+
+// Fused pack + group: the production entry point. Packs each window into a
+// row-major [W]-word key on the fly (single sequential read of the codes
+// buffer), hashes it immediately, and groups with the same growing table as
+// sk_group_windows — no strided memory anywhere on the hot path.
+// Semantics identical to sk_pack_words + sk_group_windows.
+int64_t sk_group_kmers(const uint8_t* codes, const int64_t* starts, int64_t n,
+                       int32_t k, int64_t* out_gid, int64_t* out_order) {
+    if (n == 0) return 0;
+    if (n > UINT32_MAX) return -1;
+    const int32_t W = (k + 9) / 10;
+
+    std::vector<int32_t> row_words;   // [n][W] row-major keys
+    try {
+        row_words.resize(static_cast<size_t>(n) * W);
+    } catch (...) {
+        return -1;
+    }
+
+    struct Entry {
+        uint64_t hash;
+        uint32_t rep;
+        uint32_t gid;
+    };
+    uint64_t cap = 1 << 16;
+    std::vector<Entry> table;
+    std::vector<uint32_t> reps;
+    try {
+        table.assign(cap, Entry{0, 0, 0});
+    } catch (...) {
+        return -1;
+    }
+
+    auto grow = [&]() -> bool {
+        const uint64_t new_cap = cap * 4;
+        std::vector<Entry> bigger;
+        try {
+            bigger.assign(new_cap, Entry{0, 0, 0});
+        } catch (...) {
+            return false;
+        }
+        const uint64_t new_mask = new_cap - 1;
+        for (const Entry& e : table) {
+            if (e.hash == 0) continue;
+            uint64_t s = e.hash & new_mask;
+            while (bigger[s].hash != 0) s = (s + 1) & new_mask;
+            bigger[s] = e;
+        }
+        table.swap(bigger);
+        cap = new_cap;
+        return true;
+    };
+
+    for (int64_t i = 0; i < n; ++i) {
+        int32_t* key = row_words.data() + static_cast<size_t>(i) * W;
+        const uint8_t* p = codes + starts[i];
+        uint64_t h = 0x9E3779B97F4A7C15ull;
+        for (int32_t w = 0; w < W; ++w) {
+            int32_t acc = 0;
+            const int32_t base = w * 10;
+            for (int32_t t = 0; t < 10; ++t) {
+                acc <<= 3;
+                const int32_t idx = base + t;
+                if (idx < k) acc |= p[idx];
+            }
+            key[w] = acc;
+            uint64_t x = static_cast<uint32_t>(acc) ^ h;
+            x *= 0xBF58476D1CE4E5B9ull;
+            x ^= x >> 27;
+            x *= 0x94D049BB133111EBull;
+            x ^= x >> 31;
+            h = x;
+        }
+        h |= 1;
+
+        if (reps.size() * 5 > cap * 3) {
+            if (!grow()) return -1;
+        }
+        const uint64_t mask = cap - 1;
+        uint64_t s = h & mask;
+        for (;;) {
+            Entry& e = table[s];
+            if (e.hash == 0) {
+                e.hash = h;
+                e.rep = static_cast<uint32_t>(i);
+                e.gid = static_cast<uint32_t>(reps.size());
+                reps.push_back(static_cast<uint32_t>(i));
+                out_gid[i] = e.gid;
+                break;
+            }
+            if (e.hash == h &&
+                std::memcmp(row_words.data() + static_cast<size_t>(e.rep) * W,
+                            key, sizeof(int32_t) * W) == 0) {
+                out_gid[i] = e.gid;
+                break;
+            }
+            s = (s + 1) & mask;
+        }
+    }
+
+    const int64_t U = static_cast<int64_t>(reps.size());
+
+    // lexicographic ranks over the (compact, row-major) representatives
+    std::vector<int64_t> rank_order(U);
+    for (int64_t g = 0; g < U; ++g) rank_order[g] = g;
+    std::sort(rank_order.begin(), rank_order.end(),
+              [&](int64_t a, int64_t b) {
+                  const int32_t* pa = row_words.data() +
+                      static_cast<size_t>(reps[a]) * W;
+                  const int32_t* pb = row_words.data() +
+                      static_cast<size_t>(reps[b]) * W;
+                  for (int32_t w = 0; w < W; ++w) {
+                      if (pa[w] != pb[w]) return pa[w] < pb[w];
+                  }
+                  return false;
+              });
+    std::vector<int64_t> lex_rank(U);
+    for (int64_t r = 0; r < U; ++r) lex_rank[rank_order[r]] = r;
+    for (int64_t i = 0; i < n; ++i) out_gid[i] = lex_rank[out_gid[i]];
+
+    std::vector<int64_t> counts(U + 1, 0);
+    for (int64_t i = 0; i < n; ++i) ++counts[out_gid[i] + 1];
+    for (int64_t g = 0; g < U; ++g) counts[g + 1] += counts[g];
+    for (int64_t i = 0; i < n; ++i) out_order[counts[out_gid[i]]++] = i;
+
+    return U;
+}
+
+}  // extern "C"

@@ -1,0 +1,76 @@
+"""Native seqkernel grouping must agree exactly with the numpy lexsort path."""
+
+import numpy as np
+import pytest
+
+from autocycler_tpu import native
+from autocycler_tpu.ops.kmers import _pack_and_rank_numpy, _pack_words_numpy, group_windows
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native seqkernel not built (no compiler)")
+
+
+def _random_case(n_codes, n_windows, k, seed):
+    rng = np.random.default_rng(seed)
+    codes = rng.integers(0, 5, size=n_codes).astype(np.uint8)
+    starts = rng.integers(0, n_codes - k, size=n_windows).astype(np.int64)
+    return codes, starts
+
+
+def test_native_matches_numpy():
+    for k in (5, 21, 51, 101):
+        codes, starts = _random_case(5000, 4000, k, seed=k)
+        exp_order, exp_gid = _pack_and_rank_numpy(codes, starts, k)
+        words = np.stack(_pack_words_numpy(codes, starts, k))
+        got = native.group_windows_native(words)
+        assert got is not None
+        got_order, got_gid = got
+        assert (got_gid == exp_gid).all()
+        assert (got_order == exp_order).all()
+
+
+def test_fused_group_kmers_matches_numpy():
+    for k in (5, 21, 51, 101):
+        codes, starts = _random_case(5000, 4000, k, seed=100 + k)
+        exp_order, exp_gid = _pack_and_rank_numpy(codes, starts, k)
+        got = native.group_kmers_native(codes, starts, k)
+        assert got is not None
+        got_order, got_gid = got
+        assert (got_gid == exp_gid).all()
+        assert (got_order == exp_order).all()
+
+
+def test_fused_pack_matches_numpy_pack():
+    codes, starts = _random_case(3000, 2000, 51, seed=9)
+    exp = np.stack(_pack_words_numpy(codes, starts, 51))
+    got = native.pack_words_native(codes, starts, 51)
+    assert got is not None and (got == exp).all()
+
+
+def test_native_table_growth():
+    # enough distinct k-mers to force several table growth cycles
+    codes, starts = _random_case(400_000, 300_000, 21, seed=42)
+    exp_order, exp_gid = _pack_and_rank_numpy(codes, starts, 21)
+    got_order, got_gid = native.group_kmers_native(codes, starts, 21)
+    assert (got_gid == exp_gid).all()
+    assert (got_order == exp_order).all()
+
+
+def test_native_is_default_backend():
+    codes, starts = _random_case(2000, 1500, 21, seed=3)
+    got_order, got_gid = group_windows(codes, starts, 21)
+    exp_order, exp_gid = _pack_and_rank_numpy(codes, starts, 21)
+    assert (got_gid == exp_gid).all()
+    assert (got_order == exp_order).all()
+
+
+def test_native_many_duplicates():
+    # heavy duplication (low-entropy sequence) stresses the hash table
+    codes = np.tile(np.array([1, 2, 3, 4], dtype=np.uint8), 500)
+    starts = np.arange(len(codes) - 21, dtype=np.int64)
+    words = np.stack(_pack_words_numpy(codes, starts, 21))
+    order, gid = native.group_windows_native(words)
+    exp_order, exp_gid = _pack_and_rank_numpy(codes, starts, 21)
+    assert (gid == exp_gid).all()
+    assert (order == exp_order).all()
+    assert gid[-1] == 3  # only 4 distinct 21-mers in a period-4 sequence
