@@ -250,50 +250,67 @@ int64_t sk_group_kmers(const uint8_t* codes, const int64_t* starts, int64_t n,
         return true;
     };
 
-    for (int64_t i = 0; i < n; ++i) {
-        int32_t* key = row_words.data() + static_cast<size_t>(i) * W;
-        const uint8_t* p = codes + starts[i];
-        uint64_t h = 0x9E3779B97F4A7C15ull;
-        for (int32_t w = 0; w < W; ++w) {
-            int32_t acc = 0;
-            const int32_t base = w * 10;
-            for (int32_t t = 0; t < 10; ++t) {
-                acc <<= 3;
-                const int32_t idx = base + t;
-                if (idx < k) acc |= p[idx];
-            }
-            key[w] = acc;
-            uint64_t x = static_cast<uint32_t>(acc) ^ h;
-            x *= 0xBF58476D1CE4E5B9ull;
-            x ^= x >> 27;
-            x *= 0x94D049BB133111EBull;
-            x ^= x >> 31;
-            h = x;
-        }
-        h |= 1;
+    // Process windows in blocks: pack + hash a block first (sequential
+    // reads), prefetch each window's table slot, then probe. Hides the
+    // table's cache-miss latency behind the packing of the next windows.
+    constexpr int64_t BLOCK = 64;
+    uint64_t hashes[BLOCK];
+    for (int64_t block_start = 0; block_start < n; block_start += BLOCK) {
+        const int64_t block_end = std::min(block_start + BLOCK, n);
 
-        if (reps.size() * 5 > cap * 3) {
+        if ((reps.size() + BLOCK) * 5 > cap * 3) {
             if (!grow()) return -1;
         }
         const uint64_t mask = cap - 1;
-        uint64_t s = h & mask;
-        for (;;) {
-            Entry& e = table[s];
-            if (e.hash == 0) {
-                e.hash = h;
-                e.rep = static_cast<uint32_t>(i);
-                e.gid = static_cast<uint32_t>(reps.size());
-                reps.push_back(static_cast<uint32_t>(i));
-                out_gid[i] = e.gid;
-                break;
+
+        for (int64_t i = block_start; i < block_end; ++i) {
+            int32_t* key = row_words.data() + static_cast<size_t>(i) * W;
+            const uint8_t* p = codes + starts[i];
+            uint64_t h = 0x9E3779B97F4A7C15ull;
+            for (int32_t w = 0; w < W; ++w) {
+                int32_t acc = 0;
+                const int32_t base = w * 10;
+                for (int32_t t = 0; t < 10; ++t) {
+                    acc <<= 3;
+                    const int32_t idx = base + t;
+                    if (idx < k) acc |= p[idx];
+                }
+                key[w] = acc;
+                uint64_t x = static_cast<uint32_t>(acc) ^ h;
+                x *= 0xBF58476D1CE4E5B9ull;
+                x ^= x >> 27;
+                x *= 0x94D049BB133111EBull;
+                x ^= x >> 31;
+                h = x;
             }
-            if (e.hash == h &&
-                std::memcmp(row_words.data() + static_cast<size_t>(e.rep) * W,
-                            key, sizeof(int32_t) * W) == 0) {
-                out_gid[i] = e.gid;
-                break;
+            h |= 1;
+            hashes[i - block_start] = h;
+            __builtin_prefetch(&table[h & mask], 0, 1);
+        }
+
+        for (int64_t i = block_start; i < block_end; ++i) {
+            const uint64_t h = hashes[i - block_start];
+            const int32_t* key = row_words.data() + static_cast<size_t>(i) * W;
+            uint64_t s = h & mask;
+            for (;;) {
+                Entry& e = table[s];
+                if (e.hash == 0) {
+                    e.hash = h;
+                    e.rep = static_cast<uint32_t>(i);
+                    e.gid = static_cast<uint32_t>(reps.size());
+                    reps.push_back(static_cast<uint32_t>(i));
+                    out_gid[i] = e.gid;
+                    break;
+                }
+                if (e.hash == h &&
+                    std::memcmp(row_words.data() +
+                                    static_cast<size_t>(e.rep) * W,
+                                key, sizeof(int32_t) * W) == 0) {
+                    out_gid[i] = e.gid;
+                    break;
+                }
+                s = (s + 1) & mask;
             }
-            s = (s + 1) & mask;
         }
     }
 
