@@ -60,6 +60,14 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int64),
             ctypes.c_int64, ctypes.c_int32,
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
+        lib.sk_scan_gram_matches.restype = ctypes.c_int64
+        lib.sk_scan_gram_matches.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int64)]
         _lib = lib
         return lib
     except OSError:
@@ -112,6 +120,43 @@ def group_kmers_native(codes: np.ndarray, starts: np.ndarray,
     if u < 0:
         return None
     return order, gid[order]
+
+
+def scan_gram_matches_native(codes: np.ndarray, text_off: np.ndarray,
+                             text_len: np.ndarray, h: int, q_starts: np.ndarray
+                             ) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Find every occurrence of the Q query h-grams (given as byte offsets
+    into codes) across the text segments. Returns (query_idx, text_idx,
+    local_pos) ordered by (text, pos), or None when unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    codes = np.ascontiguousarray(codes, dtype=np.uint8)
+    text_off = np.ascontiguousarray(text_off, dtype=np.int64)
+    text_len = np.ascontiguousarray(text_len, dtype=np.int64)
+    q_starts = np.ascontiguousarray(q_starts, dtype=np.int64)
+
+    def call(out_q, out_t, out_p):
+        return lib.sk_scan_gram_matches(
+            codes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            text_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            text_len.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.c_int64(len(text_off)), ctypes.c_int32(h),
+            q_starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.c_int64(len(q_starts)), out_q, out_t, out_p)
+
+    null_i32 = ctypes.POINTER(ctypes.c_int32)()
+    null_i64 = ctypes.POINTER(ctypes.c_int64)()
+    count = call(null_i32, null_i32, null_i64)
+    if count < 0:
+        return None
+    out_q = np.empty(count, dtype=np.int32)
+    out_t = np.empty(count, dtype=np.int32)
+    out_p = np.empty(count, dtype=np.int64)
+    call(out_q.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+         out_t.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+         out_p.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    return out_q, out_t, out_p
 
 
 def group_windows_native(words: np.ndarray

@@ -8,11 +8,14 @@ strands) and substitutes the best match, defined as (1) fewest dots,
 (find_best_match, compress.rs:239-270). Regex ``find_iter`` yields
 non-overlapping matches left-to-right, which we reproduce exactly.
 
-TPU formulation: a pattern of h dots + h real bases matches text at offset j
-iff text[j+h : j+2h] equals the h real bases — i.e. every match is an
-occurrence of an h-gram. So one sort-based grouping of ALL h-grams of all
-padded sequences (ops.kmers.group_windows) answers every pattern query at
-once; candidate windows are then gathered from the byte buffer.
+A pattern of h dots + h real bases matches text at offset j iff
+text[j+off : j+off+h] equals the h real bases — every match is an occurrence
+of a query h-gram. Two providers find those occurrences:
+
+- the native rolling-hash multi-pattern scan (one sequential pass over all
+  texts for all 2S queries, native/seqkernel.cpp), or
+- sort-based grouping of ALL h-grams (ops.kmers.group_windows) as the
+  numpy fallback.
 """
 
 from __future__ import annotations
@@ -36,6 +39,54 @@ def _find_best_match(candidates: List[bytes]) -> bytes:
     return min(candidates, key=lambda c: (c.count(b"."), -counts[c], c))
 
 
+def _matches_by_query_native(codes, text_off, text_len, h, q_starts):
+    from .. import native
+    if not native.available():
+        return None
+    result = native.scan_gram_matches_native(codes, text_off, text_len, h, q_starts)
+    if result is None:
+        return None
+    q_idx, t_idx, pos = result
+    # output is (text, pos)-ordered; stable grouping by query keeps that
+    order = np.argsort(q_idx, kind="stable")
+    by_query: List[Tuple[np.ndarray, np.ndarray]] = []
+    boundaries = np.searchsorted(q_idx[order], np.arange(len(q_starts) + 1))
+    for q in range(len(q_starts)):
+        sel = order[boundaries[q]:boundaries[q + 1]]
+        by_query.append((t_idx[sel], pos[sel]))
+    return by_query
+
+
+def _matches_by_query_grouped(codes, text_off, text_len, h, q_starts):
+    """Fallback: group every h-window of every text, then look up each
+    query's group."""
+    win_count = text_len - h + 1
+    woff = np.zeros(len(text_len), np.int64)
+    woff[1:] = np.cumsum(win_count)[:-1]
+    W = int(win_count.sum())
+    wocc = np.arange(W, dtype=np.int64)
+    wtext = np.searchsorted(woff, wocc, side="right") - 1
+    wpos = wocc - woff[wtext]
+    wstarts = text_off[wtext] + wpos
+
+    all_starts = np.concatenate([wstarts, q_starts])
+    order, gid_sorted = group_windows(codes, all_starts, h)
+    gid = np.empty(len(all_starts), np.int64)
+    gid[order] = gid_sorted
+    win_gid = gid[:W]
+    query_gid = gid[W:]
+
+    win_order = np.argsort(win_gid, kind="stable")  # groups keep (text,pos) order
+    sorted_gid = win_gid[win_order]
+    by_query = []
+    for q in range(len(q_starts)):
+        lo = np.searchsorted(sorted_gid, query_gid[q], side="left")
+        hi = np.searchsorted(sorted_gid, query_gid[q], side="right")
+        sel = win_order[lo:hi]
+        by_query.append((wtext[sel], wpos[sel]))
+    return by_query
+
+
 def sequence_end_repair(sequences: List[Sequence], k_size: int) -> None:
     """In-place repair of every sequence's dotted ends (compress.rs:202-236).
 
@@ -51,51 +102,41 @@ def sequence_end_repair(sequences: List[Sequence], k_size: int) -> None:
 
     # text layout: per sequence, forward then reverse padded strands
     bufs = []
-    text_off = []
+    text_off_list = []
     total = 0
     for s in sequences:
         for strand_seq in (s.forward_seq, s.reverse_seq):
-            text_off.append(total)
+            text_off_list.append(total)
             bufs.append(strand_seq)
             total += len(strand_seq)
     buf = np.concatenate(bufs)
     codes = encode_bytes(buf)
     text_len = np.array([len(b) for b in bufs], dtype=np.int64)
-    text_off = np.array(text_off, dtype=np.int64)
+    text_off = np.array(text_off_list, dtype=np.int64)
 
-    # all h-gram windows of every text
-    win_count = text_len - h + 1
-    woff = np.zeros(len(bufs), np.int64)
-    woff[1:] = np.cumsum(win_count)[:-1]
-    W = int(win_count.sum())
-    wocc = np.arange(W, dtype=np.int64)
-    wtext = np.searchsorted(woff, wocc, side="right") - 1
-    wpos = wocc - woff[wtext]
-    wstarts = text_off[wtext] + wpos
+    # queries: per sequence, the start core (real bases at [h, 2h) of the
+    # forward text) and the end core (real bases at [P-2h, P-h))
+    q_starts = []
+    for i, s in enumerate(sequences):
+        fwd = text_off[2 * i]
+        P = len(s.forward_seq)
+        q_starts.append(fwd + h)          # start-pattern core (offset h in pattern)
+        q_starts.append(fwd + P - 2 * h)  # end-pattern core (offset 0 in pattern)
+    q_starts = np.array(q_starts, dtype=np.int64)
 
-    order, gid_sorted = group_windows(codes, wstarts, h)
-    win_gid = np.zeros(W, np.int64)
-    win_gid[order] = gid_sorted
-    G = int(gid_sorted[-1]) + 1 if W else 0
-    gstart = np.zeros(G + 1, np.int64)
-    np.add.at(gstart, gid_sorted + 1, 1)
-    gstart = np.cumsum(gstart)
+    by_query = _matches_by_query_native(codes, text_off, text_len, h, q_starts)
+    if by_query is None:
+        by_query = _matches_by_query_grouped(codes, text_off, text_len, h, q_starts)
 
-    def candidates_for(core_window: int, core_offset: int) -> List[bytes]:
-        """All non-overlapping (k-1)-byte candidate windows containing the
-        given core h-gram at ``core_offset`` within the pattern (h for the
-        start pattern's trailing real bases, 0 for the end pattern's leading
-        real bases)."""
-        gid = win_gid[core_window]
-        occ = order[gstart[gid]:gstart[gid + 1]]  # ascending => text asc, pos asc
-        t = wtext[occ]
-        p = wpos[occ]
-        j = p - core_offset  # pattern start within the text
-        valid = (j >= 0) & (j + overlap <= text_len[t])
-        t, j = t[valid], j[valid]
+    def candidates(q: int, core_offset: int) -> List[bytes]:
+        """Non-overlapping (k-1)-byte candidate windows for query q, whose
+        core h-gram sits at ``core_offset`` within the pattern."""
+        t_arr, p_arr = by_query[q]
+        j_arr = p_arr - core_offset  # pattern start within the text
+        valid = (j_arr >= 0) & (j_arr + overlap <= text_len[t_arr])
         out: List[bytes] = []
         prev_text, prev_end = -1, -1
-        for ti, ji in zip(t, j):
+        for ti, ji in zip(t_arr[valid], j_arr[valid]):
             if ti == prev_text and ji < prev_end:
                 continue  # regex find_iter skips overlapping matches
             prev_text, prev_end = ti, ji + overlap
@@ -104,15 +145,9 @@ def sequence_end_repair(sequences: List[Sequence], k_size: int) -> None:
         return out
 
     for i, s in enumerate(sequences):
-        fwd_text = 2 * i
         P = len(s.forward_seq)
-        # start pattern: dots at [0,h), real core at [h,2h)
-        start_core = woff[fwd_text] + h
-        best_start = _find_best_match(candidates_for(int(start_core), h))
-        # end pattern: real core at [P-2h, P-h), dots at [P-h, P)
-        end_core = woff[fwd_text] + (P - 2 * h)
-        best_end = _find_best_match(candidates_for(int(end_core), 0))
-
+        best_start = _find_best_match(candidates(2 * i, h))
+        best_end = _find_best_match(candidates(2 * i + 1, 0))
         repaired = s.forward_seq.copy()
         repaired[:overlap] = np.frombuffer(best_start, dtype=np.uint8)
         repaired[P - overlap:] = np.frombuffer(best_end, dtype=np.uint8)

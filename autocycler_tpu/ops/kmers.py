@@ -251,32 +251,20 @@ def build_kmer_index(sequences, k: int, use_jax: Optional[bool] = None) -> KmerI
                                             L_f - 1 - pos_f)
     rev_kid = occ_kid[partner]
 
-    # ---- (k-1)-gram grouping for adjacency ----
-    # gram windows: per strand, L+1 windows (starts 0..L); the gram starting
-    # at window p is the k-mer-at-p's prefix, at p+1 its suffix.
-    g_count = 2 * (seq_len + 1)
-    gocc_off = np.zeros(S, np.int64)
-    if S > 1:
-        gocc_off[1:] = np.cumsum(g_count)[:-1]
-    GM = int(g_count.sum())
-    gocc = np.arange(GM, dtype=np.int64)
-    gseq = np.searchsorted(gocc_off, gocc, side="right") - 1
-    grel = gocc - gocc_off[gseq]
-    gL = seq_len[gseq]
-    gstrand = grel < gL + 1
-    gpos = np.where(gstrand, grel, grel - (gL + 1))
-    gstarts = np.where(gstrand, fwd_off[gseq], rev_off[gseq]) + gpos
-
-    gorder, ggid_sorted = group_windows(codes, gstarts, k - 1, use_jax)
-    gocc_gid = np.zeros(GM, np.int64)
-    gocc_gid[gorder] = ggid_sorted
-    G = int(ggid_sorted[-1]) + 1 if GM else 0
-
-    def gram_occ_index(seq_i, strand_b, p):
-        return gocc_off[seq_i] + np.where(strand_b, p, (seq_len[seq_i] + 1) + p)
-
-    prefix_gid = gocc_gid[gram_occ_index(seq_idx_f, strand_f, pos_f)]
-    suffix_gid = gocc_gid[gram_occ_index(seq_idx_f, strand_f, pos_f + 1)]
+    # ---- (k-1)-gram ids for adjacency ----
+    # Adjacency only ever counts UNIQUE k-mers per gram (next_kmers probes
+    # the k-mer set, not occurrences — kmer_graph.rs:136-166), so it
+    # suffices to group the 2U gram instances at the unique k-mers'
+    # representative windows: the prefix gram starts at the representative
+    # byte offset, the suffix gram one byte later.
+    rep_byte = starts[first_occ]
+    gram_starts = np.concatenate([rep_byte, rep_byte + 1])
+    gorder, ggid_sorted = group_windows(codes, gram_starts, k - 1, use_jax)
+    gram_gid = np.zeros(len(gram_starts), np.int64)
+    gram_gid[gorder] = ggid_sorted
+    G = int(ggid_sorted[-1]) + 1 if len(gram_starts) else 0
+    prefix_gid = gram_gid[:U]
+    suffix_gid = gram_gid[U:]
 
     # neighbour counts over UNIQUE k-mers (next_kmers/prev_kmers semantics)
     cnt_prefix = np.bincount(prefix_gid, minlength=G)

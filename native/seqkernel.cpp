@@ -342,4 +342,97 @@ int64_t sk_group_kmers(const uint8_t* codes, const int64_t* starts, int64_t n,
     return U;
 }
 
+// Multi-pattern gram scan for sequence-end repair: find every occurrence of
+// Q query h-grams across T text segments of the codes buffer (segments are
+// the padded per-strand sequences; windows never cross a segment boundary).
+//
+// Rolling polynomial hash with exact byte verification on candidate hits;
+// queries with identical grams are chained so each gets its own matches.
+//
+// Two-call protocol: with out_query == NULL, returns the total match count;
+// otherwise fills out_query[int32], out_text[int32], out_pos[int64]
+// (position local to the text segment), ordered by (text, pos, query chain).
+int64_t sk_scan_gram_matches(const uint8_t* codes,
+                             const int64_t* text_off, const int64_t* text_len,
+                             int64_t T, int32_t h,
+                             const int64_t* q_starts, int64_t Q,
+                             int32_t* out_query, int32_t* out_text,
+                             int64_t* out_pos) {
+    if (h <= 0 || Q == 0) return 0;
+    constexpr uint64_t B = 0x100000001B3ull;  // FNV-ish odd base
+
+    // base^(h-1) for the rolling update
+    uint64_t b_pow = 1;
+    for (int32_t i = 1; i < h; ++i) b_pow *= B;
+
+    auto hash_at = [&](const uint8_t* p) {
+        uint64_t v = 0;
+        for (int32_t i = 0; i < h; ++i) v = v * B + p[i];
+        return v;
+    };
+
+    // tiny open table: hash -> first query index; same-hash queries chained
+    uint64_t cap = 16;
+    while (cap < static_cast<uint64_t>(Q) * 4) cap <<= 1;
+    const uint64_t mask = cap - 1;
+    std::vector<int32_t> slot_query(cap, -1);
+    std::vector<uint64_t> slot_hash(cap, 0);
+    std::vector<int32_t> chain(Q, -1);
+    std::vector<uint64_t> q_hash(Q);
+    for (int64_t q = 0; q < Q; ++q) {
+        const uint64_t v = hash_at(codes + q_starts[q]);
+        q_hash[q] = v;
+        uint64_t s = v & mask;
+        for (;;) {
+            if (slot_query[s] < 0) {
+                slot_query[s] = static_cast<int32_t>(q);
+                slot_hash[s] = v;
+                break;
+            }
+            // chain only byte-identical grams; a same-hash different-gram
+            // query keeps probing (true hash collision)
+            if (slot_hash[s] == v &&
+                std::memcmp(codes + q_starts[slot_query[s]],
+                            codes + q_starts[q], h) == 0) {
+                chain[q] = chain[slot_query[s]];
+                chain[slot_query[s]] = static_cast<int32_t>(q);
+                break;
+            }
+            s = (s + 1) & mask;
+        }
+    }
+
+    int64_t count = 0;
+    for (int64_t t = 0; t < T; ++t) {
+        const uint8_t* text = codes + text_off[t];
+        const int64_t n = text_len[t] - h + 1;
+        if (n <= 0) continue;
+        uint64_t v = hash_at(text);
+        for (int64_t pos = 0;; ++pos) {
+            uint64_t s = v & mask;
+            while (slot_query[s] >= 0) {
+                if (slot_hash[s] == v) {
+                    const int32_t head = slot_query[s];
+                    if (std::memcmp(codes + q_starts[head], text + pos, h) == 0) {
+                        for (int32_t q = head; q >= 0; q = chain[q]) {
+                            if (out_query != nullptr) {
+                                out_query[count] = q;
+                                out_text[count] = static_cast<int32_t>(t);
+                                out_pos[count] = pos;
+                            }
+                            ++count;
+                        }
+                        break;  // identical grams share one chain
+                    }
+                    // same hash, different gram: keep probing
+                }
+                s = (s + 1) & mask;
+            }
+            if (pos + 1 >= n) break;
+            v = (v - text[pos] * b_pow) * B + text[pos + h];
+        }
+    }
+    return count;
+}
+
 }  // extern "C"

@@ -1,0 +1,88 @@
+"""Additional graph-structure parity tests (reference unitig_graph.rs test
+module): per-fixture stats, link_exists truth tables, path helpers."""
+
+from autocycler_tpu.models import UnitigGraph
+from autocycler_tpu.models.unitig_graph import parse_unitig_path, reverse_path
+from autocycler_tpu.utils import FORWARD, REVERSE
+
+from fixtures_gfa import (TEST_GFA_1, TEST_GFA_2, TEST_GFA_3, TEST_GFA_4, TEST_GFA_5,
+                          TEST_GFA_6, TEST_GFA_7, gfa_lines)
+
+
+def test_graph_stats_all_fixtures():
+    expect = [
+        (TEST_GFA_1, 9, 10, 92, (21, 11)),
+        (TEST_GFA_2, 9, 3, 31, (8, 4)),
+        (TEST_GFA_3, 9, 7, 85, (15, 8)),
+        (TEST_GFA_4, 3, 5, 43, (10, 5)),
+        (TEST_GFA_5, 3, 6, 60, (8, 4)),
+        (TEST_GFA_6, 3, 2, 34, (2, 1)),
+        (TEST_GFA_7, 3, 2, 34, (2, 1)),
+    ]
+    for text, k, n_unitigs, total, links in expect:
+        graph, _ = UnitigGraph.from_gfa_lines(gfa_lines(text))
+        graph.check_links()
+        assert graph.k_size == k
+        assert len(graph.unitigs) == n_unitigs
+        assert graph.total_length() == total
+        assert graph.link_count() == links
+
+
+def test_parse_unitig_path():
+    assert parse_unitig_path("2+,1-") == [(2, FORWARD), (1, REVERSE)]
+    assert parse_unitig_path("3+,8-,4-") == [(3, FORWARD), (8, REVERSE), (4, REVERSE)]
+
+
+def test_reverse_path():
+    assert reverse_path([(1, FORWARD), (2, REVERSE)]) == [(2, FORWARD), (1, REVERSE)]
+    assert reverse_path([(4, FORWARD), (8, FORWARD), (3, REVERSE)]) == \
+        [(3, FORWARD), (8, REVERSE), (4, REVERSE)]
+
+
+def test_link_exists_fixture_1():
+    graph, _ = UnitigGraph.from_gfa_lines(gfa_lines(TEST_GFA_1))
+    present = [
+        (1, FORWARD, 4, FORWARD), (4, REVERSE, 1, REVERSE),
+        (1, FORWARD, 5, REVERSE), (5, FORWARD, 1, REVERSE),
+        (2, FORWARD, 1, FORWARD), (1, REVERSE, 2, REVERSE),
+        (3, REVERSE, 1, FORWARD), (1, REVERSE, 3, FORWARD),
+        (4, FORWARD, 7, REVERSE), (7, FORWARD, 4, REVERSE),
+        (4, FORWARD, 8, FORWARD), (8, REVERSE, 4, REVERSE),
+        (6, REVERSE, 5, REVERSE), (5, FORWARD, 6, FORWARD),
+        (6, FORWARD, 6, REVERSE), (7, REVERSE, 9, FORWARD),
+        (9, REVERSE, 7, FORWARD), (8, FORWARD, 10, REVERSE),
+        (10, FORWARD, 8, REVERSE), (9, FORWARD, 7, FORWARD),
+        (7, REVERSE, 9, REVERSE),
+    ]
+    for a, sa, b, sb in present:
+        assert graph.link_exists(a, sa, b, sb), (a, sa, b, sb)
+    absent = [(5, REVERSE, 5, FORWARD), (7, FORWARD, 9, FORWARD),
+              (123, FORWARD, 456, FORWARD)]
+    for a, sa, b, sb in absent:
+        assert not graph.link_exists(a, sa, b, sb), (a, sa, b, sb)
+
+
+def test_link_exists_fixture_2():
+    graph, _ = UnitigGraph.from_gfa_lines(gfa_lines(TEST_GFA_2))
+    for a, sa, b, sb in [(1, FORWARD, 2, FORWARD), (2, REVERSE, 1, REVERSE),
+                         (1, FORWARD, 2, REVERSE), (2, FORWARD, 1, REVERSE),
+                         (1, REVERSE, 3, FORWARD), (3, REVERSE, 1, FORWARD),
+                         (1, REVERSE, 3, REVERSE), (3, FORWARD, 1, FORWARD)]:
+        assert graph.link_exists(a, sa, b, sb)
+    for a, sa, b, sb in [(2, FORWARD, 1, FORWARD), (2, FORWARD, 2, REVERSE),
+                         (2, REVERSE, 3, REVERSE), (4, FORWARD, 5, FORWARD)]:
+        assert not graph.link_exists(a, sa, b, sb)
+
+
+def test_delete_outgoing_incoming_links():
+    graph, _ = UnitigGraph.from_gfa_lines(gfa_lines(TEST_GFA_2))
+    graph.delete_outgoing_links(1)  # 1+ -> 2+ and 1+ -> 2-
+    assert not graph.link_exists(1, FORWARD, 2, FORWARD)
+    assert not graph.link_exists(1, FORWARD, 2, REVERSE)
+    assert graph.link_exists(1, REVERSE, 3, FORWARD)  # untouched
+    graph.check_links()
+    graph.delete_incoming_links(1)  # 3- -> 1+ and 3+ -> 1+
+    assert not graph.link_exists(3, REVERSE, 1, FORWARD)
+    assert not graph.link_exists(3, FORWARD, 1, FORWARD)
+    assert graph.link_count() == (0, 0)
+    graph.check_links()
