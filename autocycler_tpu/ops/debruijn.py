@@ -140,33 +140,33 @@ def build_chains(index: KmerIndex) -> Chains:
     mirror_chain = chain_id[index.rev_kid[chain_head]]
     self_mirror = mirror_chain == np.arange(C)
 
-    out_members: List[np.ndarray] = []
-    out_is_cycle: List[bool] = []
-    for c in range(C):
-        if self_mirror[c]:
-            mem = members[chain_off[c]:chain_off[c + 1]]
-            if chain_is_cycle[c]:
-                out_members.append(_simulate_walk_cycle(index, next_int, mem, int(min_own[c])))
-                out_is_cycle.append(False)  # walk result is not a full cycle
-            else:
-                n = len(mem)
-                half = n // 2
-                pos_of_min = int(np.argmin(mem))
-                out_members.append(mem[:half] if pos_of_min < half else mem[half:])
-                out_is_cycle.append(False)
-            continue
-        if min_own[c] > min_mirror[c]:
-            continue  # the mirror chain is emitted instead
-        out_members.append(members[chain_off[c]:chain_off[c + 1]])
-        out_is_cycle.append(bool(chain_is_cycle[c]))
+    # Emit chains vectorised: of each mirror pair keep the chain holding the
+    # smaller minimum (ties == self-mirror, handled separately below).
+    normal_keep = ~self_mirror & (min_own <= min_mirror)
+    keep_node = np.repeat(normal_keep, sizes)
+    flat = members[keep_node]
+    kept_sizes = sizes[normal_keep]
+    off = np.concatenate([[0], np.cumsum(kept_sizes)]).astype(np.int64)
+    out_is_cycle = list(chain_is_cycle[normal_keep])
 
-    if out_members:
-        flat = np.concatenate(out_members)
-        off = np.concatenate([[0], np.cumsum([len(m) for m in out_members])]).astype(np.int64)
-    else:
-        flat = np.zeros(0, np.int64)
-        off = np.zeros(1, np.int64)
-    return Chains(flat, off, np.array(out_is_cycle, dtype=bool))
+    # self-mirror chains are rare; the literal per-chain handling only runs
+    # for them (appended after the vectorised bulk — chain order is
+    # irrelevant, renumbering happens downstream)
+    extra_members: List[np.ndarray] = []
+    for c in np.flatnonzero(self_mirror):
+        mem = members[chain_off[c]:chain_off[c + 1]]
+        if chain_is_cycle[c]:
+            extra_members.append(_simulate_walk_cycle(index, next_int, mem,
+                                                      int(min_own[c])))
+        else:
+            half = len(mem) // 2
+            pos_of_min = int(np.argmin(mem))
+            extra_members.append(mem[:half] if pos_of_min < half else mem[half:])
+        out_is_cycle.append(False)  # walk results are never full cycles
+    if extra_members:
+        flat = np.concatenate([flat] + extra_members)
+        off = np.concatenate([off, off[-1] + np.cumsum([len(m) for m in extra_members])])
+    return Chains(flat, off.astype(np.int64), np.array(out_is_cycle, dtype=bool))
 
 
 def _simulate_walk_cycle(index: KmerIndex, next_int: np.ndarray,
