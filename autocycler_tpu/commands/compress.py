@@ -20,6 +20,7 @@ from ..models.simplify import simplify_structure
 from ..ops.end_repair import sequence_end_repair
 from ..ops.graph_build import build_unitig_graph
 from ..utils import find_all_assemblies, format_duration, load_fasta, log, quit_with_error
+from ..utils.timing import stage_timer
 
 MAX_INPUT_SEQUENCES = 32767  # position packing limit (reference compress.rs:112-114)
 
@@ -49,19 +50,22 @@ def compress(assemblies_dir, autocycler_dir, k_size: int = 51,
                     "generate a consensus assembly (with autocycler resolve).")
     os.makedirs(autocycler_dir, exist_ok=True)
     metrics = InputAssemblyMetrics()
-    sequences, assembly_count = load_sequences(assemblies_dir, k_size, metrics,
-                                               max_contigs)
+    with stage_timer("compress/load_and_repair"):
+        sequences, assembly_count = load_sequences(assemblies_dir, k_size, metrics,
+                                                   max_contigs)
     log.section_header("Building compacted unitig graph")
     log.explanation("K-mers are grouped with a sort-based device kernel, unitig chains "
                     "are assembled, and all non-branching paths are collapsed to form a "
                     "compacted De Bruijn graph, a.k.a. a unitig graph.")
-    graph = build_unitig_graph(sequences, k_size, use_jax=use_jax)
+    with stage_timer("compress/build_graph"):
+        graph = build_unitig_graph(sequences, k_size, use_jax=use_jax)
     graph.print_basic_graph_info()
 
     log.section_header("Simplifying unitig graph")
     log.explanation("The graph structure is now simplified by moving sequence into repeat "
                     "unitigs when possible.")
-    simplify_structure(graph, sequences)
+    with stage_timer("compress/simplify"):
+        simplify_structure(graph, sequences)
     graph.print_basic_graph_info()
 
     out_gfa = Path(autocycler_dir) / "input_assemblies.gfa"

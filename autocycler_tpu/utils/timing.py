@@ -1,0 +1,44 @@
+"""Per-stage timing and optional device profiling.
+
+The reference only reports total wall-clock at the end of a run
+(compress.rs:34,197). Here every pipeline stage can report its duration
+(AUTOCYCLER_TIMINGS=1) and optionally capture a JAX profiler trace
+(AUTOCYCLER_PROFILE_DIR=<dir>) for inspection with TensorBoard/XProf —
+the SURVEY §5 observability upgrade.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+
+from . import log
+from .misc import format_duration
+
+
+@contextlib.contextmanager
+def stage_timer(name: str):
+    """Times a pipeline stage; reporting is enabled with AUTOCYCLER_TIMINGS=1,
+    device profiling with AUTOCYCLER_PROFILE_DIR."""
+    profile_dir = os.environ.get("AUTOCYCLER_PROFILE_DIR")
+    trace = None
+    if profile_dir:
+        try:
+            import jax
+            trace = jax.profiler.trace(os.path.join(profile_dir, name))
+            trace.__enter__()
+        except Exception:
+            trace = None
+    start = time.perf_counter()
+    try:
+        yield
+    finally:
+        elapsed = time.perf_counter() - start
+        if trace is not None:
+            try:
+                trace.__exit__(None, None, None)
+            except Exception:
+                pass
+        if os.environ.get("AUTOCYCLER_TIMINGS"):
+            log.message(f"[timing] {name}: {format_duration(elapsed)}")
