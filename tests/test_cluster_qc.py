@@ -1,0 +1,63 @@
+"""Cluster QC behaviours: containment failure, trusted override, manual
+clusters (reference cluster.rs:511-723 semantics)."""
+
+from autocycler_tpu.commands.cluster import (ClusterQC, TreeNode,
+                                             cluster_is_contained_in_another,
+                                             cluster_is_trusted, qc_clusters)
+from autocycler_tpu.models import Sequence
+
+
+def mkseq(id, filename, header, length, cluster):
+    s = Sequence.with_seq(id, "A", filename, header, 1)
+    s.length = length
+    s.cluster = cluster
+    return s
+
+
+def test_cluster_is_contained_in_another():
+    # cluster 2's contigs are asymmetrically close to cluster 1 (contained)
+    seqs = [mkseq(1, "a.fasta", "c1", 100, 1), mkseq(2, "b.fasta", "c1", 100, 1),
+            mkseq(3, "a.fasta", "c2", 40, 2), mkseq(4, "b.fasta", "c2", 40, 2)]
+    d = {}
+    for a in (1, 2):
+        for b in (3, 4):
+            d[(a, b)] = 0.6   # big cluster vs small: far
+            d[(b, a)] = 0.05  # small vs big: near (contained)
+    for a in (1, 2, 3, 4):
+        for b in (1, 2, 3, 4):
+            d.setdefault((a, b), 0.0)
+    qc = {1: ClusterQC(0.0), 2: ClusterQC(0.0)}
+    assert cluster_is_contained_in_another(2, seqs, d, 0.2, qc) == 1
+    assert cluster_is_contained_in_another(1, seqs, d, 0.2, qc) == 0
+    # symmetric distances -> not contained
+    d2 = {k: 0.6 for k in d}
+    for a in (1, 2, 3, 4):
+        d2[(a, a)] = 0.0
+    assert cluster_is_contained_in_another(2, seqs, d2, 0.2, qc) == 0
+
+
+def test_trusted_contig_overrides_qc():
+    tree = TreeNode(5, TreeNode(1), TreeNode(2), 0.05)
+    # two tips from the same assembly; min_assemblies=2 would normally fail
+    seqs = [mkseq(1, "a.fasta", "c1", 100, 0),
+            mkseq(2, "a.fasta", "c2 Autocycler_trusted", 90, 0)]
+    d = {(1, 1): 0.0, (2, 2): 0.0, (1, 2): 0.05, (2, 1): 0.05}
+    qc = qc_clusters(tree, seqs, d, [5], [], 0.2, min_assemblies=2)
+    assert qc[1].passed()  # trusted membership overrides "too few assemblies"
+    assert cluster_is_trusted(seqs, 1)
+
+    seqs2 = [mkseq(1, "a.fasta", "c1", 100, 0), mkseq(2, "a.fasta", "c2", 90, 0)]
+    qc2 = qc_clusters(tree, seqs2, d, [5], [], 0.2, min_assemblies=2)
+    assert not qc2[1].passed()
+    assert qc2[1].failure_reasons == ["present in too few assemblies"]
+
+
+def test_manual_cluster_failure_reason():
+    tree = TreeNode(5, TreeNode(1), TreeNode(2), 0.4)
+    seqs = [mkseq(1, "a.fasta", "c1", 100, 0), mkseq(2, "b.fasta", "c2", 90, 0)]
+    d = {(1, 1): 0.0, (2, 2): 0.0, (1, 2): 0.8, (2, 1): 0.8}
+    qc = qc_clusters(tree, seqs, d, [1, 2], [1], 0.2, min_assemblies=1)
+    passed = [c for c, q in qc.items() if q.passed()]
+    failed = [c for c, q in qc.items() if not q.passed()]
+    assert len(passed) == 1 and len(failed) == 1
+    assert qc[failed[0]].failure_reasons == ["not included in manual clusters"]
