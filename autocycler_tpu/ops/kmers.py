@@ -215,31 +215,34 @@ def build_kmer_index(sequences, k: int, use_jax: Optional[bool] = None) -> KmerI
         occ_off[1:] = np.cumsum(2 * seq_len)[:-1]
     M = int(2 * seq_len.sum())
 
-    # byte start of every occurrence window
-    occ = np.arange(M, dtype=np.int64)
-    seq_idx = np.searchsorted(occ_off, occ, side="right") - 1
-    rel = occ - occ_off[seq_idx]
-    L = seq_len[seq_idx]
-    strand = rel < L
-    pos = np.where(strand, rel, rel - L)
-    starts = np.where(strand, fwd_off[seq_idx], rev_off[seq_idx]) + pos
+    # byte start of every occurrence window, built per contiguous strand run
+    # (avoids materialising seq/strand/pos arrays of size M)
+    start_runs = []
+    for i in range(S):
+        L_i = int(seq_len[i])
+        start_runs.append(fwd_off[i] + np.arange(L_i, dtype=np.int64))
+        start_runs.append(rev_off[i] + np.arange(L_i, dtype=np.int64))
+    starts = np.concatenate(start_runs) if start_runs else np.zeros(0, np.int64)
 
     # ---- k-mer grouping ----
     order, gid_sorted = group_windows(codes, starts, k, use_jax)
     U = int(gid_sorted[-1]) + 1 if M else 0
-    occ_kid = np.zeros(M, np.int64)
+    occ_kid = np.zeros(M, np.int32)
     occ_kid[order] = gid_sorted
-    # occurrences grouped by kid; stable lexsort keeps occurrence order inside
-    # each group ascending
+    # occurrences grouped by kid; stable grouping keeps occurrence order
+    # inside each group ascending; gid_sorted is non-decreasing, so group
+    # boundaries come from bincount
     group_start = np.zeros(U + 1, np.int64)
-    np.add.at(group_start, gid_sorted + 1, 1)
-    group_start = np.cumsum(group_start)
+    group_start[1:] = np.cumsum(np.bincount(gid_sorted, minlength=U))
     depth = np.diff(group_start).astype(np.int64)
     first_occ = order[group_start[:-1]] if U else np.zeros(0, np.int64)
 
-    # first-position flag: any occurrence with local window pos == 0
+    # first-position flag: only the two window-0 occurrences per sequence
+    # (forward occ_off[s], reverse occ_off[s] + L) can have pos == 0
     first_pos = np.zeros(U, bool)
-    np.logical_or.at(first_pos, occ_kid, pos == 0)
+    if M:
+        window0 = np.concatenate([occ_off, occ_off + seq_len])
+        first_pos[occ_kid[window0]] = True
 
     # reverse-complement partner: partner occurrence of the first occurrence
     seq_idx_f = np.searchsorted(occ_off, first_occ, side="right") - 1
