@@ -118,12 +118,13 @@ def create_bridges(graph: UnitigGraph, sequences: List[Sequence], anchors: List[
     """One Bridge per (start, end) anchor pair; sequences contribute their
     path consensus_weight times (reference resolve.rs:166-190)."""
     anchor_set = set(anchors)
+    all_paths = graph.get_unitig_paths_for_sequences([s.id for s in sequences])
     sequence_paths = []
     for s in sequences:
         weight = s.consensus_weight()
         if verbose:
             log.message(f"{s} consensus weight = {weight}")
-        path = graph.get_unitig_path_for_sequence_i32(s)
+        path = [n if st else -n for n, st in all_paths[s.id]]
         sequence_paths.extend([list(path) for _ in range(weight)])
     a_to_a = get_anchor_to_anchor_paths(sequence_paths, anchor_set)
     grouped = group_paths_by_start_end(a_to_a)

@@ -68,9 +68,10 @@ def trim_start_end_overlap(graph: UnitigGraph, sequences: List[Sequence],
     A max_unitigs of 0 disables trimming."""
     if max_unitigs == 0:
         return [None] * len(sequences)
+    all_paths = graph.get_unitig_paths_for_sequences([s.id for s in sequences])
     results: List[TrimResult] = []
     for seq in sequences:
-        path = graph.get_unitig_path_for_sequence_i32(seq)
+        path = [n if s else -n for n, s in all_paths[seq.id]]
         trimmed = trim_path_start_end(path, weights, min_identity, max_unitigs)
         if trimmed is not None:
             length = sum(weights[abs(u)] for u in trimmed)
@@ -89,9 +90,10 @@ def trim_hairpin_overlap(graph: UnitigGraph, sequences: List[Sequence],
     """Per-sequence hairpin trimming at both path ends (reference trim.rs:139-186)."""
     if max_unitigs == 0:
         return [None] * len(sequences)
+    all_paths = graph.get_unitig_paths_for_sequences([s.id for s in sequences])
     results: List[TrimResult] = []
     for seq in sequences:
-        path = graph.get_unitig_path_for_sequence_i32(seq)
+        path = [n if s else -n for n, s in all_paths[seq.id]]
         trimmed_start = trimmed_end = False
         p2 = trim_path_hairpin_start(path, weights, min_identity, max_unitigs)
         if p2 is not None:

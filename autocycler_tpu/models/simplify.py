@@ -134,8 +134,9 @@ def get_fixed_unitig_starts_and_ends(graph: UnitigGraph, sequences: List[Sequenc
     (reference graph_simplification.rs:190-230)."""
     fixed_starts: Set[int] = set()
     fixed_ends: Set[int] = set()
+    paths = graph.get_unitig_paths_for_sequences([s.id for s in sequences])
     for seq in sequences:
-        path = graph.get_unitig_path_for_sequence(seq)
+        path = paths[seq.id]
         if not path:
             continue
         first_unitig, first_strand = path[0]

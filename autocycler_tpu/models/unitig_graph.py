@@ -163,8 +163,9 @@ class UnitigGraph:
             lines.append(unitig.gfa_segment_line(use_other_colour))
         for a, a_strand, b, b_strand in self.links_for_gfa():
             lines.append(f"L\t{a}\t{a_strand}\t{b}\t{b_strand}\t0M")
+        paths = self.get_unitig_paths_for_sequences([s.id for s in sequences])
         for seq in sequences:
-            lines.append(self.gfa_path_line(seq))
+            lines.append(self.gfa_path_line(seq, paths[seq.id]))
         return "\n".join(lines) + "\n"
 
     def links_for_gfa(self, offset: int = 0):
@@ -178,8 +179,9 @@ class UnitigGraph:
                               "+" if b.strand else "-"))
         return links
 
-    def gfa_path_line(self, seq: Sequence) -> str:
-        path = self.get_unitig_path_for_sequence(seq)
+    def gfa_path_line(self, seq: Sequence, path=None) -> str:
+        if path is None:
+            path = self.get_unitig_path_for_sequence(seq)
         path_str = ",".join(f"{num}{'+' if strand else '-'}" for num, strand in path)
         cluster_tag = f"\tCL:i:{seq.cluster}" if seq.cluster > 0 else ""
         return (f"P\t{seq.id}\t{path_str}\t*\tLN:i:{seq.length}\tFN:Z:{seq.filename}"
@@ -196,43 +198,36 @@ class UnitigGraph:
     def get_sequence_from_path_signed(self, path: List[int]) -> np.ndarray:
         return self.get_sequence_from_path([(abs(n), n >= 0) for n in path])
 
-    def _find_starting_unitig(self, seq_id: int) -> UnitigStrand:
-        """The unitig+strand where the given sequence's path begins
-        (reference unitig_graph.rs:407-425)."""
-        starting = []
+    def get_unitig_paths_for_sequences(self, seq_ids) -> Dict[int, List[Tuple[int, bool]]]:
+        """Paths for many sequences in one sweep: every unitig's forward-
+        strand positions are collected and sorted by coordinate, which
+        reconstructs each path without the reference's step-by-step
+        neighbour walk (unitig_graph.rs:407-465) — same result, O(total
+        positions) instead of O(path · degree · positions)."""
+        wanted = set(seq_ids)
+        by_seq: Dict[int, List[Tuple[int, int, bool, int]]] = {i: [] for i in wanted}
         for unitig in self.unitigs:
+            length = unitig.length()
             for p in unitig.forward_positions:
-                if p.seq_id == seq_id and p.strand and p.pos == 0:
-                    starting.append(UnitigStrand(unitig, FORWARD))
+                if p.strand and p.seq_id in wanted:
+                    by_seq[p.seq_id].append((p.pos, unitig.number, FORWARD, length))
             for p in unitig.reverse_positions:
-                if p.seq_id == seq_id and p.strand and p.pos == 0:
-                    starting.append(UnitigStrand(unitig, REVERSE))
-        assert len(starting) == 1
-        return starting[0]
-
-    def _get_next_unitig(self, seq_id: int, seq_strand: bool, unitig: Unitig,
-                         strand: bool, pos: int) -> Optional[Tuple[UnitigStrand, int]]:
-        next_pos = pos + unitig.length()
-        next_edges = unitig.forward_next if strand else unitig.reverse_next
-        for nxt in next_edges:
-            positions = (nxt.unitig.forward_positions if nxt.strand
-                         else nxt.unitig.reverse_positions)
-            for p in positions:
-                if p.seq_id == seq_id and p.strand == seq_strand and p.pos == next_pos:
-                    return UnitigStrand(nxt.unitig, nxt.strand), next_pos
-        return None
+                if p.strand and p.seq_id in wanted:
+                    by_seq[p.seq_id].append((p.pos, unitig.number, REVERSE, length))
+        out: Dict[int, List[Tuple[int, bool]]] = {}
+        for sid, items in by_seq.items():
+            items.sort()
+            expected = 0
+            path = []
+            for pos, number, strand, length in items:
+                assert pos == expected, "sequence path is not contiguous"
+                path.append((number, strand))
+                expected += length
+            out[sid] = path
+        return out
 
     def get_unitig_path_for_sequence(self, seq: Sequence) -> List[Tuple[int, bool]]:
-        path = []
-        u = self._find_starting_unitig(seq.id)
-        pos = 0
-        while True:
-            path.append((u.number, u.strand))
-            step = self._get_next_unitig(seq.id, FORWARD, u.unitig, u.strand, pos)
-            if step is None:
-                break
-            u, pos = step
-        return path
+        return self.get_unitig_paths_for_sequences([seq.id])[seq.id]
 
     def get_unitig_path_for_sequence_i32(self, seq: Sequence) -> List[int]:
         return [num if strand else -num
@@ -243,9 +238,9 @@ class UnitigGraph:
         """filename -> [(header, sequence string)], in input order
         (reference unitig_graph.rs:362-370)."""
         out: Dict[str, List[Tuple[str, str]]] = {}
+        paths = self.get_unitig_paths_for_sequences([s.id for s in seqs])
         for seq in seqs:
-            path = self.get_unitig_path_for_sequence(seq)
-            sequence = self.get_sequence_from_path(path)
+            sequence = self.get_sequence_from_path(paths[seq.id])
             assert len(sequence) == seq.length, \
                 "reconstructed sequence does not have expected length"
             out.setdefault(seq.filename, []).append(

@@ -31,9 +31,10 @@ def membership_matrix(graph, sequences) -> Tuple[np.ndarray, np.ndarray, List[in
     w = np.array([u.length() for u in graph.unitigs], dtype=np.int64)
     M = np.zeros((len(sequences), len(numbers)), dtype=np.uint8)
     ids = []
+    paths = graph.get_unitig_paths_for_sequences([s.id for s in sequences])
     for i, seq in enumerate(sequences):
         ids.append(seq.id)
-        for number, _ in graph.get_unitig_path_for_sequence(seq):
+        for number, _ in paths[seq.id]:
             M[i, col[number]] = 1
     return M, w, ids
 
