@@ -32,16 +32,24 @@ from .unitig_graph import UnitigGraph
 
 def simplify_structure(graph: UnitigGraph, seqs: List[Sequence]) -> None:
     """expand_repeats to fixpoint, then renumber
-    (reference graph_simplification.rs:26-40)."""
-    while expand_repeats(graph, seqs) > 0:
+    (reference graph_simplification.rs:26-40).
+
+    The fixed start/end sets are computed once: shifting sequence between
+    unitigs never adds, removes or reorders path entries (and links are
+    untouched), so the sets are invariant across iterations — the reference
+    recomputes them each sweep with the same result."""
+    fixed = get_fixed_unitig_starts_and_ends(graph, seqs)
+    while expand_repeats(graph, seqs, fixed) > 0:
         pass
     graph.renumber_unitigs()
 
 
-def expand_repeats(graph: UnitigGraph, seqs: List[Sequence]) -> int:
+def expand_repeats(graph: UnitigGraph, seqs: List[Sequence], fixed=None) -> int:
     """One sweep of repeat expansion; returns total bases shifted
     (reference graph_simplification.rs:43-86)."""
-    fixed_starts, fixed_ends = get_fixed_unitig_starts_and_ends(graph, seqs)
+    if fixed is None:
+        fixed = get_fixed_unitig_starts_and_ends(graph, seqs)
+    fixed_starts, fixed_ends = fixed
     total_shifted = 0
     for unitig in graph.unitigs:
         number = unitig.number
