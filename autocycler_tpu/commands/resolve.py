@@ -12,7 +12,6 @@ fresh graph. Writes 3_bridged.gfa, 4_merged.gfa, 5_final.gfa.
 
 from __future__ import annotations
 
-import functools
 from pathlib import Path
 from typing import Dict, List, Optional, Set, Tuple
 

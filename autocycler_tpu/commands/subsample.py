@@ -18,7 +18,7 @@ from pathlib import Path
 from typing import List, Set
 
 from ..metrics import ReadSetDetails, SubsampleMetrics
-from ..utils import fastq_reader, format_float, log, quit_with_error
+from ..utils import fastq_reader, log, quit_with_error
 
 
 def parse_genome_size(genome_size_str: str) -> int:

@@ -17,8 +17,8 @@ from typing import Dict, List, Optional, Tuple
 from ..metrics import TrimmedClusterMetrics
 from ..models import Sequence, UnitigGraph
 from ..models.simplify import merge_linear_paths
-from ..ops.align import GAP, AlignmentPiece, find_midpoint, overlap_alignment
-from ..utils import (format_float, log, mad as mad_fn, median, quit_with_error,
+from ..ops.align import GAP, find_midpoint, overlap_alignment
+from ..utils import (log, mad as mad_fn, median, quit_with_error,
                      reverse_signed_path)
 
 TrimResult = Optional[Tuple[List[int], int]]

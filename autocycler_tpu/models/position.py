@@ -9,8 +9,6 @@ is enforced at load time (reference compress.rs:112-114).
 
 from __future__ import annotations
 
-from ..utils import FORWARD
-
 MAX_SEQ_ID = 32767  # 15-bit packing limit, reference position.rs:21 + compress.rs:112-114
 
 

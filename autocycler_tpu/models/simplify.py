@@ -20,11 +20,11 @@ being shuffled are numpy views so there is no byte copying beyond the edits.
 
 from __future__ import annotations
 
-from typing import List, Optional, Set, Tuple
+from typing import List, Set, Tuple
 
 import numpy as np
 
-from ..utils import FORWARD, REVERSE, reverse_complement_bytes
+from ..utils import FORWARD, REVERSE
 from .sequence import Sequence
 from .unitig import Unitig, UnitigStrand, UnitigType
 from .unitig_graph import UnitigGraph

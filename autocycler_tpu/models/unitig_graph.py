@@ -15,11 +15,11 @@ by design (SURVEY.md §2.1, §7).
 from __future__ import annotations
 
 from pathlib import Path
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Tuple
 
 import numpy as np
 
-from ..utils import (FORWARD, REVERSE, load_file_lines, quit_with_error, sign_at_end)
+from ..utils import FORWARD, REVERSE, load_file_lines, quit_with_error
 from .position import Position
 from .sequence import Sequence
 from .unitig import Unitig, UnitigStrand
