@@ -87,54 +87,57 @@ def build_chains(index: KmerIndex) -> Chains:
     has_next = next_int >= 0
     prev_int[next_int[has_next]] = np.flatnonzero(has_next)
 
-    # ---- component minima (for cycle detection and representatives) ----
-    node = np.arange(U, dtype=np.int64)
-    P = np.where(prev_int < 0, node, prev_int)
-    N = np.where(next_int < 0, node, next_int)
-    comp = node.copy()
-    steps = max(1, int(np.ceil(np.log2(max(U, 2)))) + 1)
-    for _ in range(steps):
-        comp = np.minimum(comp, np.minimum(comp[P], comp[N]))
-        P, N = P[P], N[N]
+    # ---- one pointer-doubling pass finds heads AND detects cycles ----
+    # Path nodes converge to their head (prev < 0); a node still pointing at
+    # a predecessor-bearing node after full doubling lies on a cycle.
+    head, rank = _pointer_double_heads(prev_int)
+    in_cycle = prev_int[head] >= 0
 
-    # a component is a cycle iff it has no head
-    head_nodes = prev_int < 0
-    comp_has_head = np.zeros(U, bool)
-    np.logical_or.at(comp_has_head, comp, head_nodes)
-    in_cycle = ~comp_has_head[comp]
-
-    # break each cycle at its representative (= smallest member id; the
-    # reference's walk starts there because iteration is lexicographic)
-    cycle_reps = np.unique(comp[in_cycle])
-    prev_broken = prev_int.copy()
-    next_broken = next_int.copy()
-    if len(cycle_reps):
+    cycle_nodes = np.flatnonzero(in_cycle)
+    prev_broken = prev_int
+    if len(cycle_nodes):
+        # representatives (= smallest member id, where the reference's
+        # lexicographic walk starts): min-propagate over the cycle subset
+        # only, with indices remapped to a compact array
+        compact = np.full(U, -1, np.int64)
+        compact[cycle_nodes] = np.arange(len(cycle_nodes))
+        cprev = compact[prev_int[cycle_nodes]]
+        cmin = cycle_nodes.copy()
+        steps = max(1, int(np.ceil(np.log2(max(len(cycle_nodes), 2)))) + 1)
+        P = cprev
+        for _ in range(steps):
+            new = np.minimum(cmin, cmin[P])
+            if np.array_equal(new, cmin):
+                break
+            cmin = new
+            P = P[P]
+        # cmin now holds, for each cycle node, the min over enough
+        # predecessors to cover the whole cycle
+        cycle_reps = np.unique(cmin)
+        prev_broken = prev_int.copy()
         tails = prev_int[cycle_reps]          # cycle predecessor of each rep
         prev_broken[cycle_reps] = -1
-        next_broken[tails] = -1
+        next_int = next_int.copy()
+        next_int[tails] = -1
+        head, rank = _pointer_double_heads(prev_broken)
 
-    # ---- heads and ranks over the (now acyclic) path forest ----
-    head, rank = _pointer_double_heads(prev_broken)
-
-    # order members by (head, rank)
-    order = np.lexsort((rank, head))
-    heads_sorted = head[order]
-    boundaries = np.flatnonzero(np.concatenate([[True], heads_sorted[1:] != heads_sorted[:-1]]))
-    chain_off = np.concatenate([boundaries, [U]]).astype(np.int64)
-    members = order  # node ids in (chain, rank) order
-    C = len(boundaries)
-    chain_of = np.zeros(U, np.int64)
-    chain_of[heads_sorted[boundaries]] = np.arange(C)
-    chain_id = chain_of[head]  # chain index of every node
-
-    sizes = np.diff(chain_off)
+    # ---- order members by (chain, rank) with O(U) scatters ----
+    is_head = prev_broken < 0
+    cid_of_head = np.cumsum(is_head) - 1      # dense chain id per head node
+    C = int(is_head.sum())
+    chain_id = cid_of_head[head]              # chain index of every node
+    sizes = np.bincount(chain_id, minlength=C)
+    chain_off = np.zeros(C + 1, np.int64)
+    chain_off[1:] = np.cumsum(sizes)
+    members = np.empty(U, np.int64)
+    members[chain_off[chain_id] + rank] = np.arange(U)
     chain_head = members[chain_off[:-1]]
     chain_tail = members[chain_off[1:] - 1]
     chain_is_cycle = in_cycle[chain_head]
 
     # per-chain minima, own and mirror
     min_own = np.full(C, U, np.int64)
-    np.minimum.at(min_own, chain_id, node)
+    np.minimum.at(min_own, chain_id, np.arange(U, dtype=np.int64))
     min_mirror = np.full(C, U, np.int64)
     np.minimum.at(min_mirror, chain_id, index.rev_kid)
     mirror_chain = chain_id[index.rev_kid[chain_head]]
