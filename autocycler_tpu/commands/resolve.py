@@ -31,21 +31,29 @@ class Bridge:
     def __init__(self, start: int, end: int, all_paths: List[List[int]],
                  unitig_lengths: Dict[int, int]):
         trimmed = [path[1:-1] for path in all_paths]
+        # The medoid objective Σ_j d(i, j) over occurrences equals
+        # Σ_distinct_j mult_j · d(i, j) (self-distance is 0), so distances
+        # are computed between DISTINCT paths only — groups are dominated by
+        # duplicates since most assemblies agree on each bridge.
+        mult: Dict[tuple, int] = {}
+        for path in trimmed:
+            mult[tuple(path)] = mult.get(tuple(path), 0) + 1
+        distinct = sorted(mult)  # lexicographic: ties resolve to smaller path
         best_path: List[int] = []
         best_total = None
-        for i, path_i in enumerate(trimmed):
+        for path_i in distinct:
             total = 0
-            for j, path_j in enumerate(trimmed):
-                if i != j:
-                    total += global_alignment_distance(path_i, path_j, unitig_lengths)
-            if best_total is None or total < best_total or \
-                    (total == best_total and path_i < best_path):
+            for path_j, m in mult.items():
+                if path_j != path_i:
+                    total += m * global_alignment_distance(path_i, path_j,
+                                                           unitig_lengths)
+            if best_total is None or total < best_total:
                 best_total = total
-                best_path = path_i
+                best_path = list(path_i)
         self.start = start
         self.end = end
         self.all_paths = trimmed
-        self.best_path = list(best_path)
+        self.best_path = best_path
         self.conflicting = False
 
     def rev_start(self) -> int:
@@ -160,6 +168,7 @@ def apply_bridges(graph: UnitigGraph, bridges: List[Bridge], bridge_depth: float
     reduce constituent depths, drop anchor-less components
     (reference resolve.rs:223-251)."""
     graph.clear_positions()
+    next_num = graph.max_unitig_number()
     for bridge in bridges:
         if bridge.conflicting:
             continue
@@ -169,7 +178,8 @@ def apply_bridges(graph: UnitigGraph, bridges: List[Bridge], bridge_depth: float
             graph.create_link(bridge.start, bridge.end)
         else:
             bridge_seq = graph.get_sequence_from_path_signed(bridge.best_path)
-            bridge_num = graph.max_unitig_number() + 1
+            next_num += 1
+            bridge_num = next_num
             unitig = Unitig.bridge(bridge_num, bridge_seq, bridge_depth)
             graph.unitigs.append(unitig)
             graph.index[bridge_num] = unitig
