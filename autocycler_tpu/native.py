@@ -60,6 +60,12 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int64),
             ctypes.c_int64, ctypes.c_int32,
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
+        lib.sk_overlap_dp.restype = None
+        lib.sk_overlap_dp.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_double)]
         lib.sk_scan_gram_matches.restype = ctypes.c_int64
         lib.sk_scan_gram_matches.argtypes = [
             ctypes.POINTER(ctypes.c_uint8),
@@ -120,6 +126,30 @@ def group_kmers_native(codes: np.ndarray, starts: np.ndarray,
     if u < 0:
         return None
     return order, gid[order]
+
+
+def overlap_dp_native(a_vals: np.ndarray, wa: np.ndarray, b_vals: np.ndarray,
+                      wb: np.ndarray, n: int, kk: int,
+                      skip_diagonal: bool) -> Optional[np.ndarray]:
+    """Fill the (kk+1)^2 overlap-DP scoring matrix (bit-identical to the
+    numpy row scans in ops.align); None when the library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    a_vals = np.ascontiguousarray(a_vals, dtype=np.int64)
+    wa = np.ascontiguousarray(wa, dtype=np.float64)
+    b_vals = np.ascontiguousarray(b_vals, dtype=np.int64)
+    wb = np.ascontiguousarray(wb, dtype=np.float64)
+    matrix = np.empty((kk + 1, kk + 1), dtype=np.float64)
+    lib.sk_overlap_dp(
+        a_vals.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        wa.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        b_vals.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        wb.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_int64(n), ctypes.c_int64(kk),
+        ctypes.c_int32(1 if skip_diagonal else 0),
+        matrix.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    return matrix
 
 
 def scan_gram_matches_native(codes: np.ndarray, text_off: np.ndarray,

@@ -81,29 +81,34 @@ def overlap_alignment(path_a: Sequence[int], path_b: Sequence[int],
     a_vals = pa
     b_vals = pb[b_glob]
 
-    matrix = np.full((k + 1, k + 1), -np.inf)
-    matrix[0, :] = 0.0
-    matrix[:, 0] = 0.0
-
-    for i in range(1, k + 1):
-        gi = i - 1
-        wi = wa[gi]
-        prev = matrix[i - 1]
-        match_add = np.where(a_vals[gi] == b_vals, wi, -(wi + wcol) / 2.0)
-        base = np.maximum(prev[:k] + match_add, prev[1:] - wi)
-        # diagonal skip leaves that cell at -inf and restarts the insert chain
-        jd = gi - (n - k) + 1 if skip_diagonal else 0
-        T = base + Wcum[1:]
-        if 1 <= jd <= k:
-            run = np.empty(k)
-            run[:jd - 1] = np.maximum.accumulate(np.concatenate([[0.0], T[:jd - 1]]))[1:]
-            if jd < k:
-                run[jd:] = np.maximum.accumulate(T[jd:])
-            row = run - Wcum[1:]
-            row[jd - 1] = -np.inf
-        else:
-            row = np.maximum.accumulate(np.concatenate([[0.0], T]))[1:] - Wcum[1:]
-        matrix[i, 1:] = row
+    from .. import native
+    matrix = None
+    if native.available():
+        matrix = native.overlap_dp_native(pa, wa, b_vals, wcol, n, k, skip_diagonal)
+    if matrix is None:
+        matrix = np.full((k + 1, k + 1), -np.inf)
+        matrix[0, :] = 0.0
+        matrix[:, 0] = 0.0
+        for i in range(1, k + 1):
+            gi = i - 1
+            wi = wa[gi]
+            prev = matrix[i - 1]
+            match_add = np.where(a_vals[gi] == b_vals, wi, -(wi + wcol) / 2.0)
+            base = np.maximum(prev[:k] + match_add, prev[1:] - wi)
+            # diagonal skip leaves the cell at -inf and restarts the insert chain
+            jd = gi - (n - k) + 1 if skip_diagonal else 0
+            T = base + Wcum[1:]
+            if 1 <= jd <= k:
+                run = np.empty(k)
+                run[:jd - 1] = np.maximum.accumulate(
+                    np.concatenate([[0.0], T[:jd - 1]]))[1:]
+                if jd < k:
+                    run[jd:] = np.maximum.accumulate(T[jd:])
+                row = run - Wcum[1:]
+                row[jd - 1] = -np.inf
+            else:
+                row = np.maximum.accumulate(np.concatenate([[0.0], T]))[1:] - Wcum[1:]
+            matrix[i, 1:] = row
 
     # best score on the right edge (smallest row wins ties, like the
     # reference's strict > scan)

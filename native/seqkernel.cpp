@@ -435,4 +435,42 @@ int64_t sk_scan_gram_matches(const uint8_t* codes,
     return count;
 }
 
+// Weighted path-overlap DP (the trim kernel): fills the (kk+1)^2 scoring
+// matrix for ops/align.py's overlap_alignment — matches +w, mismatches
+// -(w_a+w_b)/2, indels -w, top/left edges zero, optionally skipping the
+// main diagonal (path-vs-itself mode). All weights are integers so f64
+// arithmetic is exact and results are bit-identical to the numpy rows.
+// a_vals/wa: per global A index (length n); b_vals/wb: per column j=1..kk.
+void sk_overlap_dp(const int64_t* a_vals, const double* wa,
+                   const int64_t* b_vals, const double* wb,
+                   int64_t n, int64_t kk, int32_t skip_diagonal,
+                   double* matrix) {
+    const int64_t stride = kk + 1;
+    const double NEG_INF = -1.0 / 0.0;
+    for (int64_t j = 0; j <= kk; ++j) matrix[j] = 0.0;
+    for (int64_t i = 1; i <= kk; ++i) {
+        const double* prev = matrix + (i - 1) * stride;
+        double* cur = matrix + i * stride;
+        cur[0] = 0.0;
+        const int64_t gi = i - 1;
+        const double wi = wa[gi];
+        const int64_t a = a_vals[gi];
+        for (int64_t j = 1; j <= kk; ++j) {
+            const int64_t gj = n - kk + j - 1;
+            if (skip_diagonal && gi == gj) {
+                cur[j] = NEG_INF;
+                continue;
+            }
+            const double wj = wb[j - 1];
+            const double match = prev[j - 1] +
+                (a == b_vals[j - 1] ? wi : -(wi + wj) / 2.0);
+            const double del = prev[j] - wi;
+            const double ins = cur[j - 1] - wj;
+            double best = match > del ? match : del;
+            if (ins > best) best = ins;
+            cur[j] = best;
+        }
+    }
+}
+
 }  // extern "C"
