@@ -1,0 +1,61 @@
+"""Clustering score metrics (reference metrics.rs test module)."""
+
+import pytest
+
+from autocycler_tpu.metrics import (ClusteringMetrics, CombineMetrics,
+                                    InputAssemblyMetrics, SubsampleMetrics,
+                                    TrimmedClusterMetrics, UntrimmedClusterMetrics)
+
+
+def balance(filenames):
+    m = ClusteringMetrics()
+    m.calculate_balance(filenames)
+    return m.cluster_balance_score
+
+
+def test_calculate_balance_ordering():
+    scores = [
+        balance({1: ["a", "b", "c"], 2: ["a", "b", "c"], 3: ["a", "b", "c"]}),
+        balance({1: ["a", "b", "c"], 2: ["a", "b", "c", "a"], 3: ["a", "b", "c"]}),
+        balance({1: ["a", "b", "c"], 2: ["a", "b", "c", "a"], 3: ["a", "b"]}),
+        balance({1: ["a", "b", "c"], 2: ["a", "b", "c", "a"], 3: ["a"]}),
+        balance({1: ["a", "b", "c"], 2: ["a", "b", "c", "a"], 3: ["a", "a"]}),
+        balance({1: ["a", "b", "c"], 2: ["d", "e"], 3: ["f"]}),
+    ]
+    assert scores[0] == pytest.approx(1.0, abs=1e-8)
+    for earlier, later in zip(scores, scores[1:]):
+        assert later < earlier
+
+
+def test_calculate_tightness_weights_by_cluster_size():
+    combined = ClusteringMetrics()
+    split = ClusteringMetrics()
+    combined.calculate_tightness([(0.0, 4), (0.25, 8)])
+    split.calculate_tightness([(0.0, 1), (0.0, 1), (0.0, 1), (0.0, 1), (0.25, 8)])
+    assert combined.cluster_tightness_score == \
+        pytest.approx(split.cluster_tightness_score, abs=1e-8)
+    empty = ClusteringMetrics()
+    empty.calculate_tightness([])
+    assert empty.cluster_tightness_score == 0.0
+
+
+def test_get_field_names():
+    assert SubsampleMetrics.get_field_names() == \
+        ["input_read_bases", "input_read_count", "input_read_n50", "output_reads"]
+    assert InputAssemblyMetrics.get_field_names() == \
+        ["compressed_unitig_count", "compressed_unitig_total_length",
+         "input_assemblies_count", "input_assemblies_total_contigs",
+         "input_assemblies_total_length", "input_assembly_details"]
+    assert ClusteringMetrics.get_field_names() == \
+        ["cluster_balance_score", "cluster_tightness_score", "fail_cluster_count",
+         "fail_contig_count", "fail_contig_fraction", "overall_clustering_score",
+         "pass_cluster_count", "pass_contig_count", "pass_contig_fraction"]
+    assert UntrimmedClusterMetrics.get_field_names() == \
+        ["untrimmed_cluster_distance", "untrimmed_cluster_lengths",
+         "untrimmed_cluster_mad", "untrimmed_cluster_median", "untrimmed_cluster_size"]
+    assert TrimmedClusterMetrics.get_field_names() == \
+        ["trimmed_cluster_lengths", "trimmed_cluster_mad", "trimmed_cluster_median",
+         "trimmed_cluster_size"]
+    assert CombineMetrics.get_field_names() == \
+        ["consensus_assembly_bases", "consensus_assembly_clusters",
+         "consensus_assembly_fully_resolved", "consensus_assembly_unitigs"]
