@@ -1,0 +1,68 @@
+"""clean and decompress command tests (reference clean.rs / decompress.rs)."""
+
+import pytest
+
+from autocycler_tpu.commands.clean import clean, parse_tig_numbers
+from autocycler_tpu.commands.decompress import decompress
+from autocycler_tpu.commands.compress import compress
+from autocycler_tpu.models import UnitigGraph
+from autocycler_tpu.utils import AutocyclerError, load_fasta
+
+from fixtures_gfa import TEST_GFA_4, TEST_GFA_5, gfa_lines
+from synthetic import make_assemblies
+
+
+def test_parse_tig_numbers():
+    assert parse_tig_numbers("1,2,3") == [1, 2, 3]
+    assert parse_tig_numbers("3, 1, 2") == [1, 2, 3]
+    assert parse_tig_numbers(None) == []
+    with pytest.raises(AutocyclerError):
+        parse_tig_numbers("1,x")
+
+
+def test_clean_remove_and_merge(tmp_path):
+    in_gfa = tmp_path / "in.gfa"
+    out_gfa = tmp_path / "out.gfa"
+    in_gfa.write_text(TEST_GFA_5)
+    clean(in_gfa, out_gfa, remove="2,4")
+    graph, _ = UnitigGraph.from_gfa_file(out_gfa)
+    assert all(u.number not in () for u in graph.unitigs)
+    assert len(graph.unitigs) == 3  # removed 2 and 4; 3+6 merged into one
+    graph.check_links()
+
+
+def test_clean_rejects_unknown_tig(tmp_path):
+    in_gfa = tmp_path / "in.gfa"
+    in_gfa.write_text(TEST_GFA_4)
+    with pytest.raises(AutocyclerError):
+        clean(in_gfa, tmp_path / "out.gfa", remove="99")
+
+
+def test_clean_duplicate(tmp_path):
+    in_gfa = tmp_path / "in.gfa"
+    out_gfa = tmp_path / "out.gfa"
+    in_gfa.write_text(TEST_GFA_4)
+    clean(in_gfa, out_gfa, duplicate="2")
+    graph, _ = UnitigGraph.from_gfa_file(out_gfa)
+    graph.check_links()
+
+
+def test_decompress_to_single_file(tmp_path):
+    asm_dir = make_assemblies(tmp_path, n_assemblies=3, chromosome_len=2000,
+                              plasmid_len=400, seed=5)
+    out_dir = tmp_path / "out"
+    compress(asm_dir, out_dir, k_size=51, use_jax=False)
+    out_file = tmp_path / "all.fasta"
+    decompress(out_dir / "input_assemblies.gfa", out_file=out_file)
+    records = load_fasta(out_file)
+    assert len(records) == 6  # 3 assemblies x 2 contigs, filename-prefixed
+    assert all(name.startswith("assembly_") for name, _, _ in records)
+
+
+def test_decompress_requires_output(tmp_path):
+    asm_dir = make_assemblies(tmp_path, n_assemblies=2, chromosome_len=1500,
+                              plasmid_len=300, seed=6)
+    out_dir = tmp_path / "out"
+    compress(asm_dir, out_dir, k_size=51, use_jax=False)
+    with pytest.raises(AutocyclerError):
+        decompress(out_dir / "input_assemblies.gfa")
