@@ -61,3 +61,19 @@ def test_sharded_seq_axis_4():
     single = np.asarray(multi_isolate_distance_step(codes, k=21, buckets=512))
     sharded = np.asarray(sharded_multi_isolate_step(mesh, codes, k=21, buckets=512))
     assert np.abs(sharded - single).max() < 1e-5
+
+
+def test_headline_batched_multi_isolate_config():
+    """The BASELINE.md batched configuration — 96 genomes x 12 assemblies —
+    runs sharded over the (4 data x 2 seq) virtual mesh."""
+    codes = _make_batch(n_isolates=96, n_assemblies=3, length=1024, seed=9)
+    codes = np.tile(codes, (1, 4, 1))  # 12 assemblies per isolate
+    assert codes.shape == (96, 12, 1024)
+    mesh = make_mesh(8)
+    # enough buckets that the presence sketch doesn't saturate at L=1024
+    out = np.asarray(sharded_multi_isolate_step(mesh, codes, k=21, buckets=4096))
+    assert out.shape == (96, 12, 12)
+    assert np.allclose(np.diagonal(out, axis1=1, axis2=2), 0.0, atol=1e-5)
+    # tiled copies are identical -> distance 0; rotations near 0; unrelated far
+    assert out[:, 0, 4].max() < 1e-5     # same assembly tiled
+    assert out[:, 0, 2].min() > 0.4      # unrelated assembly
