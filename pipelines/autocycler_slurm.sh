@@ -1,0 +1,53 @@
+#!/usr/bin/env bash
+# Slurm variant of the full pipeline (parity with the reference's
+# pipelines/Slurm_Autocycler_Bash_script_by_Michael_Hall/): assembler jobs
+# are submitted as a Slurm array and the consensus stages run as a dependent
+# job. Adjust partitions/accounts for your cluster.
+#
+# Usage: autocycler_slurm.sh <reads.fastq> <genome_size>
+
+set -euo pipefail
+
+reads=$1
+genome_size=$2
+threads=${SLURM_CPUS_PER_TASK:-16}
+autocycler=${AUTOCYCLER_CMD:-"python -m autocycler_tpu"}
+
+$autocycler subsample --reads "$reads" --out_dir subsampled_reads \
+    --genome_size "$genome_size"
+
+mkdir -p assemblies slurm_logs
+assemblers=(canu flye metamdbg miniasm necat nextdenovo raven)
+
+# one array task per (assembler, subset)
+cat > assembler_job.sh <<EOF
+#!/usr/bin/env bash
+set -u
+assemblers=(${assemblers[@]})
+i=\$((SLURM_ARRAY_TASK_ID / 4))
+s=\$(printf '%02d' \$((SLURM_ARRAY_TASK_ID % 4 + 1)))
+a=\${assemblers[\$i]}
+$autocycler helper \$a --reads subsampled_reads/sample_\$s.fastq \
+    --out_prefix assemblies/\${a}_\$s --threads $threads \
+    --genome_size $genome_size --min_depth_rel 0.1 || true
+EOF
+
+n_jobs=$(( ${#assemblers[@]} * 4 - 1 ))
+asm_job=$(sbatch --parsable --array=0-$n_jobs --time=8:00:00 \
+    --cpus-per-task="$threads" --output=slurm_logs/%A_%a.log assembler_job.sh)
+
+cat > consensus_job.sh <<EOF
+#!/usr/bin/env bash
+set -euo pipefail
+$autocycler compress --assemblies_dir assemblies --autocycler_dir autocycler_out
+$autocycler cluster --autocycler_dir autocycler_out
+for c in autocycler_out/clustering/qc_pass/cluster_*; do
+    $autocycler trim --cluster_dir "\$c"
+    $autocycler resolve --cluster_dir "\$c"
+done
+$autocycler combine --autocycler_dir autocycler_out \
+    --in_gfas autocycler_out/clustering/qc_pass/cluster_*/5_final.gfa
+EOF
+
+sbatch --dependency=afterany:"$asm_job" --cpus-per-task="$threads" \
+    --output=slurm_logs/consensus.log consensus_job.sh
