@@ -1,0 +1,100 @@
+"""End-to-end helper orchestration with stub assembler executables on PATH:
+exercises command construction, output normalisation, depth filtering and
+the non-fatal-failure contract without real assemblers installed."""
+
+import os
+import stat
+
+import pytest
+
+from autocycler_tpu.commands.helper import helper
+from autocycler_tpu.utils import AutocyclerError, load_fasta
+
+
+def _write_stub(bin_dir, name, script):
+    path = bin_dir / name
+    path.write_text("#!/usr/bin/env bash\n" + script)
+    path.chmod(path.stat().st_mode | stat.S_IEXEC)
+    return path
+
+
+@pytest.fixture
+def stub_env(tmp_path, monkeypatch):
+    bin_dir = tmp_path / "bin"
+    bin_dir.mkdir()
+    monkeypatch.setenv("PATH", f"{bin_dir}:{os.environ['PATH']}")
+    reads = tmp_path / "reads.fastq"
+    reads.write_text("@r1\nACGTACGTACGT\n+\nIIIIIIIIIIII\n")
+    return bin_dir, reads, tmp_path
+
+
+def test_helper_flye_stub(stub_env):
+    """The flye wrapper must pass --nano-hq for ont_r10, then stamp
+    circularity and depth from assembly_info.txt into the FASTA."""
+    bin_dir, reads, tmp_path = stub_env
+    _write_stub(bin_dir, "flye", r"""
+out_dir=""
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    --out-dir) out_dir=$2; shift 2;;
+    --nano-hq) echo used_nano_hq > /dev/null; shift;;
+    *) shift;;
+  esac
+done
+mkdir -p "$out_dir"
+printf '>contig_1\nACGTACGTAC\n>contig_2\nGGGGCCCC\n' > "$out_dir/assembly.fasta"
+printf '#seq_name\tlength\tcov.\tcirc.\ncontig_1\t10\t30\tY\ncontig_2\t8\t4\tN\n' > "$out_dir/assembly_info.txt"
+printf 'log line\n' > "$out_dir/flye.log"
+printf 'H\tVN:Z:1.0\n' > "$out_dir/assembly_graph.gfa"
+""")
+    prefix = tmp_path / "asm" / "flye_01"
+    helper("flye", reads, out_prefix=prefix, read_type="ont_r10",
+           directory=tmp_path / "work")
+    records = load_fasta(tmp_path / "asm" / "flye_01.fasta")
+    assert records[0][1] == "contig_1 circular=true depth=30"
+    assert records[1][1] == "contig_2 depth=4"
+    assert (tmp_path / "asm" / "flye_01.gfa").is_file()
+    assert (tmp_path / "asm" / "flye_01.log").is_file()
+
+
+def test_helper_depth_filter_integration(stub_env):
+    bin_dir, reads, tmp_path = stub_env
+    _write_stub(bin_dir, "flye", r"""
+out_dir=""
+while [[ $# -gt 0 ]]; do
+  case "$1" in --out-dir) out_dir=$2; shift 2;; *) shift;; esac
+done
+mkdir -p "$out_dir"
+printf '>c1\nACGTACGTACGTACGT\n>c2\nGGGGCCCC\n' > "$out_dir/assembly.fasta"
+printf 'c1\t16\t30\tY\nc2\t8\t1\tN\n' > "$out_dir/assembly_info.txt"
+""")
+    prefix = tmp_path / "filtered"
+    helper("flye", reads, out_prefix=prefix, directory=tmp_path / "work2",
+           min_depth_rel=0.1)
+    records = load_fasta(tmp_path / "filtered.fasta")
+    assert len(records) == 1  # c2 at depth 1 < 0.1 * 30 dropped
+    assert records[0][0] == "c1"
+
+
+def test_helper_failed_assembler_is_not_fatal(stub_env):
+    """A crashing assembler must not raise; with no usable FASTA the output
+    file simply does not exist (reference helper.rs run_command contract)."""
+    bin_dir, reads, tmp_path = stub_env
+    _write_stub(bin_dir, "raven", "exit 3\n")
+    prefix = tmp_path / "raven_out"
+    helper("raven", reads, out_prefix=prefix, directory=tmp_path / "work3")
+    assert not (tmp_path / "raven_out.fasta").exists()
+
+
+def test_helper_genome_size_stub(stub_env, capsys):
+    bin_dir, reads, tmp_path = stub_env
+    _write_stub(bin_dir, "raven", 'printf ">c1\\nACGTACGTACGTACGTACGT\\n"\n')
+    helper("genome_size", reads, directory=tmp_path / "work4")
+    assert capsys.readouterr().out.strip() == "20"
+
+
+def test_helper_requires_prefix(stub_env):
+    bin_dir, reads, tmp_path = stub_env
+    _write_stub(bin_dir, "flye", "exit 0\n")
+    with pytest.raises(AutocyclerError):
+        helper("flye", reads, directory=tmp_path / "work5")
