@@ -104,11 +104,11 @@ def pack_words_native(codes: np.ndarray, starts: np.ndarray,
     return out
 
 
-def group_kmers_native(codes: np.ndarray, starts: np.ndarray,
-                       k: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
-    """Fused pack + group (the production path): codes uint8 (0..4) and
-    window starts -> (order, gid_sorted), identical contract to the numpy
-    lexsort grouping. None when the library is unavailable or fails."""
+def group_kmers_full(codes: np.ndarray, starts: np.ndarray,
+                     k: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Fused pack + group: codes uint8 (0..4) and window starts ->
+    (gid, order) where gid[i] is window i's lexicographic-rank group id and
+    order is the stable grouped permutation. None when unavailable."""
     lib = get_lib()
     if lib is None:
         return None
@@ -125,6 +125,17 @@ def group_kmers_native(codes: np.ndarray, starts: np.ndarray,
         order.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
     if u < 0:
         return None
+    return gid, order
+
+
+def group_kmers_native(codes: np.ndarray, starts: np.ndarray,
+                       k: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """(order, gid_sorted) view of group_kmers_full — the group_windows
+    contract."""
+    result = group_kmers_full(codes, starts, k)
+    if result is None:
+        return None
+    gid, order = result
     return order, gid[order]
 
 

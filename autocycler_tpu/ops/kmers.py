@@ -225,15 +225,24 @@ def build_kmer_index(sequences, k: int, use_jax: Optional[bool] = None) -> KmerI
     starts = np.concatenate(start_runs) if start_runs else np.zeros(0, np.int64)
 
     # ---- k-mer grouping ----
-    order, gid_sorted = group_windows(codes, starts, k, use_jax)
-    U = int(gid_sorted[-1]) + 1 if M else 0
-    occ_kid = np.zeros(M, np.int32)
-    occ_kid[order] = gid_sorted
+    # the native kernel hands back per-window ids in ORIGINAL order too,
+    # avoiding a 2M-element random scatter to reconstruct occ_kid
+    from .. import native
+    full = native.group_kmers_full(codes, starts, k) if (
+        use_jax is not True and k > 0 and M and native.available()) else None
+    if full is not None:
+        gid, order = full
+        occ_kid = gid.astype(np.int32)
+        U = int(gid[order[-1]]) + 1 if M else 0
+    else:
+        order, gid_sorted = group_windows(codes, starts, k, use_jax)
+        U = int(gid_sorted[-1]) + 1 if M else 0
+        occ_kid = np.zeros(M, np.int32)
+        occ_kid[order] = gid_sorted
     # occurrences grouped by kid; stable grouping keeps occurrence order
-    # inside each group ascending; gid_sorted is non-decreasing, so group
-    # boundaries come from bincount
+    # inside each group ascending
     group_start = np.zeros(U + 1, np.int64)
-    group_start[1:] = np.cumsum(np.bincount(gid_sorted, minlength=U))
+    group_start[1:] = np.cumsum(np.bincount(occ_kid, minlength=U))
     depth = np.diff(group_start).astype(np.int64)
     first_occ = order[group_start[:-1]] if U else np.zeros(0, np.int64)
 
