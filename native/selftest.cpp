@@ -1,0 +1,162 @@
+// Sanitizer self-test for the native kernels: exercises every entry point
+// with randomized inputs and checks results against naive oracles. Built
+// with -fsanitize=address,undefined (see Makefile `selftest`), it is the
+// race/memory-safety net this runtime's unsafe surface gets in place of the
+// reference's Rust guarantees (SURVEY.md §5 sanitizers row).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <cmath>
+#include <map>
+#include <random>
+#include <vector>
+
+extern "C" {
+int64_t sk_group_windows(const int32_t*, int64_t, int32_t, int64_t*, int64_t*);
+void sk_pack_words(const uint8_t*, const int64_t*, int64_t, int32_t, int32_t*);
+int64_t sk_group_kmers(const uint8_t*, const int64_t*, int64_t, int32_t,
+                       int64_t*, int64_t*);
+int64_t sk_scan_gram_matches(const uint8_t*, const int64_t*, const int64_t*,
+                             int64_t, int32_t, const int64_t*, int64_t,
+                             int32_t*, int32_t*, int64_t*);
+void sk_overlap_dp(const int64_t*, const double*, const int64_t*, const double*,
+                   int64_t, int64_t, int32_t, double*);
+}
+
+static int failures = 0;
+
+#define CHECK(cond, msg)                                        \
+    do {                                                        \
+        if (!(cond)) {                                          \
+            std::printf("FAIL: %s (line %d)\n", msg, __LINE__); \
+            ++failures;                                         \
+        }                                                       \
+    } while (0)
+
+static void test_group_kmers(std::mt19937& rng, int64_t n_codes, int64_t n,
+                             int32_t k) {
+    std::uniform_int_distribution<int> code_dist(0, 4);
+    std::vector<uint8_t> codes(n_codes);
+    for (auto& c : codes) c = static_cast<uint8_t>(code_dist(rng));
+    std::uniform_int_distribution<int64_t> start_dist(0, n_codes - k);
+    std::vector<int64_t> starts(n);
+    for (auto& s : starts) s = start_dist(rng);
+
+    std::vector<int64_t> gid(n), order(n);
+    const int64_t u = sk_group_kmers(codes.data(), starts.data(), n, k,
+                                     gid.data(), order.data());
+    CHECK(u > 0 && u <= n, "group count in range");
+
+    // oracle: map from k-mer string to windows; ids must be lexicographic
+    std::map<std::vector<uint8_t>, std::vector<int64_t>> oracle;
+    for (int64_t i = 0; i < n; ++i) {
+        std::vector<uint8_t> key(codes.begin() + starts[i],
+                                 codes.begin() + starts[i] + k);
+        oracle[key].push_back(i);
+    }
+    CHECK(static_cast<int64_t>(oracle.size()) == u, "group count matches oracle");
+    int64_t expect_gid = 0;
+    int64_t pos = 0;
+    for (const auto& [key, members] : oracle) {  // map iterates lexicographically
+        for (int64_t m : members) {
+            CHECK(gid[m] == expect_gid, "gid is lexicographic rank");
+            CHECK(order[pos] == m, "order groups stably");
+            ++pos;
+        }
+        ++expect_gid;
+    }
+
+    // pack + group_windows agree with the fused kernel
+    const int32_t W = (k + 9) / 10;
+    std::vector<int32_t> words(static_cast<size_t>(W) * n);
+    sk_pack_words(codes.data(), starts.data(), n, k, words.data());
+    std::vector<int64_t> gid2(n), order2(n);
+    const int64_t u2 = sk_group_windows(words.data(), n, W, gid2.data(),
+                                        order2.data());
+    CHECK(u2 == u, "sk_group_windows count agrees");
+    CHECK(std::memcmp(gid.data(), gid2.data(), n * 8) == 0, "gids agree");
+    CHECK(std::memcmp(order.data(), order2.data(), n * 8) == 0, "orders agree");
+}
+
+static void test_scan(std::mt19937& rng) {
+    std::uniform_int_distribution<int> code_dist(0, 4);
+    const int32_t h = 5;
+    std::vector<uint8_t> codes(600);
+    for (auto& c : codes) c = static_cast<uint8_t>(code_dist(rng));
+    std::vector<int64_t> text_off = {0, 200, 450};
+    std::vector<int64_t> text_len = {200, 250, 150};
+    std::vector<int64_t> q_starts = {3, 100, 3, 460};  // includes a duplicate gram
+
+    const int64_t count = sk_scan_gram_matches(
+        codes.data(), text_off.data(), text_len.data(), 3, h,
+        q_starts.data(), 4, nullptr, nullptr, nullptr);
+    CHECK(count >= 4, "each query matches at least itself");
+    std::vector<int32_t> oq(count), ot(count);
+    std::vector<int64_t> op(count);
+    sk_scan_gram_matches(codes.data(), text_off.data(), text_len.data(), 3, h,
+                         q_starts.data(), 4, oq.data(), ot.data(), op.data());
+
+    // oracle: brute-force scan
+    int64_t expect = 0;
+    for (int q = 0; q < 4; ++q)
+        for (int t = 0; t < 3; ++t)
+            for (int64_t p = 0; p + h <= text_len[t]; ++p)
+                if (std::memcmp(codes.data() + text_off[t] + p,
+                                codes.data() + q_starts[q], h) == 0)
+                    ++expect;
+    CHECK(expect == count, "scan count matches brute force");
+    for (int64_t i = 0; i < count; ++i) {
+        CHECK(std::memcmp(codes.data() + text_off[ot[i]] + op[i],
+                          codes.data() + q_starts[oq[i]], h) == 0,
+              "every reported match verifies");
+    }
+}
+
+static void test_dp(std::mt19937& rng) {
+    std::uniform_int_distribution<int> val_dist(1, 6);
+    std::uniform_int_distribution<int> w_dist(1, 20);
+    const int64_t n = 30, kk = 20;
+    std::vector<int64_t> a(n), b(kk);
+    std::vector<double> wa(n), wb(kk);
+    for (int64_t i = 0; i < n; ++i) {
+        a[i] = val_dist(rng) * (rng() % 2 ? 1 : -1);
+        wa[i] = w_dist(rng);
+    }
+    for (int64_t j = 0; j < kk; ++j) {
+        b[j] = val_dist(rng) * (rng() % 2 ? 1 : -1);
+        wb[j] = w_dist(rng);
+    }
+    std::vector<double> m((kk + 1) * (kk + 1));
+    sk_overlap_dp(a.data(), wa.data(), b.data(), wb.data(), n, kk, 0, m.data());
+    // oracle: naive recurrence
+    std::vector<double> o((kk + 1) * (kk + 1), 0.0);
+    for (int64_t i = 1; i <= kk; ++i) {
+        for (int64_t j = 1; j <= kk; ++j) {
+            const double match = o[(i - 1) * (kk + 1) + j - 1] +
+                (a[i - 1] == b[j - 1] ? wa[i - 1] : -(wa[i - 1] + wb[j - 1]) / 2);
+            const double del = o[(i - 1) * (kk + 1) + j] - wa[i - 1];
+            const double ins = o[i * (kk + 1) + j - 1] - wb[j - 1];
+            o[i * (kk + 1) + j] = std::max(match, std::max(del, ins));
+        }
+    }
+    for (size_t i = 0; i < m.size(); ++i)
+        CHECK(m[i] == o[i], "DP cell matches oracle exactly");
+}
+
+int main() {
+    std::mt19937 rng(42);
+    for (int trial = 0; trial < 5; ++trial) {
+        test_group_kmers(rng, 2000, 1500, 5);
+        test_group_kmers(rng, 4000, 3000, 21);
+        test_group_kmers(rng, 4000, 2000, 51);
+        test_scan(rng);
+        test_dp(rng);
+    }
+    if (failures == 0) {
+        std::printf("selftest OK\n");
+        return 0;
+    }
+    std::printf("selftest FAILED (%d)\n", failures);
+    return 1;
+}

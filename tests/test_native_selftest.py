@@ -1,0 +1,18 @@
+"""Run the sanitizer-instrumented native self-test (ASan + UBSan over every
+kernel with oracle checks) when a compiler is available."""
+
+import shutil
+import subprocess
+from pathlib import Path
+
+import pytest
+
+NATIVE_DIR = Path(__file__).resolve().parent.parent / "native"
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no compiler")
+def test_native_selftest_under_sanitizers():
+    result = subprocess.run(["make", "selftest"], cwd=NATIVE_DIR,
+                            capture_output=True, text=True, timeout=300)
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "selftest OK" in result.stdout
