@@ -72,7 +72,7 @@ class Unitig:
     def from_segment_line(cls, segment_line: str) -> "Unitig":
         """Parse a GFA S-line (reference unitig.rs:62-91). Requires a DP:f:
         depth tag; unitig type is recovered from the CL:Z: colour tag."""
-        parts = segment_line.rstrip("\n").split("\t")
+        parts = segment_line.rstrip("\r\n").split("\t")
         if len(parts) < 3:
             quit_with_error("Segment line does not have enough parts.")
         try:

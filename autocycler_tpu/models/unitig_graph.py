@@ -61,7 +61,7 @@ class UnitigGraph:
         graph = cls()
         link_lines, path_lines = [], []
         for line in gfa_lines:
-            parts = line.rstrip("\n").split("\t")
+            parts = line.rstrip("\r\n").split("\t")
             if not parts:
                 continue
             if parts[0] == "H":

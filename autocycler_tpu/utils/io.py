@@ -55,7 +55,7 @@ def _parse_fasta_text(lines: Iterator[str], filename) -> List[Tuple[str, str, st
     records = []
     name, header, chunks = "", "", []
     for line in lines:
-        line = line.rstrip("\n")
+        line = line.rstrip("\r\n")
         if not line:
             continue
         if line.startswith(">"):
@@ -133,6 +133,6 @@ def fastq_reader(filename) -> Iterator[Tuple[str, str, str]]:
 def load_file_lines(filename) -> List[str]:
     try:
         with open_maybe_gzip(filename, "rt") as f:
-            return [line.rstrip("\n") for line in f]
+            return [line.rstrip("\r\n") for line in f]
     except OSError as e:
         quit_with_error(f"failed to open file {filename}\n{e}")

@@ -1,0 +1,55 @@
+"""Edge cases: CRLF inputs, single-assembly clustering, tiny graphs."""
+
+from autocycler_tpu.commands.cluster import cluster
+from autocycler_tpu.commands.compress import compress
+from autocycler_tpu.models import UnitigGraph
+from autocycler_tpu.utils import load_fasta
+
+from synthetic import make_assemblies, random_genome
+import random
+
+
+def test_crlf_fasta_and_gfa(tmp_path):
+    rng = random.Random(1)
+    seq = random_genome(rng, 400)
+    asm = tmp_path / "assemblies"
+    asm.mkdir()
+    # Windows line endings in the input FASTA
+    (asm / "a.fasta").write_text(f">c1\r\n{seq[:200]}\r\n{seq[200:]}\r\n")
+    (asm / "b.fasta").write_text(f">c1\n{seq}\n")
+    out = tmp_path / "out"
+    compress(asm, out, k_size=51, use_jax=False)
+    gfa = out / "input_assemblies.gfa"
+    # CRLF-ify the GFA and reload
+    crlf = gfa.read_text().replace("\n", "\r\n")
+    gfa.write_text(crlf)
+    graph, seqs = UnitigGraph.from_gfa_file(gfa)
+    assert len(seqs) == 2
+    recon = graph.reconstruct_original_sequences(seqs)
+    assert recon["a.fasta"][0][1] == seq
+
+
+def test_single_assembly_cluster(tmp_path):
+    asm_dir = make_assemblies(tmp_path, n_assemblies=1, chromosome_len=2000,
+                              plasmid_len=400, seed=3)
+    out = tmp_path / "out"
+    compress(asm_dir, out, k_size=51, use_jax=False)
+    cluster(out, use_jax=False)
+    pass_dirs = sorted((out / "clustering" / "qc_pass").iterdir())
+    # single assembly: min_assemblies auto-set to 1, both contigs pass
+    assert len(pass_dirs) == 2
+
+
+def test_two_contig_same_sequence(tmp_path):
+    rng = random.Random(9)
+    seq = random_genome(rng, 300)
+    asm = tmp_path / "assemblies"
+    asm.mkdir()
+    (asm / "a.fasta").write_text(f">c1\n{seq}\n")
+    (asm / "b.fasta").write_text(f">c1\n{seq}\n")
+    out = tmp_path / "out"
+    compress(asm, out, k_size=51, use_jax=False)
+    graph, seqs = UnitigGraph.from_gfa_file(out / "input_assemblies.gfa")
+    # identical contigs collapse onto the same single unitig path
+    assert len(graph.unitigs) == 1
+    assert graph.unitigs[0].depth == 2.0
