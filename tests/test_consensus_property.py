@@ -1,0 +1,78 @@
+"""Property tests over the whole pipeline: for varied synthetic scenarios
+the consensus must reproduce the true replicons."""
+
+import random
+
+from autocycler_tpu.commands.cluster import cluster
+from autocycler_tpu.commands.combine import combine
+from autocycler_tpu.commands.compress import compress
+from autocycler_tpu.commands.resolve import resolve
+from autocycler_tpu.commands.trim import trim
+from autocycler_tpu.utils import load_fasta
+
+import synthetic
+from synthetic import make_assemblies, random_genome, revcomp
+
+
+def run_pipeline(tmp_path, asm_dir):
+    out = tmp_path / "out"
+    compress(asm_dir, out, k_size=51, use_jax=False)
+    cluster(out, use_jax=False)
+    dirs = sorted((out / "clustering" / "qc_pass").iterdir())
+    for c in dirs:
+        trim(c)
+        resolve(c)
+    combine(out, [c / "5_final.gfa" for c in dirs])
+    return load_fasta(out / "consensus_assembly.fasta")
+
+
+def matches_circular(seq, truth):
+    doubled = truth + truth
+    return len(seq) == len(truth) and (seq in doubled or revcomp(seq) in doubled)
+
+
+def test_circular_with_snps(tmp_path):
+    asm_dir = make_assemblies(tmp_path, n_assemblies=6, chromosome_len=5000,
+                              plasmid_len=900, n_snps=3, seed=21)
+    rng = random.Random(21)
+    chromosome = random_genome(rng, 5000)
+    plasmid = random_genome(rng, 900)
+    records = run_pipeline(tmp_path, asm_dir)
+    assert len(records) == 2
+    for _, header, seq in records:
+        truth = chromosome if len(seq) > 2500 else plasmid
+        # with SNPs the consensus may differ at mutated sites; lengths and
+        # topology must still be exact
+        assert "circular=true" in header
+        assert len(seq) == len(truth)
+
+
+def test_linear_replicon(tmp_path):
+    rng = random.Random(31)
+    genome = random_genome(rng, 3000)
+    asm = tmp_path / "assemblies"
+    asm.mkdir()
+    for i in range(4):
+        (asm / f"assembly_{i + 1}.fasta").write_text(f">contig_{i + 1}\n{genome}\n")
+    records = run_pipeline(tmp_path, asm)
+    assert len(records) == 1
+    _, header, seq = records[0]
+    assert "circular=false topology=linear" in header
+    assert seq == genome or revcomp(seq) == genome
+
+
+def test_mixed_strand_inputs(tmp_path):
+    rng = random.Random(41)
+    genome = random_genome(rng, 2500)
+    asm = tmp_path / "assemblies"
+    asm.mkdir()
+    for i in range(4):
+        g = synthetic.rotate(genome, rng.randrange(len(genome)))
+        if i % 2:
+            g = revcomp(g)
+        (asm / f"assembly_{i + 1}.fasta").write_text(f">c{i + 1}\n{g}\n")
+    records = run_pipeline(tmp_path, asm)
+    assert len(records) == 1
+    _, header, seq = records[0]
+    assert "circular=true" in header
+    assert matches_circular(seq, genome)
