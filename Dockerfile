@@ -1,0 +1,25 @@
+# Container recipe for autocycler-tpu (CPU/host build; on TPU VMs install
+# the matching jax[tpu] wheel instead of jax[cpu]).
+#
+# The external assemblers driven by `autocycler helper` are not bundled —
+# add the ones you use (Flye, Canu, Raven, ...) or mount a conda env, the
+# same model as the reference's pipeline containers.
+
+FROM python:3.12-slim
+
+RUN apt-get update \
+    && apt-get install -y --no-install-recommends g++ make \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /opt/autocycler-tpu
+COPY pyproject.toml README.md ./
+COPY autocycler_tpu ./autocycler_tpu
+COPY native ./native
+COPY pipelines ./pipelines
+
+RUN pip install --no-cache-dir "jax[cpu]" numpy pyyaml pillow matplotlib \
+    && pip install --no-cache-dir --no-build-isolation . \
+    && make -C native
+
+ENTRYPOINT ["autocycler"]
+CMD ["--help"]
