@@ -21,5 +21,9 @@ RUN pip install --no-cache-dir "jax[cpu]" numpy pyyaml pillow matplotlib \
     && pip install --no-cache-dir --no-build-isolation . \
     && make -C native
 
+# the installed package doesn't carry native/; point the loader at the
+# image's build of the kernel library
+ENV AUTOCYCLER_NATIVE_LIB=/opt/autocycler-tpu/native/libseqkernel.so
+
 ENTRYPOINT ["autocycler"]
 CMD ["--help"]

@@ -16,21 +16,32 @@ from typing import Optional, Tuple
 import numpy as np
 
 _NATIVE_DIR = Path(__file__).resolve().parent.parent / "native"
-_LIB_PATH = _NATIVE_DIR / "libseqkernel.so"
+
+
+def _lib_path() -> Path:
+    """AUTOCYCLER_NATIVE_LIB overrides the source-tree location — installed
+    packages (pip/containers) don't carry native/, so deployments point this
+    at wherever they built libseqkernel.so."""
+    override = os.environ.get("AUTOCYCLER_NATIVE_LIB")
+    if override:
+        return Path(override)
+    return _NATIVE_DIR / "libseqkernel.so"
+
+
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
-def _build() -> bool:
+def _build(lib_path: Path) -> bool:
     src = _NATIVE_DIR / "seqkernel.cpp"
     if not src.is_file():
         return False
     try:
         subprocess.run(
             ["g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
-             str(src), "-o", str(_LIB_PATH)],
+             str(src), "-o", str(lib_path)],
             check=True, capture_output=True, timeout=120)
-        return _LIB_PATH.is_file()
+        return lib_path.is_file()
     except Exception:
         return False
 
@@ -43,10 +54,11 @@ def get_lib() -> Optional[ctypes.CDLL]:
     if _tried:
         return None
     _tried = True
-    if not _LIB_PATH.is_file() and not _build():
+    lib_path = _lib_path()
+    if not lib_path.is_file() and not _build(lib_path):
         return None
     try:
-        lib = ctypes.CDLL(str(_LIB_PATH))
+        lib = ctypes.CDLL(str(lib_path))
         lib.sk_group_windows.restype = ctypes.c_int64
         lib.sk_group_windows.argtypes = [
             ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int32,

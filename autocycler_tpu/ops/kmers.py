@@ -85,19 +85,22 @@ def _pack_and_rank_jax(codes: np.ndarray, starts: np.ndarray, k: int):
     return np.asarray(order), np.asarray(gid_sorted)
 
 
-def group_windows(codes: np.ndarray, starts: np.ndarray, k: int,
-                  use_jax: Optional[bool] = None) -> Tuple[np.ndarray, np.ndarray]:
+def group_windows_full(codes: np.ndarray, starts: np.ndarray, k: int,
+                       use_jax: Optional[bool] = None
+                       ) -> Tuple[np.ndarray, np.ndarray]:
     """Group length-k windows of ``codes`` beginning at ``starts``.
 
-    Returns (order, gid_sorted): ``order`` is the stable permutation sorting
-    windows lexicographically; ``gid_sorted[i]`` is the dense group id of
-    window ``order[i]``. Group ids are lexicographic ranks.
+    Returns (gid, order): ``gid[i]`` is window i's dense group id (group ids
+    are lexicographic ranks); ``order`` is the stable permutation grouping
+    windows by gid. Owns ALL backend dispatch: jax opt-in, the fused native
+    kernel, and the numpy lexsort fallback.
     """
-    if len(starts) == 0:
+    n = len(starts)
+    if n == 0:
         return np.zeros(0, np.int64), np.zeros(0, np.int64)
     if k == 0:
         # zero-length windows are all identical (k=1's (k-1)-grams)
-        return np.arange(len(starts), dtype=np.int64), np.zeros(len(starts), np.int64)
+        return np.zeros(n, np.int64), np.arange(n, dtype=np.int64)
     if use_jax is None:
         # XLA's variadic sort has multi-minute compile times on the current
         # TPU platform, so the device path is opt-in; the native hash
@@ -105,16 +108,31 @@ def group_windows(codes: np.ndarray, starts: np.ndarray, k: int,
         use_jax = False
     if use_jax:
         try:
-            return _pack_and_rank_jax(codes, starts, k)
+            order, gid_sorted = _pack_and_rank_jax(codes, starts, k)
+            gid = np.empty(n, np.int64)
+            gid[order] = gid_sorted
+            return gid, order
         except Exception:
             pass
     # fused native pack + hash-grouping kernel (O(n) vs the comparison sort)
     from .. import native
     if native.available():
-        result = native.group_kmers_native(codes, starts, k)
+        result = native.group_kmers_full(codes, starts, k)
         if result is not None:
             return result
-    return _pack_and_rank_numpy(codes, starts, k)
+    order, gid_sorted = _pack_and_rank_numpy(codes, starts, k)
+    gid = np.empty(n, np.int64)
+    gid[order] = gid_sorted
+    return gid, order
+
+
+def group_windows(codes: np.ndarray, starts: np.ndarray, k: int,
+                  use_jax: Optional[bool] = None) -> Tuple[np.ndarray, np.ndarray]:
+    """(order, gid_sorted) view of :func:`group_windows_full` — ``order`` is
+    the stable permutation sorting windows lexicographically and
+    ``gid_sorted[i]`` the group id of window ``order[i]``."""
+    gid, order = group_windows_full(codes, starts, k, use_jax)
+    return order, gid[order]
 
 
 @dataclass
@@ -225,20 +243,11 @@ def build_kmer_index(sequences, k: int, use_jax: Optional[bool] = None) -> KmerI
     starts = np.concatenate(start_runs) if start_runs else np.zeros(0, np.int64)
 
     # ---- k-mer grouping ----
-    # the native kernel hands back per-window ids in ORIGINAL order too,
-    # avoiding a 2M-element random scatter to reconstruct occ_kid
-    from .. import native
-    full = native.group_kmers_full(codes, starts, k) if (
-        use_jax is not True and k > 0 and M and native.available()) else None
-    if full is not None:
-        gid, order = full
-        occ_kid = gid.astype(np.int32)
-        U = int(gid[order[-1]]) + 1 if M else 0
-    else:
-        order, gid_sorted = group_windows(codes, starts, k, use_jax)
-        U = int(gid_sorted[-1]) + 1 if M else 0
-        occ_kid = np.zeros(M, np.int32)
-        occ_kid[order] = gid_sorted
+    # per-window ids come back in ORIGINAL order (no scatter needed to
+    # reconstruct occ_kid); dispatch policy lives in group_windows_full
+    gid, order = group_windows_full(codes, starts, k, use_jax)
+    occ_kid = gid.astype(np.int32)
+    U = int(gid[order[-1]]) + 1 if M else 0
     # occurrences grouped by kid; stable grouping keeps occurrence order
     # inside each group ascending
     group_start = np.zeros(U + 1, np.int64)

@@ -122,12 +122,12 @@ def fastq_reader(filename) -> Iterator[Tuple[str, str, str]]:
             header = f.readline()
             if not header:
                 return
-            seq = f.readline().rstrip("\n")
+            seq = f.readline().rstrip("\r\n")
             plus = f.readline()
-            quals = f.readline().rstrip("\n")
+            quals = f.readline().rstrip("\r\n")
             if not plus:
                 quit_with_error(f"{filename} is not a valid FASTQ file")
-            yield header.rstrip("\n").lstrip("@"), seq, quals
+            yield header.rstrip("\r\n").lstrip("@"), seq, quals
 
 
 def load_file_lines(filename) -> List[str]:

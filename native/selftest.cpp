@@ -127,21 +127,32 @@ static void test_dp(std::mt19937& rng) {
         b[j] = val_dist(rng) * (rng() % 2 ? 1 : -1);
         wb[j] = w_dist(rng);
     }
-    std::vector<double> m((kk + 1) * (kk + 1));
-    sk_overlap_dp(a.data(), wa.data(), b.data(), wb.data(), n, kk, 0, m.data());
-    // oracle: naive recurrence
-    std::vector<double> o((kk + 1) * (kk + 1), 0.0);
-    for (int64_t i = 1; i <= kk; ++i) {
-        for (int64_t j = 1; j <= kk; ++j) {
-            const double match = o[(i - 1) * (kk + 1) + j - 1] +
-                (a[i - 1] == b[j - 1] ? wa[i - 1] : -(wa[i - 1] + wb[j - 1]) / 2);
-            const double del = o[(i - 1) * (kk + 1) + j] - wa[i - 1];
-            const double ins = o[i * (kk + 1) + j - 1] - wb[j - 1];
-            o[i * (kk + 1) + j] = std::max(match, std::max(del, ins));
+    for (int32_t skip_diagonal = 0; skip_diagonal <= 1; ++skip_diagonal) {
+        std::vector<double> m((kk + 1) * (kk + 1));
+        sk_overlap_dp(a.data(), wa.data(), b.data(), wb.data(), n, kk,
+                      skip_diagonal, m.data());
+        // oracle: naive recurrence, with the path-vs-itself diagonal hole
+        // (global_i == global_j stays -inf and blocks the insert chain)
+        const double NEG_INF = -1.0 / 0.0;
+        std::vector<double> o((kk + 1) * (kk + 1), 0.0);
+        for (int64_t i = 1; i <= kk; ++i) {
+            for (int64_t j = 1; j <= kk; ++j) {
+                const int64_t gi = i - 1;
+                const int64_t gj = n - kk + j - 1;
+                if (skip_diagonal && gi == gj) {
+                    o[i * (kk + 1) + j] = NEG_INF;
+                    continue;
+                }
+                const double match = o[(i - 1) * (kk + 1) + j - 1] +
+                    (a[gi] == b[j - 1] ? wa[gi] : -(wa[gi] + wb[j - 1]) / 2);
+                const double del = o[(i - 1) * (kk + 1) + j] - wa[gi];
+                const double ins = o[i * (kk + 1) + j - 1] - wb[j - 1];
+                o[i * (kk + 1) + j] = std::max(match, std::max(del, ins));
+            }
         }
+        for (size_t i = 0; i < m.size(); ++i)
+            CHECK(m[i] == o[i], "DP cell matches oracle exactly");
     }
-    for (size_t i = 0; i < m.size(); ++i)
-        CHECK(m[i] == o[i], "DP cell matches oracle exactly");
 }
 
 int main() {
