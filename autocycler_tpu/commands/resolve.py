@@ -211,6 +211,27 @@ def merge_after_bridging(graph: UnitigGraph) -> None:
     graph.renumber_unitigs()
 
 
+def print_bridges(bridges: List[Bridge], verbose: bool) -> None:
+    """Bridge summary, or every bridge when verbose (reference
+    resolve.rs:316-341)."""
+    unique = [b for b in bridges if not b.conflicting]
+    conflicting = [b for b in bridges if b.conflicting]
+    if verbose:
+        if unique:
+            log.message("Unique bridges:")
+            for b in unique:
+                log.message(f"  {b}")
+        if conflicting:
+            log.message("")
+            log.message("Conflicting bridges:")
+            for b in conflicting:
+                log.message(f"  {b}")
+    else:
+        log.message(f"     Unique bridges: {len(unique)}")
+        log.message(f"Conflicting bridges: {len(conflicting)}")
+    log.message()
+
+
 def cull_ambiguity(bridges: List[Bridge], verbose: bool = False) -> int:
     """Iteratively remove the lowest-depth conflicting bridge until no
     conflicts remain (reference resolve.rs:285-313)."""
@@ -263,10 +284,7 @@ def resolve(cluster_dir, verbose: bool = False) -> None:
     bridge_count = len(bridges)
     bridge_depth = float(len(sequences))
     determine_ambiguity(bridges)
-    unique = sum(not b.conflicting for b in bridges)
-    log.message(f"     Unique bridges: {unique}")
-    log.message(f"Conflicting bridges: {bridge_count - unique}")
-    log.message()
+    print_bridges(bridges, verbose)
 
     log.section_header("Applying unique bridges")
     log.explanation("All unique bridges (those that do not conflict with other bridges) "
