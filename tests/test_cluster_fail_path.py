@@ -1,0 +1,54 @@
+"""A contig present in too few assemblies must land in qc_fail with the
+right reason recorded (reference cluster.rs QC semantics end-to-end)."""
+
+import random
+
+from autocycler_tpu.commands.cluster import cluster
+from autocycler_tpu.commands.compress import compress
+from synthetic import random_genome, rotate
+
+
+def test_rare_contig_fails_qc(tmp_path):
+    rng = random.Random(77)
+    chromosome = random_genome(rng, 3000)
+    stray = random_genome(rng, 800)  # appears in just one assembly
+    asm = tmp_path / "assemblies"
+    asm.mkdir()
+    for i in range(4):
+        chrom = rotate(chromosome, rng.randrange(len(chromosome)))
+        body = f">chrom_{i + 1}\n{chrom}\n"
+        if i == 0:
+            body += f">stray\n{stray}\n"
+        (asm / f"assembly_{i + 1}.fasta").write_text(body)
+    out = tmp_path / "out"
+    compress(asm, out, k_size=51, use_jax=False)
+    cluster(out, use_jax=False)
+
+    pass_dirs = sorted((out / "clustering" / "qc_pass").iterdir())
+    fail_dirs = sorted((out / "clustering" / "qc_fail").iterdir())
+    assert len(pass_dirs) == 1 and len(fail_dirs) == 1
+    tsv = (out / "clustering" / "clustering.tsv").read_text()
+    stray_row = next(l for l in tsv.splitlines() if "stray" in l)
+    assert "\tnone\t" in stray_row  # no passing cluster for the stray contig
+    # failed clusters still get their untrimmed checkpoint for inspection
+    assert (fail_dirs[0] / "1_untrimmed.gfa").is_file()
+    assert (fail_dirs[0] / "1_untrimmed.yaml").is_file()
+
+
+def test_trusted_rescues_rare_contig(tmp_path):
+    rng = random.Random(78)
+    chromosome = random_genome(rng, 3000)
+    stray = random_genome(rng, 800)
+    asm = tmp_path / "assemblies"
+    asm.mkdir()
+    for i in range(4):
+        chrom = rotate(chromosome, rng.randrange(len(chromosome)))
+        body = f">chrom_{i + 1}\n{chrom}\n"
+        if i == 0:
+            body += f">stray Autocycler_trusted\n{stray}\n"
+        (asm / f"assembly_{i + 1}.fasta").write_text(body)
+    out = tmp_path / "out"
+    compress(asm, out, k_size=51, use_jax=False)
+    cluster(out, use_jax=False)
+    pass_dirs = sorted((out / "clustering" / "qc_pass").iterdir())
+    assert len(pass_dirs) == 2  # trusted contig's cluster passes despite rarity
