@@ -101,6 +101,9 @@ def unitig_graph_from_chains(index: KmerIndex, chains: Chains) -> UnitigGraph:
 def build_unitig_graph(sequences: List[Sequence], k: int,
                        use_jax=None) -> UnitigGraph:
     """Sequences (padded, end-repaired) -> compacted unitig graph."""
+    from ..utils import log
     index = build_kmer_index(sequences, k, use_jax=use_jax)
+    log.message(f"Graph contains {index.num_kmers} k-mers")
+    log.message()
     chains = build_chains(index)
     return unitig_graph_from_chains(index, chains)
