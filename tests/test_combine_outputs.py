@@ -1,0 +1,49 @@
+"""combine output contents: YAML metrics fields, GFA offsets, dotplot over a
+directory input."""
+
+import yaml
+
+from autocycler_tpu.commands.combine import combine
+from autocycler_tpu.commands.dotplot import dotplot
+from autocycler_tpu.models import UnitigGraph
+
+from fixtures_gfa import TEST_GFA_8, TEST_GFA_9
+
+
+def test_combine_yaml_and_offsets(tmp_path):
+    g1 = tmp_path / "c1.gfa"
+    g2 = tmp_path / "c2.gfa"
+    g1.write_text(TEST_GFA_8)  # one circular unitig
+    g2.write_text(TEST_GFA_9)  # one linear unitig
+    combine(tmp_path, [g1, g2])
+
+    data = yaml.safe_load((tmp_path / "consensus_assembly.yaml").read_text())
+    assert data["consensus_assembly_unitigs"] == 2
+    assert data["consensus_assembly_bases"] == 38
+    assert data["consensus_assembly_fully_resolved"] is True
+    topologies = [c["topology"] for c in data["consensus_assembly_clusters"]]
+    assert topologies == ["circular", "linear-open-open"]
+
+    fasta = (tmp_path / "consensus_assembly.fasta").read_text()
+    assert ">1 length=19 circular=true topology=circular" in fasta
+    assert ">2 length=19 circular=false topology=linear" in fasta
+
+    # second cluster's unitig is renumbered with an offset; links preserved
+    graph, _ = UnitigGraph.from_gfa_file(tmp_path / "consensus_assembly.gfa")
+    assert sorted(u.number for u in graph.unitigs) == [1, 2]
+    assert graph.index[1].is_isolated_and_circular()
+    assert graph.index[2].is_isolated_and_linear()
+
+
+def test_dotplot_directory_input(tmp_path):
+    d = tmp_path / "assemblies"
+    d.mkdir()
+    import random
+    rng = random.Random(5)
+    s = "".join(rng.choice("ACGT") for _ in range(300))
+    (d / "a.fasta").write_text(f">c1\n{s}\n")
+    (d / "b.fasta").write_text(f">c1\n{s[150:] + s[:150]}\n")
+    out = tmp_path / "plot.png"
+    dotplot(d, out, res=500, kmer=11)
+    from PIL import Image
+    assert Image.open(out).size == (500, 500)
