@@ -29,7 +29,6 @@ import numpy as np
 from .encode import encode_bytes
 
 SYMS_PER_WORD = 10  # 3 bits per symbol in an int32
-_JAX_THRESHOLD = 1_000_000  # windows; below this numpy beats device dispatch
 
 
 def _num_words(k: int) -> int:
