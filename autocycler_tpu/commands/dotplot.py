@@ -73,6 +73,8 @@ def load_dotplot_sequences(input_path) -> List[Tuple[Tuple[str, str], np.ndarray
             for name, _header, seq in load_fasta(assembly):
                 records.append(((assembly.name, name),
                                 np.frombuffer(seq.encode(), dtype=np.uint8)))
+        if not records:
+            quit_with_error("no sequences were loaded")
         return records
     if not input_path.is_file():
         quit_with_error("--input is neither a file nor a directory")
@@ -94,6 +96,8 @@ def load_dotplot_sequences(input_path) -> List[Tuple[Tuple[str, str], np.ndarray
         records = flat
     else:
         quit_with_error("--input is neither GFA or FASTA")
+    if not records:
+        quit_with_error("no sequences were loaded")
     return records
 
 

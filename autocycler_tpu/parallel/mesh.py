@@ -38,6 +38,11 @@ def make_mesh(n_devices: Optional[int] = None, seq_parallel: Optional[int] = Non
 
     devices = jax.devices()
     if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"requested a {n_devices}-device mesh but only {len(devices)} "
+                "device(s) are available; refusing to silently shrink the mesh "
+                "(a 1-device mesh would 'pass' without exercising any collective)")
         devices = devices[:n_devices]
     data, seq = mesh_axis_sizes(len(devices), seq_parallel)
     device_array = np.array(devices).reshape(data, seq)
