@@ -46,8 +46,17 @@ def _build(lib_path: Path) -> bool:
         return False
 
 
+def _stale(lib_path: Path) -> bool:
+    src = _NATIVE_DIR / "seqkernel.cpp"
+    try:
+        return src.is_file() and src.stat().st_mtime > lib_path.stat().st_mtime
+    except OSError:
+        return False
+
+
 def get_lib() -> Optional[ctypes.CDLL]:
-    """The loaded library, building it first if needed; None if unavailable."""
+    """The loaded library, (re)building it first if missing or older than the
+    source; None if unavailable."""
     global _lib, _tried
     if _lib is not None:
         return _lib
@@ -55,8 +64,9 @@ def get_lib() -> Optional[ctypes.CDLL]:
         return None
     _tried = True
     lib_path = _lib_path()
-    if not lib_path.is_file() and not _build(lib_path):
-        return None
+    if (not lib_path.is_file() or _stale(lib_path)) and not _build(lib_path):
+        if not lib_path.is_file():
+            return None
     try:
         lib = ctypes.CDLL(str(lib_path))
         lib.sk_group_windows.restype = ctypes.c_int64
@@ -86,6 +96,22 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
             ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
             ctypes.POINTER(ctypes.c_int64)]
+        try:
+            lib.sk_occ_index_build.restype = ctypes.c_int64
+            lib.sk_occ_index_build.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int64)]
+            lib.sk_occ_index_finish.restype = ctypes.c_int32
+            lib.sk_occ_index_finish.argtypes = [
+                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32)]
+        except AttributeError:
+            lib._has_occ_index = False
+        else:
+            lib._has_occ_index = True
         _lib = lib
         return lib
     except OSError:
@@ -149,6 +175,50 @@ def group_kmers_native(codes: np.ndarray, starts: np.ndarray,
         return None
     gid, order = result
     return order, gid[order]
+
+
+def build_occ_index(codes: np.ndarray, fwd_off: np.ndarray, rev_off: np.ndarray,
+                    seq_len: np.ndarray, k: int) -> Optional[dict]:
+    """Fused occurrence-index build (k <= 55): one native call produces every
+    per-occurrence and per-k-mer array ops.kmers.build_kmer_index needs.
+    Returns a dict of arrays, or None when unavailable (caller falls back)."""
+    lib = get_lib()
+    if lib is None or not getattr(lib, "_has_occ_index", False) or k > 55:
+        return None
+    codes = np.ascontiguousarray(codes, dtype=np.uint8)
+    fwd_off = np.ascontiguousarray(fwd_off, dtype=np.int64)
+    rev_off = np.ascontiguousarray(rev_off, dtype=np.int64)
+    seq_len = np.ascontiguousarray(seq_len, dtype=np.int64)
+    S = len(seq_len)
+    n_f = int(seq_len.sum())
+    out_G = ctypes.c_int64(0)
+    U = lib.sk_occ_index_build(
+        codes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.c_int64(len(codes)),
+        fwd_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        rev_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        seq_len.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.c_int64(S), ctypes.c_int32(k), ctypes.byref(out_G))
+    if U < 0:
+        return None
+    fwd_gid = np.empty(n_f, dtype=np.int32)
+    depth = np.empty(U, dtype=np.int64)
+    rep_byte = np.empty(U, dtype=np.int64)
+    rev_kid = np.empty(U, dtype=np.int32)
+    prefix_gid = np.empty(U, dtype=np.int32)
+    suffix_gid = np.empty(U, dtype=np.int32)
+    rc = lib.sk_occ_index_finish(
+        fwd_gid.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        depth.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        rep_byte.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        rev_kid.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        prefix_gid.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        suffix_gid.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    if rc != 0:
+        return None
+    return dict(U=int(U), G=int(out_G.value), fwd_gid=fwd_gid, depth=depth,
+                rep_byte=rep_byte, rev_kid=rev_kid,
+                prefix_gid=prefix_gid, suffix_gid=suffix_gid)
 
 
 def overlap_dp_native(a_vals: np.ndarray, wa: np.ndarray, b_vals: np.ndarray,

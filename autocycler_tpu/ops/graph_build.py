@@ -21,25 +21,32 @@ from .debruijn import Chains, build_chains
 from .kmers import KmerIndex, build_kmer_index
 
 
-def _positions_for_kmer(index: KmerIndex, kid: int) -> List[Position]:
-    occ = index.kmer_occurrences(kid)
-    seq_idx, strand, pos = index.occ_coords(occ)
-    ids = index.seq_ids[seq_idx]
-    return [Position(int(i), bool(s), int(p)) for i, s, p in zip(ids, strand, pos)]
-
-
 def unitig_graph_from_chains(index: KmerIndex, chains: Chains) -> UnitigGraph:
     graph = UnitigGraph(k_size=index.k)
     k, h = index.k, index.half_k
 
-    # last byte of each unique k-mer's window (for chain sequence assembly)
-    first_occ_byte = index.occ_byte_start(index.first_occ)
-    last_byte = index.buf[first_occ_byte + k - 1]
+    # last byte of each unique k-mer's window (for chain sequence assembly);
+    # any occurrence's bytes are the k-mer itself, so the representative works
+    last_byte = index.buf[index.rep_byte + k - 1]
 
     C = chains.count
     fwd_start_gram = np.zeros(C, np.int64)
     fwd_end_gram = np.zeros(C, np.int64)
     rev_start_gram = np.zeros(C, np.int64)
+
+    # batched position query for every chain head and reverse-complement tail
+    query_kids = np.empty(2 * C, np.int64)
+    for c in range(C):
+        members = chains.chain(c)
+        query_kids[2 * c] = members[0]
+        query_kids[2 * c + 1] = index.rev_kid[members[-1]]
+    positions = index.positions_for_kmers(query_kids) if C else {}
+
+    def _mk_positions(kid: int) -> List[Position]:
+        seq_idx, strand, pos = positions[int(kid)]
+        ids = index.seq_ids[seq_idx]
+        return [Position(int(i), bool(s), int(p))
+                for i, s, p in zip(ids, strand, pos)]
 
     for c in range(C):
         members = chains.chain(c)
@@ -48,14 +55,14 @@ def unitig_graph_from_chains(index: KmerIndex, chains: Chains) -> UnitigGraph:
 
         # untrimmed chain sequence: head k-mer bytes + last byte of each
         # following k-mer; trimming removes half_k from both ends
-        head_bytes = index.buf[first_occ_byte[head]:first_occ_byte[head] + k]
+        head_bytes = index.buf[index.rep_byte[head]:index.rep_byte[head] + k]
         untrimmed = np.concatenate([head_bytes, last_byte[members[1:]]])
         trimmed = untrimmed[h:h + n].copy()
 
         unitig = Unitig(number=c + 1, forward_seq=trimmed)
         unitig.depth = float(index.depth[members].mean())
-        unitig.forward_positions = _positions_for_kmer(index, head)
-        unitig.reverse_positions = _positions_for_kmer(index, int(index.rev_kid[tail]))
+        unitig.forward_positions = _mk_positions(head)
+        unitig.reverse_positions = _mk_positions(index.rev_kid[tail])
         graph.unitigs.append(unitig)
 
         fwd_start_gram[c] = index.prefix_gid[head]

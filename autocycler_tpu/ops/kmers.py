@@ -144,6 +144,13 @@ class KmerIndex:
     L reverse windows. The partner of forward window p is reverse window
     L-1-p (and vice versa), mirroring how the reference adds each k-mer on
     both strands (kmer_graph.rs:103-133).
+
+    Two backends fill this: the fused native kernel stores only per-FORWARD-
+    window ids (``fwd_gid``) and answers occurrence queries by scanning them
+    (every reverse-strand occurrence is the mirror of a forward window of the
+    rc k-mer); the numpy fallback materialises the full per-occurrence arrays
+    (``occ_kid``/``occ_sorted``/...). Both answer :meth:`positions_for_kmers`
+    identically.
     """
 
     k: int
@@ -155,13 +162,9 @@ class KmerIndex:
     fwd_byte_off: np.ndarray     # (S,) offset of forward padded seq in buf
     rev_byte_off: np.ndarray     # (S,)
     occ_off: np.ndarray          # (S,) occurrence-index base (2*L per seq)
-    # per occurrence (M = 2 * sum(L)):
-    occ_kid: np.ndarray          # (M,) unique-kmer id (lexicographic rank)
     # per unique k-mer (U,):
     depth: np.ndarray            # occurrence count
-    first_occ: np.ndarray        # smallest occurrence index in the group
-    occ_sorted: np.ndarray       # (M,) occurrence indices grouped by kid,
-    group_start: np.ndarray      # (U+1,) boundaries into occ_sorted
+    rep_byte: np.ndarray         # byte offset in buf of one occurrence's window
     rev_kid: np.ndarray          # (U,) id of the reverse-complement k-mer
     prefix_gid: np.ndarray       # (U,) (k-1)-gram id of the first k-1 bases
     suffix_gid: np.ndarray       # (U,) (k-1)-gram id of the last k-1 bases
@@ -169,6 +172,13 @@ class KmerIndex:
     in_count: np.ndarray         # (U,) ... on the left
     succ: np.ndarray             # (U,) the unique right-neighbour when out_count==1
     first_pos: np.ndarray        # (U,) bool: any occurrence at window 0
+    # fused-native backend: per forward window (n_f = sum(L)), seq-major
+    fwd_gid: Optional[np.ndarray] = None
+    # numpy-fallback backend: per occurrence (M = 2 * sum(L))
+    occ_kid: Optional[np.ndarray] = None
+    first_occ: Optional[np.ndarray] = None   # smallest occurrence per group
+    occ_sorted: Optional[np.ndarray] = None  # occurrences grouped by kid
+    group_start: Optional[np.ndarray] = None  # (U+1,) boundaries
 
     # ---- occurrence coordinate helpers (vectorised) ----
 
@@ -181,26 +191,74 @@ class KmerIndex:
         pos = np.where(strand, rel, rel - L)
         return seq_idx, strand, pos
 
-    def occ_byte_start(self, occ: np.ndarray) -> np.ndarray:
-        seq_idx, strand, pos = self.occ_coords(occ)
-        base = np.where(strand, self.fwd_byte_off[seq_idx], self.rev_byte_off[seq_idx])
-        return base + pos
-
-    def partner_occ(self, occ: np.ndarray) -> np.ndarray:
-        seq_idx, strand, pos = self.occ_coords(occ)
-        L = self.seq_len[seq_idx]
-        mirrored = L - 1 - pos
-        return self.occ_off[seq_idx] + np.where(strand, L + mirrored, mirrored)
-
     def kmer_occurrences(self, kid: int) -> np.ndarray:
         return self.occ_sorted[self.group_start[kid]:self.group_start[kid + 1]]
+
+    def positions_for_kmers(self, kids: np.ndarray):
+        """{kid: (seq_idx, strand(bool), pos)} for every requested k-mer, in
+        occurrence order (seq ascending; forward windows before reverse
+        windows within a sequence; position ascending)."""
+        kids = np.unique(np.asarray(kids, dtype=np.int64))
+        if self.occ_sorted is not None:
+            out = {}
+            for kid in kids:
+                occ = self.kmer_occurrences(int(kid))
+                out[int(kid)] = self.occ_coords(occ)
+            return out
+
+        # fused backend: one scan over the forward-window ids. A forward
+        # window of group g is a forward occurrence of g AND the mirror of a
+        # reverse occurrence of rc(g) at pos L-1-q.
+        U = self.num_kmers
+        queried = np.zeros(U, bool)
+        queried[kids] = True
+        need = np.zeros(U, bool)
+        need[kids] = True
+        need[self.rev_kid[kids]] = True
+        fwd_win_off = np.zeros(len(self.seq_len) + 1, np.int64)
+        np.cumsum(self.seq_len, out=fwd_win_off[1:])
+        hits = np.flatnonzero(need[self.fwd_gid])
+        hg = self.fwd_gid[hits].astype(np.int64)
+        seq_idx = np.searchsorted(fwd_win_off, hits, side="right") - 1
+        q = hits - fwd_win_off[seq_idx]
+        rk = self.rev_kid[hg]
+        m_fwd = queried[hg]
+        m_rev = queried[rk]
+        kid_all = np.concatenate([hg[m_fwd], rk[m_rev]])
+        seq_all = np.concatenate([seq_idx[m_fwd], seq_idx[m_rev]])
+        strand_all = np.concatenate([np.ones(int(m_fwd.sum()), bool),
+                                     np.zeros(int(m_rev.sum()), bool)])
+        pos_all = np.concatenate(
+            [q[m_fwd], self.seq_len[seq_idx[m_rev]] - 1 - q[m_rev]])
+        order = np.lexsort((pos_all, ~strand_all, seq_all, kid_all))
+        kid_sorted = kid_all[order]
+        lo = np.searchsorted(kid_sorted, kids, side="left")
+        hi = np.searchsorted(kid_sorted, kids, side="right")
+        return {int(kid): (seq_all[order[a:b]], strand_all[order[a:b]],
+                           pos_all[order[a:b]])
+                for kid, a, b in zip(kids, lo, hi)}
 
     @property
     def num_kmers(self) -> int:
         return len(self.depth)
 
 
-def build_kmer_index(sequences, k: int, use_jax: Optional[bool] = None) -> KmerIndex:
+def _adjacency(prefix_gid: np.ndarray, suffix_gid: np.ndarray, G: int):
+    """Neighbour counts over UNIQUE k-mers (next_kmers/prev_kmers semantics,
+    kmer_graph.rs:136-166) by (k-1)-gram id equality."""
+    U = len(prefix_gid)
+    cnt_prefix = np.bincount(prefix_gid, minlength=G)
+    cnt_suffix = np.bincount(suffix_gid, minlength=G)
+    out_count = cnt_prefix[suffix_gid]
+    in_count = cnt_suffix[prefix_gid]
+    succ_by_gram = np.full(G, -1, np.int64)
+    succ_by_gram[prefix_gid] = np.arange(U)
+    succ = succ_by_gram[suffix_gid]  # valid only where out_count == 1
+    return out_count, in_count, succ
+
+
+def build_kmer_index(sequences, k: int, use_jax: Optional[bool] = None,
+                     use_fused: Optional[bool] = None) -> KmerIndex:
     """Build the k-mer index from Sequence objects (padded, with bytes).
 
     Parity notes: every k-window of every padded sequence on both strands is
@@ -209,6 +267,11 @@ def build_kmer_index(sequences, k: int, use_jax: Optional[bool] = None) -> KmerI
     sequence are flagged (Kmer::first_position, kmer_graph.rs:57-60); right
     and left neighbour counts replace next_kmers/prev_kmers probing
     (kmer_graph.rs:136-166).
+
+    Backends: the fused native kernel (native/seqkernel.cpp
+    sk_occ_index_build, k <= 55) produces every array in one pass and is the
+    default; the numpy/jax grouping pipeline below is the exact fallback and
+    parity oracle (use_fused=False forces it).
     """
     half_k = k // 2
     S = len(sequences)
@@ -231,6 +294,31 @@ def build_kmer_index(sequences, k: int, use_jax: Optional[bool] = None) -> KmerI
     if S > 1:
         occ_off[1:] = np.cumsum(2 * seq_len)[:-1]
     M = int(2 * seq_len.sum())
+
+    if use_fused is None:
+        use_fused = use_jax is not True
+    from .. import native
+    if use_fused and M and native.available():
+        res = native.build_occ_index(codes, fwd_off, rev_off, seq_len, k)
+        if res is not None:
+            U, G = res["U"], res["G"]
+            fwd_gid, rev_kid = res["fwd_gid"], res["rev_kid"]
+            # window-0 occurrences: forward window 0 per sequence, and
+            # reverse window 0 (= mirror of the LAST forward window)
+            fwd_win_off = np.zeros(S + 1, np.int64)
+            np.cumsum(seq_len, out=fwd_win_off[1:])
+            first_pos = np.zeros(U, bool)
+            first_pos[fwd_gid[fwd_win_off[:-1]]] = True
+            first_pos[rev_kid[fwd_gid[fwd_win_off[1:] - 1]]] = True
+            out_count, in_count, succ = _adjacency(res["prefix_gid"],
+                                                   res["suffix_gid"], G)
+            return KmerIndex(
+                k=k, half_k=half_k, buf=buf, seq_ids=seq_ids, seq_len=seq_len,
+                fwd_byte_off=fwd_off, rev_byte_off=rev_off, occ_off=occ_off,
+                depth=res["depth"], rep_byte=res["rep_byte"], rev_kid=rev_kid,
+                prefix_gid=res["prefix_gid"], suffix_gid=res["suffix_gid"],
+                out_count=out_count, in_count=in_count, succ=succ,
+                first_pos=first_pos, fwd_gid=fwd_gid)
 
     # byte start of every occurrence window, built per contiguous strand run
     # (avoids materialising seq/strand/pos arrays of size M)
@@ -286,19 +374,13 @@ def build_kmer_index(sequences, k: int, use_jax: Optional[bool] = None) -> KmerI
     prefix_gid = gram_gid[:U]
     suffix_gid = gram_gid[U:]
 
-    # neighbour counts over UNIQUE k-mers (next_kmers/prev_kmers semantics)
-    cnt_prefix = np.bincount(prefix_gid, minlength=G)
-    cnt_suffix = np.bincount(suffix_gid, minlength=G)
-    out_count = cnt_prefix[suffix_gid]
-    in_count = cnt_suffix[prefix_gid]
-    succ_by_gram = np.full(G, -1, np.int64)
-    succ_by_gram[prefix_gid] = np.arange(U)
-    succ = succ_by_gram[suffix_gid]  # valid only where out_count == 1
+    out_count, in_count, succ = _adjacency(prefix_gid, suffix_gid, G)
 
     return KmerIndex(
         k=k, half_k=half_k, buf=buf, seq_ids=seq_ids, seq_len=seq_len,
         fwd_byte_off=fwd_off, rev_byte_off=rev_off, occ_off=occ_off,
-        occ_kid=occ_kid, depth=depth, first_occ=first_occ,
-        occ_sorted=order, group_start=group_start, rev_kid=rev_kid,
+        depth=depth, rep_byte=rep_byte, rev_kid=rev_kid,
         prefix_gid=prefix_gid, suffix_gid=suffix_gid,
-        out_count=out_count, in_count=in_count, succ=succ, first_pos=first_pos)
+        out_count=out_count, in_count=in_count, succ=succ, first_pos=first_pos,
+        occ_kid=occ_kid, first_occ=first_occ, occ_sorted=order,
+        group_start=group_start)

@@ -14,8 +14,12 @@
 // ABI: plain C, driven from Python via ctypes (no pybind11 dependency).
 
 #include <cstdint>
+#include <cstdio>
+#include <cstdlib>
 #include <cstring>
+#include <ctime>
 #include <algorithm>
+#include <memory>
 #include <vector>
 
 namespace {
@@ -433,6 +437,453 @@ int64_t sk_scan_gram_matches(const uint8_t* codes,
         }
     }
     return count;
+}
+
+}  // extern "C"
+
+// ===========================================================================
+// Fused occurrence-index kernel (k <= 55).
+//
+// Builds, in one native pass, everything ops/kmers.py:build_kmer_index needs:
+// per-occurrence group ids, grouped occurrence order, group boundaries, first
+// occurrences, reverse-complement partner ids, and (k-1)-gram adjacency ids.
+// This replaces the reference's per-base double hash upsert
+// (kmer_graph.rs:86-134) AND the numpy occurrence passes around the round-1
+// grouping kernel.
+//
+// Design notes (why this is fast on one core):
+// - k-mers are base-5 values in an unsigned __int128 ('.'=0 < A < C < G < T,
+//   same codes as ops/encode.py), so value order == byte-lexicographic order,
+//   keys are 16 bytes, compares are exact, and the next window is one
+//   multiply-add (rolling update) instead of a 51-symbol repack.
+// - only FORWARD-strand windows are hashed (half the work); every
+//   reverse-strand window is the reverse complement of a forward window of
+//   the same sequence (rev pos p  <->  fwd pos L-1-p), so reverse-strand ids
+//   come from a per-GROUP rc map (U probes instead of n_f).
+// - the table stores {hash, gid, rep}; full keys live in a dense per-group
+//   array (16 B/group), so the table stays small and the compare touches one
+//   cache line. Windows are processed in blocks with the table slot
+//   prefetched one stage ahead.
+// - lexicographic ranks come from a single top-20-bit bucket scatter plus
+//   tiny per-bucket sorts (keys are near-uniform), not a comparison sort
+//   over all groups.
+// - the grouped-occurrence counting sort is radix-partitioned by gid range
+//   so the scatter hits a cache-resident slice of the counts/output.
+// - (k-1)-gram keys are derived arithmetically per unique k-mer: prefix
+//   gram = (key - key%5)/5 (drop last symbol), suffix gram = key mod 5^(k-1)
+//   (drop first symbol) — no second scan over the input.
+
+namespace occidx {
+
+typedef unsigned __int128 u128;
+
+// phase timing to stderr when AUTOCYCLER_NATIVE_DEBUG is set
+struct PhaseTimer {
+    const bool on;
+    timespec last;
+    PhaseTimer() : on(getenv("AUTOCYCLER_NATIVE_DEBUG") != nullptr) {
+        clock_gettime(CLOCK_MONOTONIC, &last);
+    }
+    void mark(const char* name) {
+        if (!on) return;
+        timespec now;
+        clock_gettime(CLOCK_MONOTONIC, &now);
+        fprintf(stderr, "[seqkernel] %-22s %.3fs\n", name,
+                (now.tv_sec - last.tv_sec) + (now.tv_nsec - last.tv_nsec) * 1e-9);
+        last = now;
+    }
+};
+
+static inline uint64_t mix64(uint64_t x) {
+    x ^= x >> 30; x *= 0xBF58476D1CE4E5B9ull;
+    x ^= x >> 27; x *= 0x94D049BB133111EBull;
+    x ^= x >> 31;
+    return x;
+}
+
+static inline uint64_t hash_key(u128 v) {
+    return (mix64(static_cast<uint64_t>(v) ^ 0x9E3779B97F4A7C15ull) ^
+            mix64(static_cast<uint64_t>(v >> 64) + 0xD1B54A32D192ED03ull)) | 1;
+}
+
+// key % 5 without 128-bit division: 2^64 == 1 (mod 5)
+static inline uint32_t mod5(u128 v) {
+    return static_cast<uint32_t>(
+        (static_cast<uint64_t>(v) % 5 + static_cast<uint64_t>(v >> 64) % 5) % 5);
+}
+
+// multiplicative inverse of 5 mod 2^128 (for exact division by 5)
+static u128 inv5_u128() {
+    u128 x = 1;
+    for (int i = 0; i < 7; ++i) x *= 2 - static_cast<u128>(5) * x;  // Newton
+    return x;
+}
+
+struct Entry {
+    uint64_t hash;  // 0 = empty
+    uint32_t gid;
+    uint32_t rep;   // byte offset of a forward occurrence (UINT32_MAX: none)
+};
+
+struct Table {
+    std::vector<Entry> slots;
+    uint64_t cap = 0;
+
+    bool init(uint64_t min_cap) {
+        cap = 1 << 16;
+        while (cap < min_cap * 2) cap <<= 1;
+        try { slots.assign(cap, Entry{0, 0, 0}); } catch (...) { return false; }
+        return true;
+    }
+
+    bool grow() {
+        const uint64_t new_cap = cap * 2;
+        std::vector<Entry> bigger;
+        try { bigger.assign(new_cap, Entry{0, 0, 0}); } catch (...) { return false; }
+        const uint64_t new_mask = new_cap - 1;
+        for (const Entry& e : slots) {
+            if (e.hash == 0) continue;
+            uint64_t s = e.hash & new_mask;
+            while (bigger[s].hash != 0) s = (s + 1) & new_mask;
+            bigger[s] = e;
+        }
+        slots.swap(bigger);
+        cap = new_cap;
+        return true;
+    }
+
+    // find-or-insert; key storage is the caller's dense per-group array
+    inline uint32_t upsert(u128 key, uint64_t h, uint32_t rep,
+                           std::vector<u128>& keys) {
+        const uint64_t mask = cap - 1;
+        uint64_t s = h & mask;
+        for (;;) {
+            Entry& e = slots[s];
+            if (e.hash == 0) {
+                e.hash = h;
+                e.gid = static_cast<uint32_t>(keys.size());
+                e.rep = rep;
+                keys.push_back(key);
+                return e.gid;
+            }
+            if (e.hash == h && keys[e.gid] == key) return e.gid;
+            s = (s + 1) & mask;
+        }
+    }
+};
+
+struct State {
+    int64_t S = 0, n_f = 0, U = 0, G = 0;
+    int32_t k = 0;
+    std::vector<int64_t> seq_len, occ_off;
+    std::vector<int32_t> gid_f;                     // per fwd window, FINAL rank
+    std::vector<int64_t> depth, rep_byte;           // per final gid
+    std::vector<int32_t> rev_kid, prefix_gid, suffix_gid;  // per final gid
+};
+
+static std::unique_ptr<State> g_state;
+
+// Dense first-seen ids for a key array (gram grouping; order is irrelevant
+// because gram ids are only ever joined on equality).
+static int64_t dense_ids(const std::vector<u128>& keys, int32_t* out) {
+    const int64_t n = static_cast<int64_t>(keys.size());
+    try {
+        Table table;
+        if (!table.init(static_cast<uint64_t>(n))) return -1;
+        std::vector<u128> uniq;
+        uniq.reserve(n);
+        for (int64_t i = 0; i < n; ++i) {
+            if ((uniq.size() + 1) * 2 > table.cap && !table.grow()) return -1;
+            const u128 key = keys[i];
+            out[i] = static_cast<int32_t>(
+                table.upsert(key, hash_key(key), UINT32_MAX, uniq));
+        }
+        return static_cast<int64_t>(uniq.size());
+    } catch (...) {
+        return -1;
+    }
+}
+
+}  // namespace occidx
+
+extern "C" {
+
+// Phase 1 of the fused index build. codes: the concatenated padded buffer
+// (values 0..4, per sequence forward strand then reverse strand). Per
+// sequence there are L = seq_len[s] forward windows starting at
+// fwd_off[s]..fwd_off[s]+L-1 and L reverse windows likewise at rev_off[s].
+// Returns the number of distinct k-mers U (group ids are lexicographic
+// ranks), or -1 on failure. out_G receives the number of distinct
+// (k-1)-grams. State is retained for sk_occ_index_finish.
+static int64_t occ_index_build_impl(const uint8_t* codes, int64_t n_codes,
+                                    const int64_t* fwd_off, const int64_t* rev_off,
+                                    const int64_t* seq_len, int64_t S, int32_t k,
+                                    int64_t* out_G) {
+    using namespace occidx;
+    (void)rev_off;
+    if (k < 1 || k > 55) return -1;
+
+    int64_t n_f = 0;
+    for (int64_t s = 0; s < S; ++s) n_f += seq_len[s];
+    if (n_f > INT32_MAX / 2 || n_codes > UINT32_MAX) return -1;  // ids are i32
+
+    PhaseTimer pt;
+    auto state = std::make_unique<State>();
+    state->S = S;
+    state->n_f = n_f;
+    state->k = k;
+    state->seq_len.assign(seq_len, seq_len + S);
+    state->occ_off.resize(S);
+    int64_t acc = 0;
+    for (int64_t s = 0; s < S; ++s) { state->occ_off[s] = acc; acc += 2 * seq_len[s]; }
+
+    u128 pow5k1 = 1;                       // 5^(k-1)
+    for (int32_t i = 1; i < k; ++i) pow5k1 *= 5;
+
+    // ---- phase A: hash forward windows (rolling base-5 keys) ----
+    Table table;
+    if (!table.init(1 << 15)) return -1;
+    std::vector<u128> keys;                // per provisional gid
+    try {
+        state->gid_f.resize(n_f);
+        keys.reserve(1 << 16);
+    } catch (...) { return -1; }
+
+    constexpr int64_t BLOCK = 128;
+    u128 win_keys[BLOCK];
+    uint64_t win_hash[BLOCK];
+    for (int64_t s = 0; s < S; ++s) {
+        const uint8_t* base = codes + fwd_off[s];
+        const int64_t L = seq_len[s];
+        int32_t* gout = state->gid_f.data() +
+            (state->occ_off[s] / 2);       // forward windows are the first half
+        u128 cur = 0;
+        for (int64_t p0 = 0; p0 < L; p0 += BLOCK) {
+            const int64_t pe = std::min(p0 + BLOCK, L);
+            if ((keys.size() + BLOCK) * 2 > table.cap && !table.grow()) return -1;
+            const uint64_t mask = table.cap - 1;
+            for (int64_t p = p0; p < pe; ++p) {
+                if (p == 0) {
+                    cur = 0;
+                    for (int32_t j = 0; j < k; ++j) cur = cur * 5 + base[j];
+                } else {
+                    cur = (cur - base[p - 1] * pow5k1) * 5 + base[p + k - 1];
+                }
+                const uint64_t h = hash_key(cur);
+                win_keys[p - p0] = cur;
+                win_hash[p - p0] = h;
+                __builtin_prefetch(&table.slots[h & mask], 0, 1);
+            }
+            for (int64_t p = p0; p < pe; ++p) {
+                gout[p] = static_cast<int32_t>(table.upsert(
+                    win_keys[p - p0], win_hash[p - p0],
+                    static_cast<uint32_t>(fwd_off[s] + p), keys));
+            }
+        }
+    }
+    const int64_t U_f = static_cast<int64_t>(keys.size());
+    pt.mark("A fwd hash");
+
+    // ---- phase B: reverse-complement map over GROUPS ----
+    // rc keys are recomputed from each group's representative window bytes
+    // (rep byte offsets were recorded at insert time, recovered here from the
+    // table to avoid a dense side array during phase A)
+    std::vector<int32_t> rc_of;
+    std::vector<uint32_t> rep_of;
+    try {
+        rc_of.resize(U_f, -1);
+        rep_of.resize(U_f, UINT32_MAX);
+    } catch (...) { return -1; }
+    for (const Entry& e : table.slots) {
+        if (e.hash != 0 && e.rep != UINT32_MAX) rep_of[e.gid] = e.rep;
+    }
+    for (int64_t g = 0; g < U_f; ++g) {
+        if ((keys.size() + 1) * 2 > table.cap && !table.grow()) return -1;
+        const uint8_t* w = codes + rep_of[g];
+        u128 rk = 0;
+        for (int32_t j = k - 1; j >= 0; --j) {
+            const uint32_t c = w[j];
+            rk = rk * 5 + (c ? 5 - c : 0);  // complement: .->., A<->T, C<->G
+        }
+        const uint32_t g2 = table.upsert(rk, hash_key(rk), UINT32_MAX, keys);
+        if (static_cast<size_t>(g2) >= rc_of.size()) {
+            rc_of.resize(g2 + 1, -1);
+            rc_of[g2] = static_cast<int32_t>(g);
+        }
+        rc_of[g] = static_cast<int32_t>(g2);
+    }
+    const int64_t U = static_cast<int64_t>(keys.size());
+    pt.mark("B rc map");
+    state->U = U;
+    table.slots.clear();
+    table.slots.shrink_to_fit();
+
+    // ---- phase C: lexicographic ranks via top-bit buckets ----
+    std::vector<int32_t> lex_rank;
+    try { lex_rank.resize(U); } catch (...) { return -1; }
+    {
+        u128 max_key = pow5k1 * 5 - 1;     // 5^k - 1
+        int bitlen = 128;                  // shifts must stay < 128 (UB)
+        while (bitlen > 1 && !((max_key >> (bitlen - 1)) & 1)) --bitlen;
+        const int shift = bitlen > 20 ? bitlen - 20 : 0;
+        const int64_t NB = static_cast<int64_t>((max_key >> shift)) + 2;
+        struct KG { u128 key; uint32_t gid; };
+        std::vector<int64_t> bstart(NB + 1, 0);
+        std::vector<KG> sorted;
+        try { sorted.resize(U); } catch (...) { return -1; }
+        for (int64_t g = 0; g < U; ++g)
+            ++bstart[static_cast<int64_t>(keys[g] >> shift) + 1];
+        for (int64_t b = 0; b < NB; ++b) bstart[b + 1] += bstart[b];
+        std::vector<int64_t> cur(bstart.begin(), bstart.end() - 1);
+        for (int64_t g = 0; g < U; ++g) {
+            const int64_t b = static_cast<int64_t>(keys[g] >> shift);
+            sorted[cur[b]++] = KG{keys[g], static_cast<uint32_t>(g)};
+        }
+        for (int64_t b = 0; b < NB; ++b) {
+            std::sort(sorted.begin() + bstart[b], sorted.begin() + bstart[b + 1],
+                      [](const KG& a, const KG& c) { return a.key < c.key; });
+        }
+        for (int64_t r = 0; r < U; ++r) lex_rank[sorted[r].gid] = static_cast<int32_t>(r);
+        // reorder keys into rank order for the gram phase
+        std::vector<u128> ranked;
+        try { ranked.resize(U); } catch (...) { return -1; }
+        for (int64_t r = 0; r < U; ++r) ranked[r] = sorted[r].key;
+        keys.swap(ranked);
+    }
+
+    pt.mark("C ranks");
+
+    // ---- final per-group outputs: rev_kid, rep_byte + gram ids ----
+    try {
+        state->rev_kid.resize(U);
+        state->rep_byte.resize(U);
+        state->prefix_gid.resize(U);
+        state->suffix_gid.resize(U);
+    } catch (...) { return -1; }
+    for (int64_t g = 0; g < U; ++g)
+        state->rev_kid[lex_rank[g]] = lex_rank[rc_of[g]];
+
+    // representative byte offset per group: any occurrence's bytes are the
+    // k-mer itself, so forward groups use their first-insert window and
+    // rc-only groups use the reverse-strand mirror of their partner's window
+    // (rev byte start = rev_off[s] + L-1-q for partner forward window q)
+    for (int64_t g = 0; g < U_f; ++g)
+        state->rep_byte[lex_rank[g]] = rep_of[g];
+    for (int64_t g = U_f; g < U; ++g) {
+        const int64_t partner = rc_of[g];
+        const int64_t rep = rep_of[partner];
+        int64_t lo = 0, hi = S - 1;        // find the sequence containing rep
+        while (lo < hi) {
+            const int64_t mid = (lo + hi + 1) / 2;
+            if (fwd_off[mid] <= rep) lo = mid; else hi = mid - 1;
+        }
+        state->rep_byte[lex_rank[g]] =
+            rev_off[lo] + (seq_len[lo] - 1 - (rep - fwd_off[lo]));
+    }
+
+    {
+        const u128 inv5 = inv5_u128();
+        std::vector<u128> gram_keys;
+        try { gram_keys.resize(2 * U); } catch (...) { return -1; }
+        for (int64_t r = 0; r < U; ++r) {
+            const u128 key = keys[r];
+            gram_keys[r] = (key - mod5(key)) * inv5;   // drop last symbol
+            u128 sfx = key;                            // drop first symbol
+            while (sfx >= pow5k1) sfx -= pow5k1;
+            gram_keys[U + r] = sfx;
+        }
+        std::vector<int32_t> gids;
+        try { gids.resize(2 * U); } catch (...) { return -1; }
+        const int64_t G = dense_ids(gram_keys, gids.data());
+        if (G < 0) return -1;
+        state->G = G;
+        std::copy(gids.begin(), gids.begin() + U, state->prefix_gid.begin());
+        std::copy(gids.begin() + U, gids.end(), state->suffix_gid.begin());
+    }
+
+    pt.mark("F grams");
+
+    // ---- rewrite forward window ids to final ranks + forward counts ----
+    // depth[g] = (forward occurrences of g) + (forward occurrences of rc(g)):
+    // every reverse-strand occurrence of g is the mirror of a forward window
+    // of rc(g), so no occurrence-level pass is needed.
+    {
+        std::vector<int64_t> fwd_cnt;
+        try {
+            fwd_cnt.assign(U, 0);
+            state->depth.resize(U);
+        } catch (...) { return -1; }
+        int32_t* gf = state->gid_f.data();
+        for (int64_t i = 0; i < n_f; ++i) {
+            const int32_t r = lex_rank[gf[i]];
+            gf[i] = r;
+            ++fwd_cnt[r];
+        }
+        for (int64_t r = 0; r < U; ++r)
+            state->depth[r] = fwd_cnt[r] + fwd_cnt[state->rev_kid[r]];
+    }
+
+    pt.mark("A2 ranks+counts");
+    *out_G = state->G;
+    g_state = std::move(state);
+    return U;
+}
+
+// Phase 2: fills caller-allocated buffers and releases the retained state.
+// No occurrence-level arrays are materialised — position queries run over
+// fwd_gid on the Python side (KmerIndex.positions_for_kmers).
+//   fwd_gid     [n_f] i32  group id per FORWARD window, sequence-major
+//   depth       [U]  i64   occurrence count (both strands)
+//   rep_byte    [U]  i64   byte offset of one occurrence's window in codes
+//   rev_kid     [U]  i32   group id of the reverse-complement k-mer
+//   prefix_gid  [U]  i32   (k-1)-gram id of symbols 0..k-2
+//   suffix_gid  [U]  i32   (k-1)-gram id of symbols 1..k-1
+// Returns 0, or -1 if no build state is pending.
+static int32_t occ_index_finish_impl(int32_t* fwd_gid, int64_t* depth,
+                                     int64_t* rep_byte, int32_t* rev_kid,
+                                     int32_t* prefix_gid, int32_t* suffix_gid) {
+    using namespace occidx;
+    if (!g_state) return -1;
+    PhaseTimer pt2;
+    std::unique_ptr<State> state = std::move(g_state);
+    const int64_t U = state->U;
+
+    std::memcpy(fwd_gid, state->gid_f.data(), sizeof(int32_t) * state->n_f);
+    std::memcpy(depth, state->depth.data(), sizeof(int64_t) * U);
+    std::memcpy(rep_byte, state->rep_byte.data(), sizeof(int64_t) * U);
+    std::memcpy(rev_kid, state->rev_kid.data(), sizeof(int32_t) * U);
+    std::memcpy(prefix_gid, state->prefix_gid.data(), sizeof(int32_t) * U);
+    std::memcpy(suffix_gid, state->suffix_gid.data(), sizeof(int32_t) * U);
+    pt2.mark("finish copy");
+    return 0;
+}
+
+// Exception-safe extern entry points: any allocation failure inside the
+// build (including push_back/reserve growth) must surface as -1 across the
+// ctypes boundary, never as an exception.
+int64_t sk_occ_index_build(const uint8_t* codes, int64_t n_codes,
+                           const int64_t* fwd_off, const int64_t* rev_off,
+                           const int64_t* seq_len, int64_t S, int32_t k,
+                           int64_t* out_G) {
+    try {
+        return occ_index_build_impl(codes, n_codes, fwd_off, rev_off, seq_len,
+                                    S, k, out_G);
+    } catch (...) {
+        occidx::g_state.reset();
+        return -1;
+    }
+}
+
+int32_t sk_occ_index_finish(int32_t* fwd_gid, int64_t* depth, int64_t* rep_byte,
+                            int32_t* rev_kid, int32_t* prefix_gid,
+                            int32_t* suffix_gid) {
+    try {
+        return occ_index_finish_impl(fwd_gid, depth, rep_byte, rev_kid,
+                                     prefix_gid, suffix_gid);
+    } catch (...) {
+        occidx::g_state.reset();
+        return -1;
+    }
 }
 
 // Weighted path-overlap DP (the trim kernel): fills the (kk+1)^2 scoring

@@ -26,6 +26,51 @@ def mutate(rng: random.Random, seq: str, n_snps: int) -> str:
     return "".join(seq)
 
 
+def random_genome_fast(np_rng, length: int) -> str:
+    """numpy-backed random genome for Mbp-scale bench configurations."""
+    import numpy as np
+    alphabet = np.frombuffer(b"ACGT", dtype=np.uint8)
+    return alphabet[np_rng.integers(0, 4, size=length)].tobytes().decode()
+
+
+def mutate_fast(np_rng, seq: str, n_snps: int) -> str:
+    import numpy as np
+    arr = np.frombuffer(seq.encode(), dtype=np.uint8).copy()
+    sites = np_rng.choice(len(arr), size=n_snps, replace=False)
+    alphabet = np.frombuffer(b"ACGT", dtype=np.uint8)
+    subs = alphabet[np_rng.integers(0, 4, size=n_snps)]
+    clash = subs == arr[sites]
+    while clash.any():
+        subs[clash] = alphabet[np_rng.integers(0, 4, size=int(clash.sum()))]
+        clash = subs == arr[sites]
+    arr[sites] = subs
+    return arr.tobytes().decode()
+
+
+def make_assemblies_fast(tmp_path, n_assemblies=24, chromosome_len=6_000_000,
+                         plasmid_len=120_000, n_snps=600, seed=7):
+    """The BASELINE.md headline configuration (24 assemblies of a 6 Mbp
+    genome + 120 kb plasmid, light SNPs), generated with numpy so dataset
+    creation is seconds rather than minutes. Same shape as make_assemblies:
+    rotated replicon copies, alternate-assembly reverse-complement plasmids."""
+    import numpy as np
+    np_rng = np.random.default_rng(seed)
+    chromosome = random_genome_fast(np_rng, chromosome_len)
+    plasmid = random_genome_fast(np_rng, plasmid_len)
+    asm_dir = tmp_path / "assemblies"
+    asm_dir.mkdir(parents=True, exist_ok=True)
+    for i in range(n_assemblies):
+        chrom = rotate(chromosome, int(np_rng.integers(0, chromosome_len)))
+        plas = rotate(plasmid, int(np_rng.integers(0, plasmid_len)))
+        if i % 2 == 1:
+            plas = revcomp(plas)
+        if n_snps:
+            chrom = mutate_fast(np_rng, chrom, n_snps)
+        (asm_dir / f"assembly_{i + 1}.fasta").write_text(
+            f">chromosome_{i + 1}\n{chrom}\n>plasmid_{i + 1}\n{plas}\n")
+    return asm_dir
+
+
 def make_assemblies(tmp_path, n_assemblies=4, chromosome_len=6000, plasmid_len=800,
                     n_snps=0, seed=42, rotate_contigs=True):
     """Write n FASTA files, each containing a rotated (and optionally lightly

@@ -34,14 +34,39 @@ def test_group_windows_jax_flag():
 
 
 def test_full_index_identical_across_backends():
+    """The fused native kernel, the numpy fallback, and the jax path must
+    agree on every semantic field; the fused path additionally answers
+    position queries identically to the occurrence arrays."""
     seqs = [Sequence.with_seq(i + 1, s, "a.fasta", f"c{i}", 10)
             for i, s in enumerate([
                 "ACGTACGTACGTACGTAACCGGTTACGT" * 3,
                 "TTGGCCAAACGTACGTACGTACGTAACC" * 3,
             ])]
-    a = build_kmer_index(seqs, 21, use_jax=False)
-    b = build_kmer_index(seqs, 21, use_jax=True)
-    for field in ("occ_kid", "depth", "first_occ", "rev_kid", "prefix_gid",
-                  "suffix_gid", "out_count", "in_count", "first_pos",
-                  "occ_sorted", "group_start"):
-        assert (getattr(a, field) == getattr(b, field)).all(), field
+    fused = build_kmer_index(seqs, 21, use_fused=True)
+    assert fused.fwd_gid is not None, \
+        "fused native backend unavailable — parity test would be vacuous"
+    fallback = build_kmer_index(seqs, 21, use_fused=False)
+    assert fallback.occ_sorted is not None
+    jaxed = build_kmer_index(seqs, 21, use_jax=True)
+    U = fallback.num_kmers
+    for field in ("depth", "rev_kid", "out_count", "in_count", "first_pos",
+                  "succ"):
+        assert (getattr(fallback, field) == getattr(jaxed, field)).all(), field
+        assert (getattr(fused, field) == getattr(fallback, field)).all(), field
+    # representative bytes must be the k-mer itself, whichever occurrence
+    for g in range(U):
+        assert np.array_equal(
+            fused.buf[fused.rep_byte[g]:fused.rep_byte[g] + 21],
+            fallback.buf[fallback.rep_byte[g]:fallback.rep_byte[g] + 21]), g
+    # gram ids may be relabelled between backends but must have the same
+    # equality structure
+    pair = np.stack([
+        np.concatenate([fused.prefix_gid, fused.suffix_gid]).astype(np.int64),
+        np.concatenate([fallback.prefix_gid, fallback.suffix_gid]).astype(np.int64)])
+    assert np.unique(pair, axis=1).shape[1] == len(np.unique(pair[0]))
+    # position queries agree for every k-mer
+    pa = fused.positions_for_kmers(np.arange(U))
+    pb = fallback.positions_for_kmers(np.arange(U))
+    for g in range(U):
+        for x, y in zip(pa[g], pb[g]):
+            assert np.array_equal(np.asarray(x), np.asarray(y)), g
