@@ -48,9 +48,14 @@ def trim(cluster_dir, min_identity: float = 0.75, max_unitigs: int = 5000,
     graph.print_basic_graph_info()
     weights = {u.number: u.length() for u in graph.unitigs}
 
+    # one path query serves both trimming passes (the graph is unchanged
+    # until choose_trim_type applies the results)
+    all_paths = graph.get_unitig_paths_for_sequences([s.id for s in sequences]) \
+        if max_unitigs else {}
     start_end = trim_start_end_overlap(graph, sequences, weights, min_identity,
-                                       max_unitigs)
-    hairpin = trim_hairpin_overlap(graph, sequences, weights, min_identity, max_unitigs)
+                                       max_unitigs, all_paths)
+    hairpin = trim_hairpin_overlap(graph, sequences, weights, min_identity,
+                                   max_unitigs, all_paths)
     sequences = choose_trim_type(start_end, hairpin, graph, sequences)
     sequences = exclude_outliers_in_length(graph, sequences, mad)
     clean_up_graph(graph, sequences)
@@ -63,12 +68,13 @@ def trim(cluster_dir, min_identity: float = 0.75, max_unitigs: int = 5000,
 
 def trim_start_end_overlap(graph: UnitigGraph, sequences: List[Sequence],
                            weights: Dict[int, int], min_identity: float,
-                           max_unitigs: int) -> List[TrimResult]:
+                           max_unitigs: int, all_paths=None) -> List[TrimResult]:
     """Per-sequence circular start-end trimming (reference trim.rs:113-136).
     A max_unitigs of 0 disables trimming."""
     if max_unitigs == 0:
         return [None] * len(sequences)
-    all_paths = graph.get_unitig_paths_for_sequences([s.id for s in sequences])
+    if all_paths is None:
+        all_paths = graph.get_unitig_paths_for_sequences([s.id for s in sequences])
     results: List[TrimResult] = []
     for seq in sequences:
         path = [n if s else -n for n, s in all_paths[seq.id]]
@@ -86,11 +92,12 @@ def trim_start_end_overlap(graph: UnitigGraph, sequences: List[Sequence],
 
 def trim_hairpin_overlap(graph: UnitigGraph, sequences: List[Sequence],
                          weights: Dict[int, int], min_identity: float,
-                         max_unitigs: int) -> List[TrimResult]:
+                         max_unitigs: int, all_paths=None) -> List[TrimResult]:
     """Per-sequence hairpin trimming at both path ends (reference trim.rs:139-186)."""
     if max_unitigs == 0:
         return [None] * len(sequences)
-    all_paths = graph.get_unitig_paths_for_sequences([s.id for s in sequences])
+    if all_paths is None:
+        all_paths = graph.get_unitig_paths_for_sequences([s.id for s in sequences])
     results: List[TrimResult] = []
     for seq in sequences:
         path = [n if s else -n for n, s in all_paths[seq.id]]

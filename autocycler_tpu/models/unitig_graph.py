@@ -49,6 +49,9 @@ class UnitigGraph:
         self.unitigs: List[Unitig] = []
         self.k_size = k_size
         self.index: Dict[int, Unitig] = {}
+        # transient number -> (positions lists, length) map used while
+        # stamping many paths in one batch (see _add_positions_from_path)
+        self._path_helper = None
 
     # ---------------- loading ----------------
 
@@ -108,6 +111,13 @@ class UnitigGraph:
 
     def _build_paths_from_gfa(self, path_lines: List[List[str]]) -> List[Sequence]:
         sequences = []
+        # one lookup table for all paths: number -> (fwd positions list,
+        # rev positions list, length); keeps the hot stamping loop free of
+        # attribute lookups (big SNPy graphs have millions of path steps)
+        self._path_helper = {
+            u.number: (u.forward_positions, u.reverse_positions,
+                       len(u.forward_seq))
+            for u in self.unitigs}
         for parts in path_lines:
             seq_id = int(parts[1])
             length = filename = header = None
@@ -126,6 +136,7 @@ class UnitigGraph:
             path = parse_unitig_path(parts[2])
             sequences.append(self.create_sequence_and_positions(
                 seq_id, length, filename, header, cluster, path))
+        self._path_helper = None
         return sequences
 
     def create_sequence_and_positions(self, seq_id: int, length: int, filename: str,
@@ -140,14 +151,30 @@ class UnitigGraph:
 
     def _add_positions_from_path(self, path, path_strand: bool, seq_id: int,
                                  length: int) -> None:
+        helper = self._path_helper
         pos = 0
-        for unitig_num, unitig_strand in path:
-            unitig = self.index.get(unitig_num)
-            if unitig is None:
-                quit_with_error(f"unitig {unitig_num} not found in unitig index")
-            positions = unitig.forward_positions if unitig_strand else unitig.reverse_positions
-            positions.append(Position(seq_id, path_strand, pos))
-            pos += unitig.length()
+        if helper is None:
+            # single-path call: per-step index lookups beat building an
+            # O(unitigs) helper for one path
+            index_get = self.index.get
+            for unitig_num, unitig_strand in path:
+                unitig = index_get(unitig_num)
+                if unitig is None:
+                    quit_with_error(f"unitig {unitig_num} not found in unitig index")
+                (unitig.forward_positions if unitig_strand
+                 else unitig.reverse_positions).append(
+                    Position(seq_id, path_strand, pos))
+                pos += len(unitig.forward_seq)
+        else:
+            helper_get = helper.get
+            for unitig_num, unitig_strand in path:
+                entry = helper_get(unitig_num)
+                if entry is None:
+                    quit_with_error(f"unitig {unitig_num} not found in unitig index")
+                fwd, rev, ln = entry
+                (fwd if unitig_strand else rev).append(
+                    Position(seq_id, path_strand, pos))
+                pos += ln
         assert pos == length, "Position calculation mismatch"
 
     # ---------------- saving ----------------
@@ -203,7 +230,48 @@ class UnitigGraph:
         strand positions are collected and sorted by coordinate, which
         reconstructs each path without the reference's step-by-step
         neighbour walk (unitig_graph.rs:407-465) — same result, O(total
-        positions) instead of O(path · degree · positions)."""
+        positions) instead of O(path · degree · positions).
+
+        Entries are packed as (pos << 22 | number << 1 | strand) ints so the
+        per-position loop allocates nothing but one int, and sorting /
+        contiguity checking run in numpy."""
+        max_num = max((u.number for u in self.unitigs), default=0)
+        if max_num >= (1 << 21):
+            return self._get_unitig_paths_tuples(seq_ids)
+        by_seq: Dict[int, List[int]] = {i: [] for i in set(seq_ids)}
+        by_seq_get = by_seq.get
+        for unitig in self.unitigs:
+            code_f = (unitig.number << 1) | 1
+            code_r = unitig.number << 1
+            for p in unitig.forward_positions:
+                if p.strand:
+                    lst = by_seq_get(p.seq_id)
+                    if lst is not None:
+                        lst.append((p.pos << 22) | code_f)
+            for p in unitig.reverse_positions:
+                if p.strand:
+                    lst = by_seq_get(p.seq_id)
+                    if lst is not None:
+                        lst.append((p.pos << 22) | code_r)
+        lengths = np.zeros(max_num + 1, np.int64)
+        for u in self.unitigs:
+            lengths[u.number] = len(u.forward_seq)
+        out: Dict[int, List[Tuple[int, bool]]] = {}
+        for sid, items in by_seq.items():
+            arr = np.array(items, dtype=np.int64)
+            arr.sort()
+            numbers = (arr >> 1) & ((1 << 21) - 1)
+            pos = arr >> 22
+            expected = np.zeros(len(arr), np.int64)
+            if len(arr):
+                np.cumsum(lengths[numbers[:-1]], out=expected[1:])
+            assert np.array_equal(pos, expected), "sequence path is not contiguous"
+            strands = arr & 1
+            out[sid] = list(zip(numbers.tolist(), (strands != 0).tolist()))
+        return out
+
+    def _get_unitig_paths_tuples(self, seq_ids) -> Dict[int, List[Tuple[int, bool]]]:
+        """Tuple-based fallback for unitig numbers >= 2^21 (no packing)."""
         wanted = set(seq_ids)
         by_seq: Dict[int, List[Tuple[int, int, bool, int]]] = {i: [] for i in wanted}
         for unitig in self.unitigs:
@@ -384,23 +452,30 @@ class UnitigGraph:
     def check_links(self) -> None:
         """Invariant checker: every link has its strand twin, its prev/next
         mirror, and resolves through the index (reference
-        unitig_graph.rs:752-793). Raises AssertionError on violation."""
+        unitig_graph.rs:752-793). Raises AssertionError on violation.
+
+        Set-based: all next- and prev-edges are collected once, then every
+        edge (either direction) must appear in both sets along with its
+        strand twin — O(E) instead of per-link adjacency-list scans."""
+        nexts, prevs = set(), set()
         for a in self.unitigs:
             for b in a.forward_next:
-                self._check_one_link(a.number, FORWARD, b.number, b.strand)
+                nexts.add((a.number, FORWARD, b.number, b.strand))
             for b in a.reverse_next:
-                self._check_one_link(a.number, REVERSE, b.number, b.strand)
+                nexts.add((a.number, REVERSE, b.number, b.strand))
             for b in a.forward_prev:
-                self._check_one_link(b.number, b.strand, a.number, FORWARD)
+                prevs.add((b.number, b.strand, a.number, FORWARD))
             for b in a.reverse_prev:
-                self._check_one_link(b.number, b.strand, a.number, REVERSE)
-
-    def _check_one_link(self, a_num: int, a_strand: bool, b_num: int, b_strand: bool) -> None:
-        assert self.link_exists(a_num, a_strand, b_num, b_strand), "missing next link"
-        assert self.link_exists_prev(a_num, a_strand, b_num, b_strand), "missing prev link"
-        assert self.link_exists(b_num, not b_strand, a_num, not a_strand), "missing next link"
-        assert self.link_exists_prev(b_num, not b_strand, a_num, not a_strand), "missing prev link"
-        assert a_num in self.index and b_num in self.index, "unitig missing from index"
+                prevs.add((b.number, b.strand, a.number, REVERSE))
+        for a_num, a_strand, b_num, b_strand in nexts | prevs:
+            assert (a_num, a_strand, b_num, b_strand) in nexts, "missing next link"
+            assert (a_num, a_strand, b_num, b_strand) in prevs, "missing prev link"
+            assert (b_num, not b_strand, a_num, not a_strand) in nexts, \
+                "missing next link"
+            assert (b_num, not b_strand, a_num, not a_strand) in prevs, \
+                "missing prev link"
+            assert a_num in self.index and b_num in self.index, \
+                "unitig missing from index"
 
     def delete_dangling_links(self) -> None:
         """Drop links that point at unitigs no longer in the graph

@@ -112,6 +112,27 @@ def get_lib() -> Optional[ctypes.CDLL]:
             lib._has_occ_index = False
         else:
             lib._has_occ_index = True
+        try:
+            lib.sk_overlap_dp_tb.restype = None
+            lib.sk_overlap_dp_tb.argtypes = [
+                ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_double),
+                ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_double),
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_uint64)]
+        except AttributeError:
+            lib._has_dp_tb = False
+        else:
+            lib._has_dp_tb = True
+        try:
+            lib.sk_chain_walk.restype = ctypes.c_int64
+            lib.sk_chain_walk.argtypes = [
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_uint8)]
+        except AttributeError:
+            lib._has_chain_walk = False
+        else:
+            lib._has_chain_walk = True
         _lib = lib
         return lib
     except OSError:
@@ -221,6 +242,29 @@ def build_occ_index(codes: np.ndarray, fwd_off: np.ndarray, rev_off: np.ndarray,
                 prefix_gid=prefix_gid, suffix_gid=suffix_gid)
 
 
+def chain_walk(next_int: np.ndarray):
+    """Walk the internal-successor forest into unitig chains (exact same
+    chain order/content as the pointer-doubling fallback in ops.debruijn).
+    Returns (members, chain_off, is_cycle) or None when unavailable."""
+    lib = get_lib()
+    if lib is None or not getattr(lib, "_has_chain_walk", False):
+        return None
+    next_int = np.ascontiguousarray(next_int, dtype=np.int64)
+    U = len(next_int)
+    members = np.empty(U, dtype=np.int64)
+    chain_off = np.empty(U + 1, dtype=np.int64)
+    is_cycle = np.empty(U, dtype=np.uint8)
+    C = lib.sk_chain_walk(
+        next_int.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.c_int64(U),
+        members.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        chain_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        is_cycle.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    if C < 0:
+        return None
+    return members, chain_off[:C + 1], is_cycle[:C].astype(bool)
+
+
 def overlap_dp_native(a_vals: np.ndarray, wa: np.ndarray, b_vals: np.ndarray,
                       wb: np.ndarray, n: int, kk: int,
                       skip_diagonal: bool) -> Optional[np.ndarray]:
@@ -243,6 +287,33 @@ def overlap_dp_native(a_vals: np.ndarray, wa: np.ndarray, b_vals: np.ndarray,
         ctypes.c_int32(1 if skip_diagonal else 0),
         matrix.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
     return matrix
+
+
+def overlap_dp_tb_native(a_vals: np.ndarray, wa: np.ndarray, b_vals: np.ndarray,
+                         wb: np.ndarray, n: int, kk: int, skip_diagonal: bool):
+    """Rolling-row overlap DP: returns (right_edge_scores[kk+1],
+    traceback_bits[(kk+1)*words], words) with scores/decisions bit-identical
+    to the full-matrix kernel, using O(kk) score memory. None if unavailable."""
+    lib = get_lib()
+    if lib is None or not getattr(lib, "_has_dp_tb", False):
+        return None
+    a_vals = np.ascontiguousarray(a_vals, dtype=np.int64)
+    wa = np.ascontiguousarray(wa, dtype=np.float64)
+    b_vals = np.ascontiguousarray(b_vals, dtype=np.int64)
+    wb = np.ascontiguousarray(wb, dtype=np.float64)
+    words = (kk + 1 + 63) // 64
+    right = np.empty(kk + 1, dtype=np.float64)
+    bits = np.zeros((kk + 1) * words, dtype=np.uint64)
+    lib.sk_overlap_dp_tb(
+        a_vals.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        wa.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        b_vals.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        wb.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_int64(n), ctypes.c_int64(kk),
+        ctypes.c_int32(1 if skip_diagonal else 0),
+        right.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        bits.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
+    return right, bits, words
 
 
 def scan_gram_matches_native(codes: np.ndarray, text_off: np.ndarray,

@@ -83,9 +83,11 @@ def overlap_alignment(path_a: Sequence[int], path_b: Sequence[int],
 
     from .. import native
     matrix = None
-    if native.available():
+    tb = native.overlap_dp_tb_native(pa, wa, b_vals, wcol, n, k, skip_diagonal) \
+        if native.available() else None
+    if tb is None and native.available():
         matrix = native.overlap_dp_native(pa, wa, b_vals, wcol, n, k, skip_diagonal)
-    if matrix is None:
+    if tb is None and matrix is None:
         matrix = np.full((k + 1, k + 1), -np.inf)
         matrix[0, :] = 0.0
         matrix[:, 0] = 0.0
@@ -112,9 +114,20 @@ def overlap_alignment(path_a: Sequence[int], path_b: Sequence[int],
 
     # best score on the right edge (smallest row wins ties, like the
     # reference's strict > scan)
-    right = matrix[1:, k]
-    max_i = int(np.argmax(right)) + 1
-    max_score = matrix[max_i, k]
+    if tb is not None:
+        right_edge, bits, words = tb
+
+        def up_ge(i: int, j: int) -> bool:
+            # packed (S[i-1][j] >= S[i][j-1]) bit from the rolling-row kernel
+            return bool((int(bits[i * words + (j >> 6)]) >> (j & 63)) & 1)
+    else:
+        right_edge = matrix[:, k]
+
+        def up_ge(i: int, j: int) -> bool:
+            return matrix[i - 1, j] >= matrix[i, j - 1]
+
+    max_i = int(np.argmax(right_edge[1:])) + 1
+    max_score = right_edge[max_i]
     if not max_score > 0.0:
         return []
 
@@ -127,7 +140,7 @@ def overlap_alignment(path_a: Sequence[int], path_b: Sequence[int],
             pieces.append(AlignmentPiece(int(pa[gi]), gi, int(pb[gj]), gj))
             i -= 1
             j -= 1
-        elif matrix[i - 1, j] >= matrix[i, j - 1]:
+        elif up_ge(i, j):
             pieces.append(AlignmentPiece(int(pa[gi]), gi, GAP, NONE))
             i -= 1
         else:

@@ -77,12 +77,9 @@ def _pointer_double_heads(prev_int: np.ndarray):
     return P, R
 
 
-def build_chains(index: KmerIndex) -> Chains:
-    U = index.num_kmers
-    if U == 0:
-        return Chains(np.zeros(0, np.int64), np.zeros(1, np.int64), np.zeros(0, bool))
-
-    next_int = internal_edges(index)
+def _chains_numpy(next_int: np.ndarray):
+    """Pointer-doubling fallback: (members, chain_off, chain_is_cycle)."""
+    U = len(next_int)
     prev_int = np.full(U, -1, np.int64)
     has_next = next_int >= 0
     prev_int[next_int[has_next]] = np.flatnonzero(has_next)
@@ -131,16 +128,37 @@ def build_chains(index: KmerIndex) -> Chains:
     chain_off[1:] = np.cumsum(sizes)
     members = np.empty(U, np.int64)
     members[chain_off[chain_id] + rank] = np.arange(U)
+    chain_is_cycle = in_cycle[members[chain_off[:-1]]]
+    return members, chain_off, chain_is_cycle
+
+
+def build_chains(index: KmerIndex) -> Chains:
+    U = index.num_kmers
+    if U == 0:
+        return Chains(np.zeros(0, np.int64), np.zeros(1, np.int64), np.zeros(0, bool))
+
+    next_int = internal_edges(index)
+    from .. import native
+    walked = native.chain_walk(next_int) if native.available() else None
+    if walked is not None:
+        members, chain_off, chain_is_cycle = walked
+    else:
+        members, chain_off, chain_is_cycle = _chains_numpy(next_int)
+
+    C = len(chain_off) - 1
+    sizes = np.diff(chain_off)
+    # chain index of every node (members lists each node exactly once)
+    node_chain = np.empty(U, np.int64)
+    node_chain[members] = np.repeat(np.arange(C, dtype=np.int64), sizes)
     chain_head = members[chain_off[:-1]]
     chain_tail = members[chain_off[1:] - 1]
-    chain_is_cycle = in_cycle[chain_head]
 
     # per-chain minima, own and mirror
-    min_own = np.full(C, U, np.int64)
-    np.minimum.at(min_own, chain_id, np.arange(U, dtype=np.int64))
-    min_mirror = np.full(C, U, np.int64)
-    np.minimum.at(min_mirror, chain_id, index.rev_kid)
-    mirror_chain = chain_id[index.rev_kid[chain_head]]
+    min_own = np.minimum.reduceat(members, chain_off[:-1]) if C else \
+        np.zeros(0, np.int64)
+    min_mirror = np.minimum.reduceat(index.rev_kid[members], chain_off[:-1]) \
+        if C else np.zeros(0, np.int64)
+    mirror_chain = node_chain[index.rev_kid[chain_head]]
     self_mirror = mirror_chain == np.arange(C)
 
     # Emit chains vectorised: of each mirror pair keep the chain holding the

@@ -583,27 +583,6 @@ struct State {
 
 static std::unique_ptr<State> g_state;
 
-// Dense first-seen ids for a key array (gram grouping; order is irrelevant
-// because gram ids are only ever joined on equality).
-static int64_t dense_ids(const std::vector<u128>& keys, int32_t* out) {
-    const int64_t n = static_cast<int64_t>(keys.size());
-    try {
-        Table table;
-        if (!table.init(static_cast<uint64_t>(n))) return -1;
-        std::vector<u128> uniq;
-        uniq.reserve(n);
-        for (int64_t i = 0; i < n; ++i) {
-            if ((uniq.size() + 1) * 2 > table.cap && !table.grow()) return -1;
-            const u128 key = keys[i];
-            out[i] = static_cast<int32_t>(
-                table.upsert(key, hash_key(key), UINT32_MAX, uniq));
-        }
-        return static_cast<int64_t>(uniq.size());
-    } catch (...) {
-        return -1;
-    }
-}
-
 }  // namespace occidx
 
 extern "C" {
@@ -697,20 +676,38 @@ static int64_t occ_index_build_impl(const uint8_t* codes, int64_t n_codes,
     for (const Entry& e : table.slots) {
         if (e.hash != 0 && e.rep != UINT32_MAX) rep_of[e.gid] = e.rep;
     }
-    for (int64_t g = 0; g < U_f; ++g) {
-        if ((keys.size() + 1) * 2 > table.cap && !table.grow()) return -1;
-        const uint8_t* w = codes + rep_of[g];
-        u128 rk = 0;
-        for (int32_t j = k - 1; j >= 0; --j) {
-            const uint32_t c = w[j];
-            rk = rk * 5 + (c ? 5 - c : 0);  // complement: .->., A<->T, C<->G
+    {
+        constexpr int64_t RCB = 128;
+        u128 rks[RCB];
+        uint64_t rhs[RCB];
+        for (int64_t g0 = 0; g0 < U_f; g0 += RCB) {
+            const int64_t ge = std::min(g0 + RCB, U_f);
+            if ((keys.size() + RCB) * 2 > table.cap && !table.grow()) return -1;
+            const uint64_t mask = table.cap - 1;
+            for (int64_t g = g0; g < ge; ++g) {
+                if (g + RCB < U_f)  // rep window bytes of the NEXT block
+                    __builtin_prefetch(codes + rep_of[g + RCB], 0, 1);
+                const uint8_t* w = codes + rep_of[g];
+                u128 rk = 0;
+                for (int32_t j = k - 1; j >= 0; --j) {
+                    const uint32_t c = w[j];
+                    rk = rk * 5 + (c ? 5 - c : 0);  // complement: .<->., A<->T, C<->G
+                }
+                const uint64_t h = hash_key(rk);
+                rks[g - g0] = rk;
+                rhs[g - g0] = h;
+                __builtin_prefetch(&table.slots[h & mask], 0, 1);
+            }
+            for (int64_t g = g0; g < ge; ++g) {
+                const uint32_t g2 = table.upsert(rks[g - g0], rhs[g - g0],
+                                                 UINT32_MAX, keys);
+                if (static_cast<size_t>(g2) >= rc_of.size()) {
+                    rc_of.resize(g2 + 1, -1);
+                    rc_of[g2] = static_cast<int32_t>(g);
+                }
+                rc_of[g] = static_cast<int32_t>(g2);
+            }
         }
-        const uint32_t g2 = table.upsert(rk, hash_key(rk), UINT32_MAX, keys);
-        if (static_cast<size_t>(g2) >= rc_of.size()) {
-            rc_of.resize(g2 + 1, -1);
-            rc_of[g2] = static_cast<int32_t>(g);
-        }
-        rc_of[g] = static_cast<int32_t>(g2);
     }
     const int64_t U = static_cast<int64_t>(keys.size());
     pt.mark("B rc map");
@@ -782,23 +779,72 @@ static int64_t occ_index_build_impl(const uint8_t* codes, int64_t n_codes,
     }
 
     {
+        // Sort-merge gram ids, no hashing: the keys are already in rank
+        // order, so prefix grams (key / 5) come out SORTED and their ids are
+        // run boundaries; suffix grams (key mod 5^(k-1)) need one bucket
+        // sort; a two-pointer merge over the two distinct sequences assigns
+        // one consistent dense id space (ids are merged sorted order —
+        // only equality is ever used downstream).
         const u128 inv5 = inv5_u128();
-        std::vector<u128> gram_keys;
-        try { gram_keys.resize(2 * U); } catch (...) { return -1; }
+        struct KG { u128 key; uint32_t gid; };
+        std::vector<u128> pfx;
+        std::vector<KG> sfx;
+        try {
+            pfx.resize(U);
+            sfx.resize(U);
+        } catch (...) { return -1; }
         for (int64_t r = 0; r < U; ++r) {
             const u128 key = keys[r];
-            gram_keys[r] = (key - mod5(key)) * inv5;   // drop last symbol
-            u128 sfx = key;                            // drop first symbol
-            while (sfx >= pow5k1) sfx -= pow5k1;
-            gram_keys[U + r] = sfx;
+            pfx[r] = (key - mod5(key)) * inv5;         // drop last symbol
+            u128 s = key;                              // drop first symbol
+            while (s >= pow5k1) s -= pow5k1;
+            sfx[r] = KG{s, static_cast<uint32_t>(r)};
         }
-        std::vector<int32_t> gids;
-        try { gids.resize(2 * U); } catch (...) { return -1; }
-        const int64_t G = dense_ids(gram_keys, gids.data());
-        if (G < 0) return -1;
-        state->G = G;
-        std::copy(gids.begin(), gids.begin() + U, state->prefix_gid.begin());
-        std::copy(gids.begin() + U, gids.end(), state->suffix_gid.begin());
+        // bucket sort suffix grams by top bits (same scheme as phase C)
+        if (U > 1) {
+            u128 max_gram = pow5k1 - 1;                // grams are < 5^(k-1)
+            int bitlen = 128;
+            while (bitlen > 1 && !((max_gram >> (bitlen - 1)) & 1)) --bitlen;
+            const int shift = bitlen > 20 ? bitlen - 20 : 0;
+            const int64_t NB = static_cast<int64_t>((max_gram >> shift)) + 2;
+            std::vector<int64_t> bstart, cur;
+            std::vector<KG> tmp;
+            try {
+                bstart.assign(NB + 1, 0);
+                tmp.resize(U);
+            } catch (...) { return -1; }
+            for (int64_t r = 0; r < U; ++r)
+                ++bstart[static_cast<int64_t>(sfx[r].key >> shift) + 1];
+            for (int64_t b = 0; b < NB; ++b) bstart[b + 1] += bstart[b];
+            cur.assign(bstart.begin(), bstart.end() - 1);
+            for (int64_t r = 0; r < U; ++r)
+                tmp[cur[static_cast<int64_t>(sfx[r].key >> shift)]++] = sfx[r];
+            for (int64_t b = 0; b < NB; ++b) {
+                std::sort(tmp.begin() + bstart[b], tmp.begin() + bstart[b + 1],
+                          [](const KG& a, const KG& c) { return a.key < c.key; });
+            }
+            sfx.swap(tmp);
+        }
+        // merge distinct prefix runs and distinct suffix runs in key order
+        int32_t next_id = 0;
+        int64_t ip = 0, is = 0;
+        while (ip < U || is < U) {
+            u128 pk = 0, sk = 0;
+            const bool has_p = ip < U, has_s = is < U;
+            if (has_p) pk = pfx[ip];
+            if (has_s) sk = sfx[is].key;
+            const bool take_p = has_p && (!has_s || pk <= sk);
+            const bool take_s = has_s && (!has_p || sk <= pk);
+            const u128 key = take_p ? pk : sk;
+            if (take_p)
+                while (ip < U && pfx[ip] == key)
+                    state->prefix_gid[ip++] = next_id;
+            if (take_s)
+                while (is < U && sfx[is].key == key)
+                    state->suffix_gid[sfx[is++].gid] = next_id;
+            ++next_id;
+        }
+        state->G = next_id;
     }
 
     pt.mark("F grams");
@@ -896,8 +942,17 @@ void sk_overlap_dp(const int64_t* a_vals, const double* wa,
                    const int64_t* b_vals, const double* wb,
                    int64_t n, int64_t kk, int32_t skip_diagonal,
                    double* matrix) {
+    // Prefix-max formulation (identical results): with column-weight prefix
+    // sums W, the insert recurrence S[j] = max(base[j], S[j-1] - wb[j])
+    // becomes a running max of base[j] + W[j]. The base pass has no
+    // loop-carried dependency, so the compiler vectorises it; the running
+    // max is one compare per cell. All weights are integers, so f64 sums
+    // are exact and the result is bit-identical to the cell-by-cell loop.
     const int64_t stride = kk + 1;
     const double NEG_INF = -1.0 / 0.0;
+    std::vector<double> Wcum(kk + 1, 0.0);
+    for (int64_t j = 1; j <= kk; ++j) Wcum[j] = Wcum[j - 1] + wb[j - 1];
+    std::vector<double> T(kk + 1);
     for (int64_t j = 0; j <= kk; ++j) matrix[j] = 0.0;
     for (int64_t i = 1; i <= kk; ++i) {
         const double* prev = matrix + (i - 1) * stride;
@@ -906,21 +961,144 @@ void sk_overlap_dp(const int64_t* a_vals, const double* wa,
         const int64_t gi = i - 1;
         const double wi = wa[gi];
         const int64_t a = a_vals[gi];
+        double* tp = T.data();
         for (int64_t j = 1; j <= kk; ++j) {
-            const int64_t gj = n - kk + j - 1;
-            if (skip_diagonal && gi == gj) {
+            const double match = prev[j - 1] +
+                (a == b_vals[j - 1] ? wi : -(wi + wb[j - 1]) / 2.0);
+            const double del = prev[j] - wi;
+            tp[j] = (match > del ? match : del) + Wcum[j];
+        }
+        // running max; the skipped diagonal cell is -inf and restarts the
+        // insert chain (nothing propagates through it)
+        const int64_t jd = skip_diagonal ? gi - (n - kk) + 1 : -1;
+        double running = 0.0;  // left edge: cur[0] + Wcum[0]
+        for (int64_t j = 1; j <= kk; ++j) {
+            if (j == jd) {
                 cur[j] = NEG_INF;
+                running = NEG_INF;
                 continue;
             }
-            const double wj = wb[j - 1];
-            const double match = prev[j - 1] +
-                (a == b_vals[j - 1] ? wi : -(wi + wj) / 2.0);
-            const double del = prev[j] - wi;
-            const double ins = cur[j - 1] - wj;
-            double best = match > del ? match : del;
-            if (ins > best) best = ins;
-            cur[j] = best;
+            if (tp[j] > running) running = tp[j];
+            cur[j] = running - Wcum[j];
         }
+    }
+}
+
+// Rolling-row variant of sk_overlap_dp for large matrices: instead of the
+// O(kk^2) f64 score matrix (memory-bound at kk=5000: 200 MB of writes per
+// call), it keeps two score rows and records ONE traceback bit per cell —
+// up_ge[i][j] = (S[i-1][j] >= S[i][j-1]) — which is exactly the comparison
+// the traceback makes on mismatch cells. Outputs:
+//   out_right [kk+1]                      S[i][kk] (right edge, incl. row 0)
+//   out_bits  [(kk+1) * ceil((kk+1)/64)]  packed up_ge bits, row-major
+// Scores and traceback decisions are bit-identical to sk_overlap_dp.
+void sk_overlap_dp_tb(const int64_t* a_vals, const double* wa,
+                      const int64_t* b_vals, const double* wb,
+                      int64_t n, int64_t kk, int32_t skip_diagonal,
+                      double* out_right, uint64_t* out_bits) {
+    const double NEG_INF = -1.0 / 0.0;
+    const int64_t words = (kk + 1 + 63) / 64;
+    std::vector<double> Wcum(kk + 1, 0.0);
+    for (int64_t j = 1; j <= kk; ++j) Wcum[j] = Wcum[j - 1] + wb[j - 1];
+    std::vector<double> prev_row(kk + 1, 0.0), cur_row(kk + 1, 0.0), T(kk + 1);
+    out_right[0] = 0.0;
+    for (int64_t i = 1; i <= kk; ++i) {
+        const double* prev = prev_row.data();
+        double* cur = cur_row.data();
+        cur[0] = 0.0;
+        const int64_t gi = i - 1;
+        const double wi = wa[gi];
+        const int64_t a = a_vals[gi];
+        double* tp = T.data();
+        for (int64_t j = 1; j <= kk; ++j) {
+            const double match = prev[j - 1] +
+                (a == b_vals[j - 1] ? wi : -(wi + wb[j - 1]) / 2.0);
+            const double del = prev[j] - wi;
+            tp[j] = (match > del ? match : del) + Wcum[j];
+        }
+        const int64_t jd = skip_diagonal ? gi - (n - kk) + 1 : -1;
+        uint64_t* bits = out_bits + i * words;
+        uint64_t word = 0;
+        double running = 0.0;
+        for (int64_t j = 1; j <= kk; ++j) {
+            double v;
+            if (j == jd) {
+                v = NEG_INF;
+                running = NEG_INF;
+            } else {
+                if (tp[j] > running) running = tp[j];
+                v = running - Wcum[j];
+            }
+            // traceback bit BEFORE overwriting: S[i-1][j] >= S[i][j-1]
+            if (prev[j] >= cur[j - 1]) word |= 1ull << (j & 63);
+            cur[j] = v;
+            if ((j & 63) == 63) {
+                bits[j >> 6] = word;
+                word = 0;
+            }
+        }
+        if ((kk & 63) != 63) bits[kk >> 6] = word;  // flush partial tail word
+        out_right[i] = cur[kk];
+        prev_row.swap(cur_row);
+    }
+}
+
+// Unitig chain walk over the internal-successor forest (ops/debruijn.py).
+// next[g] is the unitig-internal successor of k-mer g or -1. Chains are
+// emitted in ascending order of their head node (paths) / smallest member
+// (cycles, rotated to start there) — the exact order the pointer-doubling
+// fallback produces. Outputs: members [U], chain_off [C+1], is_cycle [C].
+// Returns the number of chains C, or -1 on allocation failure.
+int64_t sk_chain_walk(const int64_t* next, int64_t U,
+                      int64_t* out_members, int64_t* out_chain_off,
+                      uint8_t* out_is_cycle) {
+    if (U == 0) { out_chain_off[0] = 0; return 0; }
+    try {
+        std::vector<int32_t> has_prev(U, 0);
+        for (int64_t g = 0; g < U; ++g)
+            if (next[g] >= 0) has_prev[next[g]] = 1;
+        std::vector<uint8_t> visited(U, 0);
+
+        struct ChainRec { int64_t key, start, len; uint8_t cycle; };
+        std::vector<ChainRec> recs;
+        std::vector<int64_t> buf;   // members of all chains, walk order
+        buf.reserve(U);
+
+        // paths first (ascending head), then cycles (ascending smallest
+        // member: scanning g ascending, the first unvisited node of a cycle
+        // is its minimum)
+        for (int pass = 0; pass < 2; ++pass) {
+            for (int64_t g = 0; g < U; ++g) {
+                if (visited[g]) continue;
+                if (pass == 0 && has_prev[g]) continue;
+                const int64_t start = static_cast<int64_t>(buf.size());
+                int64_t cur = g;
+                while (cur >= 0 && !visited[cur]) {
+                    visited[cur] = 1;
+                    buf.push_back(cur);
+                    cur = next[cur];
+                }
+                recs.push_back(ChainRec{g, start,
+                                        static_cast<int64_t>(buf.size()) - start,
+                                        static_cast<uint8_t>(pass)});
+            }
+        }
+        // merge into ascending-key order (paths and cycles interleaved by
+        // head/rep node id, matching the fallback's chain numbering)
+        std::sort(recs.begin(), recs.end(),
+                  [](const ChainRec& a, const ChainRec& b) { return a.key < b.key; });
+        int64_t off = 0;
+        for (size_t c = 0; c < recs.size(); ++c) {
+            out_chain_off[c] = off;
+            std::memcpy(out_members + off, buf.data() + recs[c].start,
+                        sizeof(int64_t) * recs[c].len);
+            out_is_cycle[c] = recs[c].cycle;
+            off += recs[c].len;
+        }
+        out_chain_off[recs.size()] = off;
+        return static_cast<int64_t>(recs.size());
+    } catch (...) {
+        return -1;
     }
 }
 
