@@ -14,10 +14,12 @@ import os
 from pathlib import Path
 from typing import Dict, List, Optional, Tuple
 
+import numpy as np
+
 from ..metrics import TrimmedClusterMetrics
 from ..models import Sequence, UnitigGraph
 from ..models.simplify import merge_linear_paths
-from ..ops.align import GAP, find_midpoint, overlap_alignment
+from ..ops.align import GAP, Weights, find_midpoint, overlap_alignment
 from ..utils import (log, mad as mad_fn, median, quit_with_error,
                      reverse_signed_path)
 
@@ -46,7 +48,12 @@ def trim(cluster_dir, min_identity: float = 0.75, max_unitigs: int = 5000,
                     "with linear sequences).")
     graph, sequences = UnitigGraph.from_gfa_file(untrimmed_gfa)
     graph.print_basic_graph_info()
-    weights = {u.number: u.length() for u in graph.unitigs}
+    # dense number -> length array: scalar indexing works like the dict and
+    # the alignment kernels can gather whole paths in one vector op
+    max_num = max((u.number for u in graph.unitigs), default=0)
+    weights = np.zeros(max_num + 1, dtype=np.int64)
+    for u in graph.unitigs:
+        weights[u.number] = u.length()
 
     # one path query serves both trimming passes (the graph is unchanged
     # until choose_trim_type applies the results)
@@ -67,7 +74,7 @@ def trim(cluster_dir, min_identity: float = 0.75, max_unitigs: int = 5000,
 
 
 def trim_start_end_overlap(graph: UnitigGraph, sequences: List[Sequence],
-                           weights: Dict[int, int], min_identity: float,
+                           weights: Weights, min_identity: float,
                            max_unitigs: int, all_paths=None) -> List[TrimResult]:
     """Per-sequence circular start-end trimming (reference trim.rs:113-136).
     A max_unitigs of 0 disables trimming."""
@@ -91,7 +98,7 @@ def trim_start_end_overlap(graph: UnitigGraph, sequences: List[Sequence],
 
 
 def trim_hairpin_overlap(graph: UnitigGraph, sequences: List[Sequence],
-                         weights: Dict[int, int], min_identity: float,
+                         weights: Weights, min_identity: float,
                          max_unitigs: int, all_paths=None) -> List[TrimResult]:
     """Per-sequence hairpin trimming at both path ends (reference trim.rs:139-186)."""
     if max_unitigs == 0:
@@ -187,7 +194,7 @@ def clean_up_graph(graph: UnitigGraph, sequences: List[Sequence]) -> None:
 
 # ---------------- path-level trimming ----------------
 
-def trim_path_start_end(path: List[int], weights: Dict[int, int], min_identity: float,
+def trim_path_start_end(path: List[int], weights: Weights, min_identity: float,
                         max_unitigs: int) -> Optional[List[int]]:
     """Detect a start-end overlap by aligning the path against itself (off-
     diagonal) and cut at the weighted midpoint (reference trim.rs:288-296)."""
@@ -200,7 +207,7 @@ def trim_path_start_end(path: List[int], weights: Dict[int, int], min_identity: 
     return list(path[start:end])
 
 
-def trim_path_hairpin_end(path: List[int], weights: Dict[int, int],
+def trim_path_hairpin_end(path: List[int], weights: Weights,
                           min_identity: float, max_unitigs: int
                           ) -> Optional[List[int]]:
     """Detect a hairpin overlap at the path end by aligning the reverse path
@@ -228,7 +235,7 @@ def trim_path_hairpin_end(path: List[int], weights: Dict[int, int],
     return list(path[:end])
 
 
-def trim_path_hairpin_start(path: List[int], weights: Dict[int, int],
+def trim_path_hairpin_start(path: List[int], weights: Weights,
                             min_identity: float, max_unitigs: int
                             ) -> Optional[List[int]]:
     """Hairpin trim at the path start = hairpin-end trim of the reverse path

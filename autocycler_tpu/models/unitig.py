@@ -43,7 +43,7 @@ _COLOUR_FOR_TYPE = {
 
 
 class Unitig:
-    __slots__ = ("number", "forward_seq", "reverse_seq", "depth", "unitig_type",
+    __slots__ = ("number", "forward_seq", "_reverse_seq", "depth", "unitig_type",
                  "forward_positions", "reverse_positions",
                  "forward_next", "forward_prev", "reverse_next", "reverse_prev")
 
@@ -54,9 +54,9 @@ class Unitig:
                  unitig_type: UnitigType = UnitigType.OTHER):
         self.number = number
         self.forward_seq = forward_seq if forward_seq is not None else np.zeros(0, np.uint8)
-        if reverse_seq is None:
-            reverse_seq = reverse_complement_bytes(self.forward_seq)
-        self.reverse_seq = reverse_seq
+        # reverse strand is derived lazily: most unitigs of a loaded graph
+        # never have their reverse sequence read
+        self._reverse_seq = reverse_seq
         self.depth = depth
         self.unitig_type = unitig_type
         self.forward_positions: list = []
@@ -65,6 +65,16 @@ class Unitig:
         self.forward_prev: List[UnitigStrand] = []
         self.reverse_next: List[UnitigStrand] = []
         self.reverse_prev: List[UnitigStrand] = []
+
+    @property
+    def reverse_seq(self) -> np.ndarray:
+        if self._reverse_seq is None:
+            self._reverse_seq = reverse_complement_bytes(self.forward_seq)
+        return self._reverse_seq
+
+    @reverse_seq.setter
+    def reverse_seq(self, value: Optional[np.ndarray]) -> None:
+        self._reverse_seq = value
 
     # ---------------- construction ----------------
 
@@ -169,26 +179,26 @@ class Unitig:
         for p in self.forward_positions:
             p.pos += amount
         self.forward_seq = self.forward_seq[amount:]
-        self.reverse_seq = self.reverse_seq[:len(self.reverse_seq) - amount]
+        self._reverse_seq = None  # rederived lazily from the trimmed forward
 
     def remove_seq_from_end(self, amount: int) -> None:
         assert amount <= len(self.forward_seq)
         for p in self.reverse_positions:
             p.pos += amount
         self.forward_seq = self.forward_seq[:len(self.forward_seq) - amount]
-        self.reverse_seq = self.reverse_seq[amount:]
+        self._reverse_seq = None  # rederived lazily from the trimmed forward
 
     def add_seq_to_start(self, seq: np.ndarray) -> None:
         for p in self.forward_positions:
             p.pos -= len(seq)
         self.forward_seq = np.concatenate([seq, self.forward_seq])
-        self.reverse_seq = reverse_complement_bytes(self.forward_seq)
+        self._reverse_seq = None
 
     def add_seq_to_end(self, seq: np.ndarray) -> None:
         for p in self.reverse_positions:
             p.pos -= len(seq)
         self.forward_seq = np.concatenate([self.forward_seq, seq])
-        self.reverse_seq = reverse_complement_bytes(self.forward_seq)
+        self._reverse_seq = None
 
     # ---------------- positions / depth ----------------
 

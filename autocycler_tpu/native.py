@@ -113,6 +113,21 @@ def get_lib() -> Optional[ctypes.CDLL]:
         else:
             lib._has_occ_index = True
         try:
+            lib.sk_scan_gram_begin.restype = ctypes.c_int64
+            lib.sk_scan_gram_begin.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64, ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
+            lib.sk_scan_gram_fetch.restype = ctypes.c_int32
+            lib.sk_scan_gram_fetch.argtypes = [
+                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int64)]
+        except AttributeError:
+            lib._has_gram_begin = False
+        else:
+            lib._has_gram_begin = True
+        try:
             lib.sk_overlap_dp_tb.restype = None
             lib.sk_overlap_dp_tb.argtypes = [
                 ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_double),
@@ -329,6 +344,27 @@ def scan_gram_matches_native(codes: np.ndarray, text_off: np.ndarray,
     text_off = np.ascontiguousarray(text_off, dtype=np.int64)
     text_len = np.ascontiguousarray(text_len, dtype=np.int64)
     q_starts = np.ascontiguousarray(q_starts, dtype=np.int64)
+
+    if getattr(lib, "_has_gram_begin", False):
+        # single-pass: scan once with retained results, then fetch
+        count = lib.sk_scan_gram_begin(
+            codes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            text_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            text_len.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.c_int64(len(text_off)), ctypes.c_int32(h),
+            q_starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.c_int64(len(q_starts)))
+        if count < 0:
+            return None
+        out_q = np.empty(count, dtype=np.int32)
+        out_t = np.empty(count, dtype=np.int32)
+        out_p = np.empty(count, dtype=np.int64)
+        if lib.sk_scan_gram_fetch(
+                out_q.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                out_t.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                out_p.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))) != 0:
+            return None
+        return out_q, out_t, out_p
 
     def call(out_q, out_t, out_p):
         return lib.sk_scan_gram_matches(

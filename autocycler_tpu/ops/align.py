@@ -21,9 +21,14 @@ formulation maps to a lax.scan over rows on TPU).
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Union
 
 import numpy as np
+
+# weights: unitig number -> length, as a dict or a dense number-indexed array
+# (scalar indexing is identical; the array form lets the kernels gather whole
+# paths in one vector op)
+Weights = Union[Dict[int, int], np.ndarray]
 
 GAP = 0
 NONE = -1  # the reference uses usize::MAX; -1 is the Python stand-in
@@ -53,7 +58,7 @@ class AlignmentPiece:
 
 
 def overlap_alignment(path_a: Sequence[int], path_b: Sequence[int],
-                      weights: Dict[int, int], min_identity: float,
+                      weights: Weights, min_identity: float,
                       max_unitigs: int, skip_diagonal: bool) -> List[AlignmentPiece]:
     """Find an overlap alignment from the right edge to the top edge of the
     (first k of a) × (last k of b) scoring matrix (reference trim.rs:366-479).
@@ -72,8 +77,12 @@ def overlap_alignment(path_a: Sequence[int], path_b: Sequence[int],
 
     pa = np.asarray(path_a, dtype=np.int64)
     pb = np.asarray(path_b, dtype=np.int64)
-    wa = np.array([weights[abs(int(u))] for u in pa], dtype=np.float64)
-    wb = np.array([weights[abs(int(u))] for u in pb], dtype=np.float64)
+    if isinstance(weights, np.ndarray):
+        wa = weights[np.abs(pa)].astype(np.float64)
+        wb = weights[np.abs(pb)].astype(np.float64)
+    else:
+        wa = np.array([weights[abs(int(u))] for u in pa], dtype=np.float64)
+        wb = np.array([weights[abs(int(u))] for u in pb], dtype=np.float64)
 
     b_glob = n - k + np.arange(1, k + 1) - 1       # global b index per column j=1..k
     wcol = wb[b_glob]
@@ -160,7 +169,7 @@ def overlap_alignment(path_a: Sequence[int], path_b: Sequence[int],
     return pieces
 
 
-def find_midpoint(alignment: List[AlignmentPiece], weights: Dict[int, int]) -> int:
+def find_midpoint(alignment: List[AlignmentPiece], weights: Weights) -> int:
     """Index of the match column whose cumulative weight is closest to the
     alignment's weighted midpoint (reference trim.rs:482-507)."""
     total = 0
@@ -183,7 +192,7 @@ def find_midpoint(alignment: List[AlignmentPiece], weights: Dict[int, int]) -> i
 
 
 def global_alignment_distance(path_a: Sequence[int], path_b: Sequence[int],
-                              weights: Dict[int, int]) -> int:
+                              weights: Weights) -> int:
     """Weighted global alignment (Needleman-Wunsch) distance between two
     paths (reference resolve.rs:387-418): match 0, mismatch max(w_a, w_b)
     (the longer tig), indel w; returns the minimum total distance. Row-
@@ -192,8 +201,12 @@ def global_alignment_distance(path_a: Sequence[int], path_b: Sequence[int],
     a = np.asarray(path_a, dtype=np.int64)
     b = np.asarray(path_b, dtype=np.int64)
     n, m = len(a), len(b)
-    wa = np.array([weights[abs(int(u))] for u in a], dtype=np.int64) if n else np.zeros(0, np.int64)
-    wb = np.array([weights[abs(int(u))] for u in b], dtype=np.int64) if m else np.zeros(0, np.int64)
+    if isinstance(weights, np.ndarray):
+        wa = weights[np.abs(a)]
+        wb = weights[np.abs(b)]
+    else:
+        wa = np.array([weights[abs(int(u))] for u in a], dtype=np.int64) if n else np.zeros(0, np.int64)
+        wb = np.array([weights[abs(int(u))] for u in b], dtype=np.int64) if m else np.zeros(0, np.int64)
     Wb = np.concatenate([[0], np.cumsum(wb)])      # top edge: gaps in A
     prev = Wb.copy()                               # row 0
     for i in range(n):

@@ -349,24 +349,31 @@ int64_t sk_group_kmers(const uint8_t* codes, const int64_t* starts, int64_t n,
 // Multi-pattern gram scan for sequence-end repair: find every occurrence of
 // Q query h-grams across T text segments of the codes buffer (segments are
 // the padded per-strand sequences; windows never cross a segment boundary).
-//
 // Rolling polynomial hash with exact byte verification on candidate hits;
 // queries with identical grams are chained so each gets its own matches.
-//
-// Two-call protocol: with out_query == NULL, returns the total match count;
-// otherwise fills out_query[int32], out_text[int32], out_pos[int64]
-// (position local to the text segment), ordered by (text, pos, query chain).
-int64_t sk_scan_gram_matches(const uint8_t* codes,
-                             const int64_t* text_off, const int64_t* text_len,
-                             int64_t T, int32_t h,
-                             const int64_t* q_starts, int64_t Q,
-                             int32_t* out_query, int32_t* out_text,
-                             int64_t* out_pos) {
+// One implementation, two drivers: the legacy two-call NULL-probe protocol
+// (sk_scan_gram_matches) and the single-pass stash protocol
+// (sk_scan_gram_begin / sk_scan_gram_fetch).
+
+}  // extern "C"
+
+namespace gramscan {
+
+struct Res {
+    std::vector<int32_t> q, t;
+    std::vector<int64_t> p;
+};
+static std::unique_ptr<Res> g_res;
+
+template <typename Emit>
+static int64_t scan_impl(const uint8_t* codes,
+                         const int64_t* text_off, const int64_t* text_len,
+                         int64_t T, int32_t h,
+                         const int64_t* q_starts, int64_t Q, Emit emit) {
     if (h <= 0 || Q == 0) return 0;
     constexpr uint64_t B = 0x100000001B3ull;  // FNV-ish odd base
 
-    // base^(h-1) for the rolling update
-    uint64_t b_pow = 1;
+    uint64_t b_pow = 1;                        // base^(h-1) for rolling update
     for (int32_t i = 1; i < h; ++i) b_pow *= B;
 
     auto hash_at = [&](const uint8_t* p) {
@@ -382,10 +389,8 @@ int64_t sk_scan_gram_matches(const uint8_t* codes,
     std::vector<int32_t> slot_query(cap, -1);
     std::vector<uint64_t> slot_hash(cap, 0);
     std::vector<int32_t> chain(Q, -1);
-    std::vector<uint64_t> q_hash(Q);
     for (int64_t q = 0; q < Q; ++q) {
         const uint64_t v = hash_at(codes + q_starts[q]);
-        q_hash[q] = v;
         uint64_t s = v & mask;
         for (;;) {
             if (slot_query[s] < 0) {
@@ -419,11 +424,7 @@ int64_t sk_scan_gram_matches(const uint8_t* codes,
                     const int32_t head = slot_query[s];
                     if (std::memcmp(codes + q_starts[head], text + pos, h) == 0) {
                         for (int32_t q = head; q >= 0; q = chain[q]) {
-                            if (out_query != nullptr) {
-                                out_query[count] = q;
-                                out_text[count] = static_cast<int32_t>(t);
-                                out_pos[count] = pos;
-                            }
+                            emit(q, static_cast<int32_t>(t), pos, count);
                             ++count;
                         }
                         break;  // identical grams share one chain
@@ -438,6 +439,64 @@ int64_t sk_scan_gram_matches(const uint8_t* codes,
     }
     return count;
 }
+
+}  // namespace gramscan
+
+extern "C" {
+
+// Two-call protocol: with out_query == NULL, returns the total match count;
+// otherwise fills out_query[int32], out_text[int32], out_pos[int64]
+// (position local to the text segment), ordered by (text, pos, query chain).
+int64_t sk_scan_gram_matches(const uint8_t* codes,
+                             const int64_t* text_off, const int64_t* text_len,
+                             int64_t T, int32_t h,
+                             const int64_t* q_starts, int64_t Q,
+                             int32_t* out_query, int32_t* out_text,
+                             int64_t* out_pos) {
+    return gramscan::scan_impl(
+        codes, text_off, text_len, T, h, q_starts, Q,
+        [&](int32_t q, int32_t t, int64_t pos, int64_t i) {
+            if (out_query != nullptr) {
+                out_query[i] = q;
+                out_text[i] = t;
+                out_pos[i] = pos;
+            }
+        });
+}
+
+// Single-pass protocol: scan once, stash results; returns match count or -1.
+// Fetch with sk_scan_gram_fetch (copies into caller buffers, frees stash).
+int64_t sk_scan_gram_begin(const uint8_t* codes,
+                           const int64_t* text_off, const int64_t* text_len,
+                           int64_t T, int32_t h,
+                           const int64_t* q_starts, int64_t Q) {
+    try {
+        auto res = std::make_unique<gramscan::Res>();
+        const int64_t count = gramscan::scan_impl(
+            codes, text_off, text_len, T, h, q_starts, Q,
+            [&](int32_t q, int32_t t, int64_t pos, int64_t) {
+                res->q.push_back(q);
+                res->t.push_back(t);
+                res->p.push_back(pos);
+            });
+        gramscan::g_res = std::move(res);
+        return count;
+    } catch (...) {
+        gramscan::g_res.reset();
+        return -1;
+    }
+}
+
+int32_t sk_scan_gram_fetch(int32_t* out_query, int32_t* out_text,
+                           int64_t* out_pos) {
+    if (!gramscan::g_res) return -1;
+    std::unique_ptr<gramscan::Res> res = std::move(gramscan::g_res);
+    std::memcpy(out_query, res->q.data(), sizeof(int32_t) * res->q.size());
+    std::memcpy(out_text, res->t.data(), sizeof(int32_t) * res->t.size());
+    std::memcpy(out_pos, res->p.data(), sizeof(int64_t) * res->p.size());
+    return 0;
+}
+
 
 }  // extern "C"
 
@@ -953,6 +1012,8 @@ void sk_overlap_dp(const int64_t* a_vals, const double* wa,
     std::vector<double> Wcum(kk + 1, 0.0);
     for (int64_t j = 1; j <= kk; ++j) Wcum[j] = Wcum[j - 1] + wb[j - 1];
     std::vector<double> T(kk + 1);
+    std::vector<double> bd(kk), mm(kk);  // b ids + mismatch halves as doubles
+    for (int64_t j = 0; j < kk; ++j) bd[j] = static_cast<double>(b_vals[j]);
     for (int64_t j = 0; j <= kk; ++j) matrix[j] = 0.0;
     for (int64_t i = 1; i <= kk; ++i) {
         const double* prev = matrix + (i - 1) * stride;
@@ -960,11 +1021,12 @@ void sk_overlap_dp(const int64_t* a_vals, const double* wa,
         cur[0] = 0.0;
         const int64_t gi = i - 1;
         const double wi = wa[gi];
-        const int64_t a = a_vals[gi];
+        const double ad = static_cast<double>(a_vals[gi]);
         double* tp = T.data();
+        for (int64_t j = 0; j < kk; ++j) mm[j] = -(wi + wb[j]) / 2.0;
         for (int64_t j = 1; j <= kk; ++j) {
             const double match = prev[j - 1] +
-                (a == b_vals[j - 1] ? wi : -(wi + wb[j - 1]) / 2.0);
+                (ad == bd[j - 1] ? wi : mm[j - 1]);
             const double del = prev[j] - wi;
             tp[j] = (match > del ? match : del) + Wcum[j];
         }
@@ -1001,6 +1063,8 @@ void sk_overlap_dp_tb(const int64_t* a_vals, const double* wa,
     std::vector<double> Wcum(kk + 1, 0.0);
     for (int64_t j = 1; j <= kk; ++j) Wcum[j] = Wcum[j - 1] + wb[j - 1];
     std::vector<double> prev_row(kk + 1, 0.0), cur_row(kk + 1, 0.0), T(kk + 1);
+    std::vector<double> bd(kk), mm(kk);  // b ids + mismatch halves as doubles
+    for (int64_t j = 0; j < kk; ++j) bd[j] = static_cast<double>(b_vals[j]);
     out_right[0] = 0.0;
     for (int64_t i = 1; i <= kk; ++i) {
         const double* prev = prev_row.data();
@@ -1008,11 +1072,12 @@ void sk_overlap_dp_tb(const int64_t* a_vals, const double* wa,
         cur[0] = 0.0;
         const int64_t gi = i - 1;
         const double wi = wa[gi];
-        const int64_t a = a_vals[gi];
+        const double ad = static_cast<double>(a_vals[gi]);
         double* tp = T.data();
+        for (int64_t j = 0; j < kk; ++j) mm[j] = -(wi + wb[j]) / 2.0;
         for (int64_t j = 1; j <= kk; ++j) {
             const double match = prev[j - 1] +
-                (a == b_vals[j - 1] ? wi : -(wi + wb[j - 1]) / 2.0);
+                (ad == bd[j - 1] ? wi : mm[j - 1]);
             const double del = prev[j] - wi;
             tp[j] = (match > del ? match : del) + Wcum[j];
         }
