@@ -1,4 +1,5 @@
-"""Command-line interface: the twelve Autocycler subcommands.
+"""Command-line interface: the twelve Autocycler subcommands plus the
+TPU-native `batch` extension (mesh-batched multi-isolate processing).
 
 Parity target: reference main.rs:44-370 — same subcommand names, flags,
 defaults and validation ranges, dispatching to commands/*.
@@ -30,6 +31,16 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--version", action="version",
                         version=f"Autocycler-TPU v{__version__}")
     sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("batch",
+                       help="compress + cluster distances for MANY isolates in one "
+                            "mesh-batched device step (TPU-native extension)")
+    p.add_argument("-i", "--assemblies_parent", required=True,
+                   help="directory of isolate subdirectories, each a normal "
+                        "--assemblies_dir")
+    p.add_argument("-a", "--out_parent", required=True)
+    p.add_argument("-k", "--kmer", type=int, default=51)
+    p.add_argument("--max_contigs", type=int, default=25)
 
     p = sub.add_parser("clean",
                        help="manual manipulation of the final consensus assembly graph")
@@ -69,6 +80,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-o", "--out_png", required=True)
     p.add_argument("--res", type=int, default=2000)
     p.add_argument("--kmer", type=int, default=32)
+    p.add_argument("--grid-mode", dest="grid_mode", default="auto",
+                   choices=["auto", "host", "device"],
+                   help="k-mer matching backend: host sort-join (near-linear, "
+                        "the measured default) or the TPU Pallas match grid "
+                        "with exact per-tile refinement")
 
     p = sub.add_parser("gfa2fasta",
                        help="convert an Autocycler GFA file to FASTA format")
@@ -118,7 +134,11 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def dispatch(args) -> None:
-    if args.command == "clean":
+    if args.command == "batch":
+        from .commands.batch import batch
+        batch(args.assemblies_parent, args.out_parent, args.kmer,
+              args.max_contigs)
+    elif args.command == "clean":
         from .commands.clean import clean
         clean(args.in_gfa, args.out_gfa, args.remove, args.duplicate, args.min_depth)
     elif args.command == "cluster":
@@ -136,7 +156,7 @@ def dispatch(args) -> None:
         decompress(args.in_gfa, args.out_dir, args.out_file)
     elif args.command == "dotplot":
         from .commands.dotplot import dotplot
-        dotplot(args.input, args.out_png, args.res, args.kmer)
+        dotplot(args.input, args.out_png, args.res, args.kmer, args.grid_mode)
     elif args.command == "gfa2fasta":
         from .commands.gfa2fasta import gfa2fasta
         gfa2fasta(args.in_gfa, args.out_fasta)

@@ -1,0 +1,100 @@
+"""`autocycler batch`: many isolates through compress + cluster distances in
+one mesh-batched device step.
+
+This subcommand is greenfield (the reference processes one isolate per
+invocation; SURVEY.md §2.4 lists multi-chip batching as this port's design
+axis): given a directory of isolate subdirectories (each a normal
+``--assemblies_dir``), it compresses every isolate to its unitig graph,
+computes ALL isolates' exact all-vs-all contig distance matrices in one
+sharded device contraction (isolates on the mesh 'data' axis, the unitig
+axis on 'seq' — parallel.batch.batched_membership_intersections), and emits
+per-isolate clustering outputs (pairwise_distances.phylip +
+clustering.newick, same formats as `autocycler cluster`).
+
+The distances are bit-identical to what `autocycler cluster` computes per
+isolate (integer intersection matmul + the same float division), which is
+asserted by tests/test_parallel.py on a 96-isolate CPU mesh.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import List
+
+from ..models.simplify import simplify_structure
+from ..ops.distance import intersections_to_distances, membership_matrix
+from ..ops.graph_build import build_unitig_graph
+from ..parallel.batch import batched_membership_intersections
+from ..parallel.mesh import make_mesh
+from ..utils import log, quit_with_error
+from .cluster import (make_symmetrical_distances, normalise_tree,
+                      save_distance_matrix, save_tree_to_newick, upgma)
+from .compress import load_sequences
+
+
+def find_isolate_dirs(parent) -> List[Path]:
+    parent = Path(parent)
+    if not parent.is_dir():
+        quit_with_error(f"directory does not exist: {parent}")
+    isolates = sorted(d for d in parent.iterdir() if d.is_dir())
+    if not isolates:
+        quit_with_error(f"no isolate subdirectories found in {parent}")
+    return isolates
+
+
+def batch(assemblies_parent, out_parent, k_size: int = 51,
+          max_contigs: int = 25) -> None:
+    """Compress every isolate and emit per-isolate clustering from one
+    batched device distance step."""
+    if k_size < 11 or k_size > 501 or k_size % 2 == 0:
+        quit_with_error("--kmer must be an odd number between 11 and 501")
+    log.section_header("Starting autocycler batch")
+    log.explanation("Each isolate subdirectory is compressed into a unitig graph; the "
+                    "exact all-vs-all contig distance matrices of ALL isolates are then "
+                    "computed in a single sharded device step and clustered per isolate.")
+    isolates = find_isolate_dirs(assemblies_parent)
+    out_parent = Path(out_parent)
+    os.makedirs(out_parent, exist_ok=True)
+
+    seq_lists, Ms, ws = [], [], []
+    for iso in isolates:
+        log.message(f"Compressing isolate {iso.name}")
+        from ..metrics import InputAssemblyMetrics
+        sequences, _ = load_sequences(iso, k_size, InputAssemblyMetrics(),
+                                      max_contigs)
+        graph = build_unitig_graph(sequences, k_size)
+        simplify_structure(graph, sequences)
+        out_dir = out_parent / iso.name
+        os.makedirs(out_dir, exist_ok=True)
+        graph.save_gfa(out_dir / "input_assemblies.gfa", sequences)
+        M, w, ids = membership_matrix(graph, sequences)
+        seq_lists.append((sequences, ids))
+        Ms.append(M)
+        ws.append(w)
+    log.message()
+
+    log.section_header("Batched distance step")
+    log.explanation("Isolates ride the mesh 'data' axis; the unitig axis is sharded over "
+                    "'seq' and contracted with an integer matmul + psum, so every "
+                    "isolate's matrix is exactly the single-isolate computation.")
+    mesh = make_mesh()
+    inters = batched_membership_intersections(mesh, Ms, ws)
+
+    for iso, (sequences, ids), inter in zip(isolates, seq_lists, inters):
+        distances = intersections_to_distances(inter, ids)
+        clustering_dir = out_parent / iso.name / "clustering"
+        os.makedirs(clustering_dir, exist_ok=True)
+        save_distance_matrix(distances, sequences,
+                             clustering_dir / "pairwise_distances.phylip")
+        if len(sequences) > 1:
+            tree = upgma(make_symmetrical_distances(distances, sequences),
+                         sequences)
+            normalise_tree(tree)
+            save_tree_to_newick(tree, sequences,
+                                clustering_dir / "clustering.newick")
+        log.message(f"{iso.name}: {len(sequences)} contigs clustered")
+
+    log.section_header("Finished!")
+    log.message(f"Per-isolate outputs: {out_parent}/<isolate>/clustering/")
+    log.message()

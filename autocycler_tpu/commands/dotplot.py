@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import os
 from pathlib import Path
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -43,7 +43,8 @@ FORWARD_DOT = (0, 0, 205)
 REVERSE_DOT = (178, 34, 34)
 
 
-def dotplot(input_path, out_png, res: int = 2000, kmer: int = 32) -> None:
+def dotplot(input_path, out_png, res: int = 2000, kmer: int = 32,
+            grid_mode: str = "auto") -> None:
     if res < 500:
         quit_with_error("--res cannot be less than 500")
     if res > 10000:
@@ -52,12 +53,14 @@ def dotplot(input_path, out_png, res: int = 2000, kmer: int = 32) -> None:
         quit_with_error("--kmer cannot be less than 10")
     if kmer > 100:
         quit_with_error("--kmer cannot be greater than 100")
+    if grid_mode not in ("auto", "host", "device"):
+        quit_with_error("--grid-mode must be auto, host or device")
     log.section_header("Starting autocycler dotplot")
     log.explanation("This command will take a unitig graph (either before or after "
                     "trimming) and generate a dotplot image containing all pairwise "
                     "comparisons of the sequences.")
     seqs = load_dotplot_sequences(input_path)
-    create_dotplot(seqs, out_png, res, kmer)
+    create_dotplot(seqs, out_png, res, kmer, grid_mode)
     log.section_header("Finished!")
     log.message(f"Pairwise dotplots: {out_png}")
     log.message()
@@ -134,6 +137,78 @@ def get_positions(seqs, res: int, kmer: int, top_left_gap: int, bottom_right_gap
     return start_positions, end_positions, bp_per_pixel
 
 
+# Device-grid dispatch threshold for --grid-mode auto: the Pallas match grid
+# is O(nA*nB) while the host sort-join is near-linear, so on measurement the
+# host path wins at every size through the current remote-execution tunnel
+# (see docs/architecture.md "dotplot grid" table). auto therefore behaves
+# like host; the device path stays available via --grid-mode device and is
+# pixel-exact (coarse device tiles + exact per-tile refinement).
+DEVICE_GRID_MIN_CELLS = None
+
+
+# Above this many grid cells the kernel's (8, 128)-broadcast count output no
+# longer fits device memory (out bytes = 1024 * cells / tile^2 * 4); pairs
+# beyond it always use the host sort-join, which is near-linear anyway.
+MAX_DEVICE_CELLS = 5e11
+
+
+def _device_match_pair(a_words: np.ndarray, b_words: np.ndarray, tile: int = 2048
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+    """Exact (i, j) match positions via the Pallas coarse count grid: run the
+    device kernel for tile-level counts, then refine only NONZERO tiles with
+    an exact numpy equality block (matches are sparse — diagonals — so the
+    refinement touches a vanishing fraction of the grid)."""
+    from ..ops.dotplot_pallas import match_grid
+
+    tiles = np.asarray(match_grid(a_words, b_words, tile_a=tile, tile_b=tile))
+    iis: List[np.ndarray] = []
+    jjs: List[np.ndarray] = []
+    W = a_words.shape[0]
+    for ti, tj in np.argwhere(tiles > 0):
+        a = a_words[:, ti * tile:(ti + 1) * tile]
+        b = b_words[:, tj * tile:(tj + 1) * tile]
+        eq = np.ones((a.shape[1], b.shape[1]), dtype=bool)
+        for w in range(W):
+            eq &= a[w][:, None] == b[w][None, :]
+        ii, jj = np.nonzero(eq)
+        iis.append(ii.astype(np.int64) + ti * tile)
+        jjs.append(jj.astype(np.int64) + tj * tile)
+    if not iis:
+        z = np.zeros(0, np.int64)
+        return z, z
+    return np.concatenate(iis), np.concatenate(jjs)
+
+
+def kmer_match_positions_device(seq_a: np.ndarray, seq_b: np.ndarray, kmer: int
+                                ) -> Optional[Tuple[np.ndarray, np.ndarray,
+                                                    np.ndarray, np.ndarray]]:
+    """Device-grid variant of :func:`kmer_match_positions` (same contract and
+    identical results). Returns None when inputs contain non-ACGT bytes —
+    the 2-bit device packing cannot represent them, so the caller falls back
+    to the host sort-join."""
+    from ..ops.dotplot_pallas import pack_2bit_words
+
+    n_a = len(seq_a) - kmer + 1
+    n_b = len(seq_b) - kmer + 1
+    if n_a <= 0 or n_b <= 0:
+        z = np.zeros(0, np.int64)
+        return z, z, z, z
+    if float(n_a) * float(n_b) > MAX_DEVICE_CELLS:
+        return None
+    codes_a = encode_bytes(seq_a)
+    codes_b = encode_bytes(seq_b)
+    if (codes_a == 0).any() or (codes_b == 0).any():
+        return None
+    codes_rc = encode_bytes(reverse_complement_bytes(seq_a))
+    wa = pack_2bit_words(codes_a, kmer)
+    wrc = pack_2bit_words(codes_rc, kmer)
+    wb = pack_2bit_words(codes_b, kmer)
+    fwd_i, fwd_j = _device_match_pair(wa, wb)
+    rc_i, rev_j = _device_match_pair(wrc, wb)
+    rev_i = n_a - 1 - rc_i  # reference's reverse mapping (dotplot.rs:433-450)
+    return fwd_i, fwd_j, rev_i, rev_j
+
+
 def kmer_match_positions(seq_a: np.ndarray, seq_b: np.ndarray, kmer: int
                          ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """All (i, j) k-mer matches of A-forward vs B and A-reverse vs B, with
@@ -176,7 +251,8 @@ def kmer_match_positions(seq_a: np.ndarray, seq_b: np.ndarray, kmer: int
     return fwd_i, fwd_j, rev_i, rev_j
 
 
-def create_dotplot(seqs, png_filename, res: int, kmer: int) -> None:
+def create_dotplot(seqs, png_filename, res: int, kmer: int,
+                   grid_mode: str = "auto") -> None:
     from PIL import Image, ImageDraw
 
     log.section_header("Creating dotplot")
@@ -209,7 +285,15 @@ def create_dotplot(seqs, png_filename, res: int, kmer: int) -> None:
     count = 0
     for name_a, seq_a in seqs:
         for name_b, seq_b in seqs:
-            fwd_i, fwd_j, rev_i, rev_j = kmer_match_positions(seq_a, seq_b, kmer)
+            use_device = grid_mode == "device" or (
+                grid_mode == "auto" and DEVICE_GRID_MIN_CELLS is not None and
+                max(0, len(seq_a) - kmer + 1) * max(0, len(seq_b) - kmer + 1)
+                >= DEVICE_GRID_MIN_CELLS)
+            matches = kmer_match_positions_device(seq_a, seq_b, kmer) \
+                if use_device else None
+            if matches is None:
+                matches = kmer_match_positions(seq_a, seq_b, kmer)
+            fwd_i, fwd_j, rev_i, rev_j = matches
             a0, b0 = start_positions[name_a], start_positions[name_b]
             # reverse dots first so forward dots win overlaps, like the
             # reference's draw order (dotplot.rs:394-423)

@@ -39,6 +39,24 @@ def membership_matrix(graph, sequences) -> Tuple[np.ndarray, np.ndarray, List[in
     return M, w, ids
 
 
+def _intersections_to_matrix(inter: np.ndarray) -> np.ndarray:
+    """Integer intersection matrix -> asymmetric distance matrix. The single
+    float expression shared by every backend (host matmul, device matmul,
+    mesh-batched contraction) so their results stay bit-identical."""
+    a_len = np.diag(inter).astype(np.float64)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        return 1.0 - inter / a_len[:, None]
+
+
+def intersections_to_distances(inter: np.ndarray, ids: List[int]
+                               ) -> Dict[Tuple[int, int], float]:
+    """Reference-shaped {(id_a, id_b): distance} from an integer
+    intersection matrix (used by `cluster` and the batched `batch` path)."""
+    D = _intersections_to_matrix(inter)
+    return {(ids[a], ids[b]): float(D[a, b])
+            for a in range(len(ids)) for b in range(len(ids))}
+
+
 def pairwise_distance_matrix(M: np.ndarray, w: np.ndarray,
                              use_jax=None) -> np.ndarray:
     """Asymmetric distance matrix D[a, b] = 1 - |A∩B|_len / |A|_len."""
@@ -56,10 +74,7 @@ def pairwise_distance_matrix(M: np.ndarray, w: np.ndarray,
             inter = Mw @ M.astype(np.int64).T
     else:
         inter = Mw @ M.astype(np.int64).T
-    a_len = np.diag(inter).astype(np.float64)
-    with np.errstate(divide="ignore", invalid="ignore"):
-        D = 1.0 - inter / a_len[:, None]
-    return D
+    return _intersections_to_matrix(inter)
 
 
 def pairwise_contig_distances(graph, sequences, use_jax=None

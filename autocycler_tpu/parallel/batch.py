@@ -148,3 +148,60 @@ def sharded_multi_isolate_step(mesh, codes: np.ndarray, k: int = DEFAULT_K,
                      in_specs=P("data", None, "seq"),
                      out_specs=P("data", None, None))
     return jax.jit(step)(codes)
+
+
+# ---------------------------------------------------------------------------
+# Exact batched distances (the production multi-isolate step)
+# ---------------------------------------------------------------------------
+
+def _membership_body(Mw, M, seq_axis: str):
+    """shard_map body: contract the (sharded) unitig axis locally on the MXU
+    and psum partial intersections over 'seq' — integer arithmetic end to
+    end, so the result is exactly the unsharded matmul."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    inter_local = jnp.einsum("bsu,btu->bst", Mw, M,
+                             preferred_element_type=jnp.int32)
+    return lax.psum(inter_local, seq_axis)
+
+
+def batched_membership_intersections(mesh, M_list: List[np.ndarray],
+                                     w_list: List[np.ndarray]) -> List[np.ndarray]:
+    """Exact per-isolate contig intersection matrices, batched over the mesh.
+
+    This is ops.distance.pairwise_distance_matrix semantics (reference
+    cluster.rs:132-157: |A∩B| weighted by unitig length) for MANY isolates at
+    once: isolates ride the 'data' axis (pure data parallelism), the unitig
+    axis is sharded over 'seq' and contracted with an int32 einsum + psum —
+    integers all the way, so each isolate's matrix is bit-identical to the
+    single-isolate computation.
+
+    M_list[i]: [S_i, U_i] uint8 membership; w_list[i]: [U_i] int64 unitig
+    lengths. Returns per-isolate [S_i, S_i] int64 intersection matrices
+    (divide by the diagonal on the host for the asymmetric distances).
+    """
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    B = len(M_list)
+    data_size, seq_size = mesh.devices.shape
+    S = max((m.shape[0] for m in M_list), default=1)
+    U = max((m.shape[1] for m in M_list), default=1)
+    U = -(-U // seq_size) * seq_size          # pad unitig axis to seq shards
+    Bp = -(-B // data_size) * data_size       # pad batch to data shards
+
+    Mw = np.zeros((Bp, S, U), dtype=np.int32)
+    M = np.zeros((Bp, S, U), dtype=np.int32)
+    for i, (m, w) in enumerate(zip(M_list, w_list)):
+        s, u = m.shape
+        M[i, :s, :u] = m
+        Mw[i, :s, :u] = m.astype(np.int64) * w[None, :]
+
+    step = shard_map(functools.partial(_membership_body, seq_axis="seq"),
+                     mesh=mesh,
+                     in_specs=(P("data", None, "seq"), P("data", None, "seq")),
+                     out_specs=P("data", None, None))
+    inter = np.asarray(jax.jit(step)(Mw, M)).astype(np.int64)
+    return [inter[i, :m.shape[0], :m.shape[0]] for i, m in enumerate(M_list)]

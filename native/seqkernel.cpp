@@ -491,9 +491,11 @@ int32_t sk_scan_gram_fetch(int32_t* out_query, int32_t* out_text,
                            int64_t* out_pos) {
     if (!gramscan::g_res) return -1;
     std::unique_ptr<gramscan::Res> res = std::move(gramscan::g_res);
-    std::memcpy(out_query, res->q.data(), sizeof(int32_t) * res->q.size());
-    std::memcpy(out_text, res->t.data(), sizeof(int32_t) * res->t.size());
-    std::memcpy(out_pos, res->p.data(), sizeof(int64_t) * res->p.size());
+    if (!res->q.empty()) {  // vector::data() may be null when empty
+        std::memcpy(out_query, res->q.data(), sizeof(int32_t) * res->q.size());
+        std::memcpy(out_text, res->t.data(), sizeof(int32_t) * res->t.size());
+        std::memcpy(out_pos, res->p.data(), sizeof(int64_t) * res->p.size());
+    }
     return 0;
 }
 

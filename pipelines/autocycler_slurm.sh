@@ -4,12 +4,13 @@
 # are submitted as a Slurm array and the consensus stages run as a dependent
 # job. Adjust partitions/accounts for your cluster.
 #
-# Usage: autocycler_slurm.sh <reads.fastq> <genome_size>
+# Usage: autocycler_slurm.sh <reads.fastq> <genome_size> [read_type]
 
 set -euo pipefail
 
 reads=$1
 genome_size=$2
+read_type=${3:-ont_r10}
 threads=${SLURM_CPUS_PER_TASK:-16}
 autocycler=${AUTOCYCLER_CMD:-"python -m autocycler_tpu"}
 
@@ -17,7 +18,7 @@ $autocycler subsample --reads "$reads" --out_dir subsampled_reads \
     --genome_size "$genome_size"
 
 mkdir -p assemblies slurm_logs
-assemblers=(canu flye metamdbg miniasm necat nextdenovo raven)
+assemblers=(raven myloasm miniasm flye metamdbg necat nextdenovo plassembler canu)
 
 # one array task per (assembler, subset)
 cat > assembler_job.sh <<EOF
@@ -29,7 +30,7 @@ s=\$(printf '%02d' \$((SLURM_ARRAY_TASK_ID % 4 + 1)))
 a=\${assemblers[\$i]}
 $autocycler helper \$a --reads subsampled_reads/sample_\$s.fastq \
     --out_prefix assemblies/\${a}_\$s --threads $threads \
-    --genome_size $genome_size --min_depth_rel 0.1 || true
+    --genome_size $genome_size --read_type $read_type --min_depth_rel 0.1 || true
 EOF
 
 n_jobs=$(( ${#assemblers[@]} * 4 - 1 ))
@@ -39,6 +40,15 @@ asm_job=$(sbatch --parsable --array=0-$n_jobs --time=8:00:00 \
 cat > consensus_job.sh <<EOF
 #!/usr/bin/env bash
 set -euo pipefail
+# weight tags, same sed semantics as the reference full script
+shopt -s nullglob
+for f in assemblies/plassembler*.fasta; do
+    sed -i 's/circular=True/circular=True Autocycler_cluster_weight=3/' "\$f"
+done
+for f in assemblies/canu*.fasta assemblies/flye*.fasta; do
+    sed -i 's/^>.*\$/& Autocycler_consensus_weight=2/' "\$f"
+done
+shopt -u nullglob
 $autocycler compress --assemblies_dir assemblies --autocycler_dir autocycler_out
 $autocycler cluster --autocycler_dir autocycler_out
 for c in autocycler_out/clustering/qc_pass/cluster_*; do

@@ -55,3 +55,24 @@ def test_dotplot_png(tmp_path):
     arr = np.array(img)
     # forward (mediumblue) and reverse-complement (firebrick) dots both exist
     assert ((arr == np.array([0, 0, 205])).all(axis=2)).sum() > 100
+
+
+def test_device_grid_mode_identical_png(tmp_path):
+    """--grid-mode device (Pallas coarse grid + exact per-tile refinement)
+    must produce a byte-identical PNG to the host sort-join."""
+    fasta = tmp_path / "seqs.fasta"
+    import random
+    rng = random.Random(5)
+    s1 = "".join(rng.choice("ACGT") for _ in range(700))
+    fasta.write_text(f">s1\n{s1}\n>s2\n{s1[300:] + s1[:300]}\n")
+    host_png = tmp_path / "host.png"
+    dev_png = tmp_path / "dev.png"
+    dotplot(fasta, host_png, res=500, kmer=12, grid_mode="host")
+    dotplot(fasta, dev_png, res=500, kmer=12, grid_mode="device")
+    assert host_png.read_bytes() == dev_png.read_bytes()
+
+
+def test_device_grid_falls_back_on_non_acgt():
+    from autocycler_tpu.commands.dotplot import kmer_match_positions_device
+    seq = b("ACGTNNNNACGTACGTACGT")
+    assert kmer_match_positions_device(seq, seq, 10) is None
