@@ -501,7 +501,8 @@ def save_cluster_gfa(sequences: List[Sequence], cluster_num: int,
     cluster_seqs = [_clone_seq(s) for s in sequences if s.cluster == cluster_num]
     to_remove = [s.id for s in sequences if s.cluster != cluster_num]
     filtered = filter_gfa_lines(gfa_lines, to_remove)
-    cluster_graph, _ = UnitigGraph.from_gfa_lines(filtered)
+    # these lines were generated (and invariant-checked) by this process
+    cluster_graph, _ = UnitigGraph.from_gfa_lines(filtered, check=False)
     cluster_graph.recalculate_depths()
     cluster_graph.remove_zero_depth_unitigs()
     merge_linear_paths(cluster_graph, cluster_seqs)

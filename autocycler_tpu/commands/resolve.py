@@ -17,7 +17,8 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from ..models import Sequence, Unitig, UnitigGraph, UnitigType
 from ..models.simplify import merge_linear_paths
-from ..ops.align import global_alignment_distance
+from ..ops.align import (global_alignment_distance,
+                         global_alignment_distance_batch)
 from ..utils import (load_file_lines, log, quit_with_error, reverse_signed_path,
                      sign_at_end, sign_at_end_vec)
 
@@ -29,24 +30,36 @@ class Bridge:
     __slots__ = ("start", "end", "all_paths", "best_path", "conflicting")
 
     def __init__(self, start: int, end: int, all_paths: List[List[int]],
-                 unitig_lengths: Dict[int, int]):
+                 unitig_lengths: Dict[int, int],
+                 pair_distances: Optional[Dict[tuple, int]] = None):
         trimmed = [path[1:-1] for path in all_paths]
         # The medoid objective Σ_j d(i, j) over occurrences equals
         # Σ_distinct_j mult_j · d(i, j) (self-distance is 0), so distances
         # are computed between DISTINCT paths only — groups are dominated by
-        # duplicates since most assemblies agree on each bridge.
+        # duplicates since most assemblies agree on each bridge. When
+        # pair_distances is supplied (create_bridges computes every group's
+        # pairs in ONE batched DP), the per-pair Python calls vanish.
         mult: Dict[tuple, int] = {}
         for path in trimmed:
             mult[tuple(path)] = mult.get(tuple(path), 0) + 1
         distinct = sorted(mult)  # lexicographic: ties resolve to smaller path
+
+        def dist(pi: tuple, pj: tuple) -> int:
+            if pair_distances is not None:
+                got = pair_distances.get((pi, pj))
+                if got is None:
+                    got = pair_distances.get((pj, pi))
+                if got is not None:
+                    return got
+            return global_alignment_distance(pi, pj, unitig_lengths)
+
         best_path: List[int] = []
         best_total = None
         for path_i in distinct:
             total = 0
             for path_j, m in mult.items():
                 if path_j != path_i:
-                    total += m * global_alignment_distance(path_i, path_j,
-                                                           unitig_lengths)
+                    total += m * dist(path_i, path_j)
             if best_total is None or total < best_total:
                 best_total = total
                 best_path = list(path_i)
@@ -136,10 +149,35 @@ def create_bridges(graph: UnitigGraph, sequences: List[Sequence], anchors: List[
     a_to_a = get_anchor_to_anchor_paths(sequence_paths, anchor_set)
     grouped = group_paths_by_start_end(a_to_a)
     unitig_lengths = {u.number: u.length() for u in graph.unitigs}
-    bridges = [Bridge(start, end, paths, unitig_lengths)
+    pair_distances = _batched_medoid_distances(grouped, unitig_lengths)
+    bridges = [Bridge(start, end, paths, unitig_lengths, pair_distances)
                for (start, end), paths in grouped.items()]
     bridges.sort(key=Bridge.sort_key)
     return bridges
+
+
+def _batched_medoid_distances(grouped, unitig_lengths) -> Dict[tuple, int]:
+    """Every bridge group's distinct-path pairs through ONE vectorised DP
+    (ops.align.global_alignment_distance_batch) instead of O(paths^2) tiny
+    Python calls per bridge (reference resolve.rs:387-418 scope)."""
+    wanted = {}
+    for paths in grouped.values():
+        distinct = sorted({tuple(p[1:-1]) for p in paths})
+        for i, pi in enumerate(distinct):
+            for pj in distinct[i + 1:]:
+                wanted.setdefault((pi, pj), None)
+    # the batch pads every pair to the global max length, so a rare long
+    # outlier pair would multiply the whole batch's cost — route those
+    # through the scalar DP instead (sum(n*m) cost, no padding)
+    batch_pairs, long_pairs = [], []
+    for pair in wanted:
+        (batch_pairs if max(len(pair[0]), len(pair[1])) <= 64
+         else long_pairs).append(pair)
+    dists = global_alignment_distance_batch(batch_pairs, unitig_lengths)
+    out = {pair: int(d) for pair, d in zip(batch_pairs, dists)}
+    for pi, pj in long_pairs:
+        out[(pi, pj)] = global_alignment_distance(pi, pj, unitig_lengths)
+    return out
 
 
 def determine_ambiguity(bridges: List[Bridge]) -> int:

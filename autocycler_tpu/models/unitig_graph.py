@@ -60,7 +60,11 @@ class UnitigGraph:
         return cls.from_gfa_lines(load_file_lines(gfa_filename))
 
     @classmethod
-    def from_gfa_lines(cls, gfa_lines) -> Tuple["UnitigGraph", List[Sequence]]:
+    def from_gfa_lines(cls, gfa_lines,
+                       check: bool = True) -> Tuple["UnitigGraph", List[Sequence]]:
+        """check=False skips the link-invariant pass — only for re-loading
+        lines this process just generated itself (e.g. per-cluster subsetting
+        of an in-memory graph); external files are always checked."""
         graph = cls()
         link_lines, path_lines = [], []
         for line in gfa_lines:
@@ -78,7 +82,8 @@ class UnitigGraph:
         graph.build_index()
         graph._build_links_from_gfa(link_lines)
         sequences = graph._build_paths_from_gfa(path_lines)
-        graph.check_links()
+        if check:
+            graph.check_links()
         return graph, sequences
 
     def _read_header_line(self, parts: List[str]) -> None:

@@ -191,6 +191,79 @@ def find_midpoint(alignment: List[AlignmentPiece], weights: Weights) -> int:
     return best_index
 
 
+def global_alignment_distance_batch(pairs, weights: Weights,
+                                    use_jax: bool = False) -> np.ndarray:
+    """Many global-alignment distances in one padded, vectorised DP — the
+    batched form of :func:`global_alignment_distance` (identical integers
+    per pair). Used by resolve's medoid selection, which otherwise issues
+    O(paths^2) tiny Python-level DP calls per bridge (resolve.rs:387-418).
+
+    use_jax=True runs the identical recurrence as a lax.scan on the default
+    device (measured slower than the host at bridge scale through the
+    current TPU tunnel — docs/architecture.md "resolve medoid DP" table —
+    so the host path is the default)."""
+    P = len(pairs)
+    if P == 0:
+        return np.zeros(0, np.int64)
+    n_len = np.array([len(a) for a, _ in pairs], dtype=np.int64)
+    m_len = np.array([len(b) for _, b in pairs], dtype=np.int64)
+    n_max = max(int(n_len.max()), 1)
+    m_max = max(int(m_len.max()), 1)
+    A = np.zeros((P, n_max), np.int64)
+    B = np.zeros((P, m_max), np.int64)
+    WA = np.zeros((P, n_max), np.int64)
+    WB = np.zeros((P, m_max), np.int64)
+    for p, (a, b) in enumerate(pairs):
+        A[p, :len(a)] = a
+        B[p, :len(b)] = b
+        WA[p, :len(a)] = [weights[abs(int(u))] for u in a]
+        WB[p, :len(b)] = [weights[abs(int(u))] for u in b]
+
+    Wb = np.zeros((P, m_max + 1), np.int64)
+    np.cumsum(WB, axis=1, out=Wb[:, 1:])
+
+    if use_jax:
+        import jax
+        import jax.numpy as jnp
+
+        def row_step(prev, xs):
+            a_col, wi, active = xs
+            mismatch = jnp.where(a_col[:, None] == Bd, 0,
+                                 jnp.maximum(wi[:, None], WBd))
+            base = jnp.minimum(prev[:, :-1] + mismatch,
+                               prev[:, 1:] + wi[:, None])
+            left = prev[:, 0] + wi
+            run = jax.lax.associative_scan(
+                jnp.minimum,
+                jnp.concatenate([left[:, None], base - Wbd[:, 1:]], axis=1),
+                axis=1)
+            row = jnp.concatenate([left[:, None], run[:, 1:] + Wbd[:, 1:]],
+                                  axis=1)
+            return jnp.where(active[:, None], row, prev), None
+
+        Bd, WBd, Wbd = jnp.asarray(B), jnp.asarray(WB), jnp.asarray(Wb)
+        active_rows = (np.arange(n_max)[:, None] < n_len[None, :])
+        final, _ = jax.lax.scan(
+            row_step, jnp.asarray(Wb),
+            (jnp.asarray(A.T), jnp.asarray(WA.T), jnp.asarray(active_rows)))
+        prev = np.asarray(final)
+        return prev[np.arange(P), m_len]
+
+    prev = Wb.copy()
+    for i in range(n_max):
+        active = i < n_len
+        wi = WA[:, i]
+        mismatch = np.where(A[:, i:i + 1] == B, 0,
+                            np.maximum(wi[:, None], WB))
+        base = np.minimum(prev[:, :-1] + mismatch, prev[:, 1:] + wi[:, None])
+        left = prev[:, 0] + wi
+        run = np.minimum.accumulate(
+            np.concatenate([left[:, None], base - Wb[:, 1:]], axis=1), axis=1)
+        row = np.concatenate([left[:, None], run[:, 1:] + Wb[:, 1:]], axis=1)
+        prev = np.where(active[:, None], row, prev)
+    return prev[np.arange(P), m_len]
+
+
 def global_alignment_distance(path_a: Sequence[int], path_b: Sequence[int],
                               weights: Weights) -> int:
     """Weighted global alignment (Needleman-Wunsch) distance between two

@@ -30,17 +30,32 @@ def unitig_graph_from_chains(index: KmerIndex, chains: Chains) -> UnitigGraph:
     last_byte = index.buf[index.rep_byte + k - 1]
 
     C = chains.count
-    fwd_start_gram = np.zeros(C, np.int64)
-    fwd_end_gram = np.zeros(C, np.int64)
-    rev_start_gram = np.zeros(C, np.int64)
+    members_all = chains.members
+    chain_off = chains.chain_off
+    sizes = np.diff(chain_off)
+    heads = members_all[chain_off[:-1]] if C else np.zeros(0, np.int64)
+    tails = members_all[chain_off[1:] - 1] if C else np.zeros(0, np.int64)
+    rev_tails = index.rev_kid[tails].astype(np.int64) if C else heads
+
+    # ---- chain sequences, assembled in one pass over all chains ----
+    # untrimmed chain sequence = head k-mer bytes + last byte of each
+    # following k-mer; trimming removes half_k from both ends, so trimmed
+    # byte i of a chain is the head window byte h+i while h+i < k and the
+    # last byte of member i-h after that
+    slot = np.arange(len(members_all), dtype=np.int64)
+    chain_of_slot = np.repeat(np.arange(C, dtype=np.int64), sizes)
+    pos_ic = slot - chain_off[chain_of_slot]
+    from_head = pos_ic <= h
+    head_byte_idx = index.rep_byte[heads[chain_of_slot]] + h + np.minimum(pos_ic, h)
+    tail_byte = last_byte[members_all[np.maximum(slot - h, 0)]]
+    seq_bytes = np.where(from_head, index.buf[head_byte_idx], tail_byte)
+
+    depths = (np.add.reduceat(index.depth[members_all].astype(np.float64),
+                              chain_off[:-1]) / sizes) if C else np.zeros(0)
 
     # batched position query for every chain head and reverse-complement tail
-    query_kids = np.empty(2 * C, np.int64)
-    for c in range(C):
-        members = chains.chain(c)
-        query_kids[2 * c] = members[0]
-        query_kids[2 * c + 1] = index.rev_kid[members[-1]]
-    positions = index.positions_for_kmers(query_kids) if C else {}
+    positions = index.positions_for_kmers(
+        np.concatenate([heads, rev_tails])) if C else {}
 
     def _mk_positions(kid: int) -> List[Position]:
         seq_idx, strand, pos = positions[int(kid)]
@@ -49,25 +64,18 @@ def unitig_graph_from_chains(index: KmerIndex, chains: Chains) -> UnitigGraph:
                 for i, s, p in zip(ids, strand, pos)]
 
     for c in range(C):
-        members = chains.chain(c)
-        head, tail = int(members[0]), int(members[-1])
-        n = len(members)
-
-        # untrimmed chain sequence: head k-mer bytes + last byte of each
-        # following k-mer; trimming removes half_k from both ends
-        head_bytes = index.buf[index.rep_byte[head]:index.rep_byte[head] + k]
-        untrimmed = np.concatenate([head_bytes, last_byte[members[1:]]])
-        trimmed = untrimmed[h:h + n].copy()
-
-        unitig = Unitig(number=c + 1, forward_seq=trimmed)
-        unitig.depth = float(index.depth[members].mean())
-        unitig.forward_positions = _mk_positions(head)
-        unitig.reverse_positions = _mk_positions(index.rev_kid[tail])
+        unitig = Unitig(number=c + 1,
+                        forward_seq=seq_bytes[chain_off[c]:chain_off[c + 1]].copy())
+        unitig.depth = float(depths[c])
+        unitig.forward_positions = _mk_positions(heads[c])
+        unitig.reverse_positions = _mk_positions(rev_tails[c])
         graph.unitigs.append(unitig)
 
-        fwd_start_gram[c] = index.prefix_gid[head]
-        fwd_end_gram[c] = index.suffix_gid[tail]
-        rev_start_gram[c] = index.prefix_gid[index.rev_kid[tail]]
+    fwd_start_gram = index.prefix_gid[heads].astype(np.int64)
+    fwd_end_gram = index.suffix_gid[tails].astype(np.int64)
+    rev_start_gram = index.prefix_gid[rev_tails].astype(np.int64)
+    rev_end_gram = index.suffix_gid[index.rev_kid[heads]].astype(np.int64) \
+        if C else fwd_start_gram
 
     # rev_end_gram is the strand mirror of fwd_start_gram's matching rule;
     # matching uses the same three joins as the reference (unitig_graph.rs:253-285)
@@ -76,8 +84,6 @@ def unitig_graph_from_chains(index: KmerIndex, chains: Chains) -> UnitigGraph:
     for c in range(C):
         by_fwd_start.setdefault(int(fwd_start_gram[c]), []).append(c)
         by_rev_start.setdefault(int(rev_start_gram[c]), []).append(c)
-    rev_end_gram = [int(index.suffix_gid[index.rev_kid[int(chains.chain(c)[0])]])
-                    for c in range(C)]
 
     for c in range(C):
         a = graph.unitigs[c]

@@ -165,3 +165,25 @@ def test_global_alignment_distance():
     assert global_alignment_distance([1, 2, 3], [1, 4, 3], w) == 40   # mismatch max(20,40)
     assert global_alignment_distance([], [1, 2], w) == 30
     assert global_alignment_distance([1, -1], [1, 1], w) == 10        # strand mismatch
+
+
+def test_global_alignment_distance_batch_matches_scalar():
+    """The batched medoid DP (host and device variants) must produce the
+    exact integers of the scalar DP for every pair, including empty paths."""
+    import numpy as np
+
+    from autocycler_tpu.ops.align import (global_alignment_distance,
+                                          global_alignment_distance_batch)
+    rng = np.random.default_rng(4)
+    weights = {i: int(rng.integers(1, 2000)) for i in range(1, 30)}
+    pairs = []
+    for _ in range(100):
+        la, lb = int(rng.integers(0, 10)), int(rng.integers(0, 10))
+        pairs.append((
+            tuple(int(x) for x in rng.integers(1, 30, la) * rng.choice([-1, 1], la)),
+            tuple(int(x) for x in rng.integers(1, 30, lb) * rng.choice([-1, 1], lb))))
+    host = global_alignment_distance_batch(pairs, weights)
+    for (a, b), d in zip(pairs, host):
+        assert int(d) == global_alignment_distance(a, b, weights)
+    dev = global_alignment_distance_batch(pairs, weights, use_jax=True)
+    assert np.array_equal(np.asarray(host), np.asarray(dev))
