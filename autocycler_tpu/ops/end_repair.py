@@ -32,11 +32,23 @@ from .kmers import group_windows
 
 def _find_best_match(candidates: List[bytes]) -> bytes:
     """(fewest dots, most frequent, lexicographically first)
-    (reference compress.rs:239-270)."""
+    (reference compress.rs:239-270). The key depends only on the candidate
+    value, so the min runs over DISTINCT candidates. Kept as the scalar
+    oracle for :func:`_best_match_rows` (tests/test_edge_cases.py)."""
     counts: Dict[bytes, int] = {}
     for c in candidates:
         counts[c] = counts.get(c, 0) + 1
-    return min(candidates, key=lambda c: (c.count(b"."), -counts[c], c))
+    return min(counts, key=lambda c: (c.count(b"."), -counts[c], c))
+
+
+def _best_match_rows(rows: np.ndarray) -> bytes:
+    """Vectorised `_find_best_match` over a [N, overlap] byte matrix: dedupe
+    with counts, then pick (fewest dots, most frequent, lexicographically
+    first) without materialising per-occurrence byte objects."""
+    distinct, counts = np.unique(rows, axis=0, return_counts=True)  # sorted
+    dots = (distinct == ord(".")).sum(axis=1)
+    order = np.lexsort((np.arange(len(distinct)), -counts, dots))
+    return distinct[order[0]].tobytes()
 
 
 def _matches_by_query_native(codes, text_off, text_len, h, q_starts):
@@ -128,26 +140,30 @@ def sequence_end_repair(sequences: List[Sequence], k_size: int) -> None:
     if by_query is None:
         by_query = _matches_by_query_grouped(codes, text_off, text_len, h, q_starts)
 
-    def candidates(q: int, core_offset: int) -> List[bytes]:
-        """Non-overlapping (k-1)-byte candidate windows for query q, whose
-        core h-gram sits at ``core_offset`` within the pattern."""
+    def best_candidate(q: int, core_offset: int) -> bytes:
+        """Best non-overlapping (k-1)-byte candidate window for query q,
+        whose core h-gram sits at ``core_offset`` within the pattern."""
         t_arr, p_arr = by_query[q]
         j_arr = p_arr - core_offset  # pattern start within the text
         valid = (j_arr >= 0) & (j_arr + overlap <= text_len[t_arr])
-        out: List[bytes] = []
+        t_v = t_arr[valid]
+        j_v = j_arr[valid]
+        keep = np.empty(len(t_v), dtype=bool)
         prev_text, prev_end = -1, -1
-        for ti, ji in zip(t_arr[valid], j_arr[valid]):
+        for idx, (ti, ji) in enumerate(zip(t_v.tolist(), j_v.tolist())):
             if ti == prev_text and ji < prev_end:
-                continue  # regex find_iter skips overlapping matches
+                keep[idx] = False  # regex find_iter skips overlapping matches
+                continue
+            keep[idx] = True
             prev_text, prev_end = ti, ji + overlap
-            start = text_off[ti] + ji
-            out.append(buf[start:start + overlap].tobytes())
-        return out
+        starts = text_off[t_v[keep]] + j_v[keep]
+        rows = buf[starts[:, None] + np.arange(overlap)]
+        return _best_match_rows(rows)
 
     for i, s in enumerate(sequences):
         P = len(s.forward_seq)
-        best_start = _find_best_match(candidates(2 * i, h))
-        best_end = _find_best_match(candidates(2 * i + 1, 0))
+        best_start = best_candidate(2 * i, h)
+        best_end = best_candidate(2 * i + 1, 0)
         repaired = s.forward_seq.copy()
         repaired[:overlap] = np.frombuffer(best_start, dtype=np.uint8)
         repaired[P - overlap:] = np.frombuffer(best_end, dtype=np.uint8)

@@ -53,3 +53,20 @@ def test_two_contig_same_sequence(tmp_path):
     # identical contigs collapse onto the same single unitig path
     assert len(graph.unitigs) == 1
     assert graph.unitigs[0].depth == 2.0
+
+
+def test_best_match_rows_matches_scalar_oracle():
+    """_best_match_rows (vectorised) must reproduce the scalar
+    _find_best_match tie-break — fewest dots, most frequent,
+    lexicographically first — on random candidate sets."""
+    import numpy as np
+
+    from autocycler_tpu.ops.end_repair import _best_match_rows, _find_best_match
+    rng = np.random.default_rng(8)
+    alphabet = np.frombuffer(b".ACGT", dtype=np.uint8)
+    for _ in range(300):
+        n = int(rng.integers(1, 40))
+        width = int(rng.integers(1, 12))
+        rows = alphabet[rng.integers(0, 5, size=(n, width))]
+        scalar = _find_best_match([r.tobytes() for r in rows])
+        assert _best_match_rows(rows) == scalar
