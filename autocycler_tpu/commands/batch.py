@@ -18,6 +18,7 @@ asserted by tests/test_parallel.py on a 96-isolate CPU mesh.
 
 from __future__ import annotations
 
+import gc
 import os
 from pathlib import Path
 from typing import List
@@ -72,6 +73,11 @@ def batch(assemblies_parent, out_parent, k_size: int = 51,
         seq_lists.append((sequences, ids))
         Ms.append(M)
         ws.append(w)
+        del graph
+        # the CLI disables the cycle collector; each isolate's graph is
+        # reference-cyclic, so reclaim it explicitly or RSS grows by one
+        # full graph per isolate
+        gc.collect()
     log.message()
 
     log.section_header("Batched distance step")

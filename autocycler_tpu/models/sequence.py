@@ -14,6 +14,10 @@ import numpy as np
 
 from ..utils import quit_with_error, reverse_complement_bytes, up_to_first_space, after_first_space
 
+# byte-value lookup beats np.isin's sort-based path on Mbp arrays
+_IS_ACGT = np.zeros(256, dtype=bool)
+_IS_ACGT[np.frombuffer(b"ACGT", dtype=np.uint8)] = True
+
 _ACGT = frozenset(b"ACGT")
 
 
@@ -37,8 +41,7 @@ class Sequence:
         """Construct with the actual sequence stored, dot-padded by half_k on
         both ends (reference sequence.rs:31-59)."""
         raw = np.frombuffer(seq.encode(), dtype=np.uint8)
-        is_acgt = np.isin(raw, np.frombuffer(b"ACGT", dtype=np.uint8))
-        if not is_acgt.all():
+        if not _IS_ACGT[raw].all():
             quit_with_error(f"{filename} contains non-ACGT characters")
         pad = np.full(half_k, ord("."), dtype=np.uint8)
         forward = np.concatenate([pad, raw, pad])

@@ -333,6 +333,7 @@ def _merge_path(graph: UnitigGraph, path: List[UnitigStrand], new_number: int) -
     unitig.reverse_prev = reverse_prev
     if any(p.is_anchor() or p.is_consentig() for p in path):
         unitig.unitig_type = UnitigType.CONSENTIG
+    graph.invalidate_paths_cache()
     graph.unitigs.append(unitig)
 
     for u in unitig.forward_next:

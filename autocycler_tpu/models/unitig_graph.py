@@ -52,6 +52,11 @@ class UnitigGraph:
         # transient number -> (positions lists, length) map used while
         # stamping many paths in one batch (see _add_positions_from_path)
         self._path_helper = None
+        # paths parsed from the GFA P-lines, valid until any mutation that
+        # could change path composition (see invalidate_paths_cache callers);
+        # position-COORDINATE edits (repeat expansion) keep it valid because
+        # the (number, strand) sequence of every path is unchanged
+        self._paths_cache = None
 
     # ---------------- loading ----------------
 
@@ -123,6 +128,7 @@ class UnitigGraph:
             u.number: (u.forward_positions, u.reverse_positions,
                        len(u.forward_seq))
             for u in self.unitigs}
+        paths_cache = {}
         for parts in path_lines:
             seq_id = int(parts[1])
             length = filename = header = None
@@ -141,7 +147,9 @@ class UnitigGraph:
             path = parse_unitig_path(parts[2])
             sequences.append(self.create_sequence_and_positions(
                 seq_id, length, filename, header, cluster, path))
+            paths_cache[seq_id] = path
         self._path_helper = None
+        self._paths_cache = paths_cache
         return sequences
 
     def create_sequence_and_positions(self, seq_id: int, length: int, filename: str,
@@ -150,6 +158,7 @@ class UnitigGraph:
         """Register a sequence's path through the graph by stamping Position
         records onto each traversed unitig, both strands
         (reference unitig_graph.rs:151-174)."""
+        self.invalidate_paths_cache()
         self._add_positions_from_path(forward_path, FORWARD, seq_id, length)
         self._add_positions_from_path(reverse_path(forward_path), REVERSE, seq_id, length)
         return Sequence.without_seq(seq_id, filename, header, length, cluster)
@@ -230,6 +239,9 @@ class UnitigGraph:
     def get_sequence_from_path_signed(self, path: List[int]) -> np.ndarray:
         return self.get_sequence_from_path([(abs(n), n >= 0) for n in path])
 
+    def invalidate_paths_cache(self) -> None:
+        self._paths_cache = None
+
     def get_unitig_paths_for_sequences(self, seq_ids) -> Dict[int, List[Tuple[int, bool]]]:
         """Paths for many sequences in one sweep: every unitig's forward-
         strand positions are collected and sorted by coordinate, which
@@ -237,9 +249,16 @@ class UnitigGraph:
         neighbour walk (unitig_graph.rs:407-465) — same result, O(total
         positions) instead of O(path · degree · positions).
 
+        When the graph is unmutated since a GFA load, the parsed P-line
+        paths are returned directly (identical by construction — asserted
+        in tests/test_models_more.py).
+
         Entries are packed as (pos << 22 | number << 1 | strand) ints so the
         per-position loop allocates nothing but one int, and sorting /
         contiguity checking run in numpy."""
+        cache = self._paths_cache
+        if cache is not None and all(sid in cache for sid in seq_ids):
+            return {sid: list(cache[sid]) for sid in seq_ids}
         max_num = max((u.number for u in self.unitigs), default=0)
         if max_num >= (1 << 21):
             return self._get_unitig_paths_tuples(seq_ids)
@@ -378,6 +397,7 @@ class UnitigGraph:
         """Deterministic renumbering by (length desc, sequence lex asc,
         depth desc) — the reproducibility anchor of the whole pipeline
         (reference unitig_graph.rs:295-315)."""
+        self.invalidate_paths_cache()
         self.unitigs.sort(key=lambda u: (-u.length(), u.forward_seq.tobytes(), -u.depth))
         for i, unitig in enumerate(self.unitigs):
             unitig.number = i + 1
@@ -495,6 +515,7 @@ class UnitigGraph:
     # ---------------- unitig-level surgery ----------------
 
     def remove_sequence_from_graph(self, seq_id: int) -> None:
+        self.invalidate_paths_cache()
         for u in self.unitigs:
             u.remove_sequence(seq_id)
 
@@ -503,15 +524,18 @@ class UnitigGraph:
             u.recalculate_depth()
 
     def clear_positions(self) -> None:
+        self.invalidate_paths_cache()
         for u in self.unitigs:
             u.clear_positions()
 
     def remove_zero_depth_unitigs(self) -> None:
+        self.invalidate_paths_cache()
         self.unitigs = [u for u in self.unitigs if u.depth > 0.0]
         self.delete_dangling_links()
         self.build_index()
 
     def remove_unitigs_by_number(self, to_remove) -> None:
+        self.invalidate_paths_cache()
         to_remove = set(to_remove)
         self.unitigs = [u for u in self.unitigs if u.number not in to_remove]
         self.delete_dangling_links()
@@ -521,6 +545,7 @@ class UnitigGraph:
         """Split a unitig with exactly two non-self links into two half-depth
         copies, one link each; self-links are copied to both
         (reference unitig_graph.rs:594-653)."""
+        self.invalidate_paths_cache()
         target = self.index.get(unitig_num)
         if target is None:
             quit_with_error(f"unitig {unitig_num} not found in unitig index")
@@ -569,6 +594,7 @@ class UnitigGraph:
         """Remove unitigs at/below the depth threshold, but only when removal
         creates no dead ends (reference unitig_graph.rs:670-721). Iterates in
         reverse unitig order so longer unitigs are kept."""
+        self.invalidate_paths_cache()
         for u in list(reversed(self.unitigs)):
             if u.number not in self.index:
                 continue

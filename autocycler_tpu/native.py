@@ -213,15 +213,16 @@ def group_kmers_native(codes: np.ndarray, starts: np.ndarray,
     return order, gid[order]
 
 
-def build_occ_index(codes: np.ndarray, fwd_off: np.ndarray, rev_off: np.ndarray,
+def build_occ_index(seq_bytes: np.ndarray, fwd_off: np.ndarray, rev_off: np.ndarray,
                     seq_len: np.ndarray, k: int) -> Optional[dict]:
     """Fused occurrence-index build (k <= 55): one native call produces every
     per-occurrence and per-k-mer array ops.kmers.build_kmer_index needs.
-    Returns a dict of arrays, or None when unavailable (caller falls back)."""
+    seq_bytes is the RAW padded ASCII buffer — the kernel translates symbols
+    inline. Returns a dict of arrays, or None when unavailable."""
     lib = get_lib()
     if lib is None or not getattr(lib, "_has_occ_index", False) or k > 55:
         return None
-    codes = np.ascontiguousarray(codes, dtype=np.uint8)
+    seq_bytes = np.ascontiguousarray(seq_bytes, dtype=np.uint8)
     fwd_off = np.ascontiguousarray(fwd_off, dtype=np.int64)
     rev_off = np.ascontiguousarray(rev_off, dtype=np.int64)
     seq_len = np.ascontiguousarray(seq_len, dtype=np.int64)
@@ -229,8 +230,8 @@ def build_occ_index(codes: np.ndarray, fwd_off: np.ndarray, rev_off: np.ndarray,
     n_f = int(seq_len.sum())
     out_G = ctypes.c_int64(0)
     U = lib.sk_occ_index_build(
-        codes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-        ctypes.c_int64(len(codes)),
+        seq_bytes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.c_int64(len(seq_bytes)),
         fwd_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         rev_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         seq_len.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),

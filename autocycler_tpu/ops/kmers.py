@@ -288,7 +288,6 @@ def build_kmer_index(sequences, k: int, use_jax: Optional[bool] = None,
         bufs.append(s.reverse_seq)
         total += len(s.reverse_seq)
     buf = np.concatenate(bufs) if bufs else np.zeros(0, np.uint8)
-    codes = encode_bytes(buf)
 
     occ_off = np.zeros(S, np.int64)
     if S > 1:
@@ -299,7 +298,8 @@ def build_kmer_index(sequences, k: int, use_jax: Optional[bool] = None,
         use_fused = use_jax is not True
     from .. import native
     if use_fused and M and native.available():
-        res = native.build_occ_index(codes, fwd_off, rev_off, seq_len, k)
+        # the kernel translates ASCII -> symbols inline; no encode pass
+        res = native.build_occ_index(buf, fwd_off, rev_off, seq_len, k)
         if res is not None:
             U, G = res["U"], res["G"]
             fwd_gid, rev_kid = res["fwd_gid"], res["rev_kid"]
@@ -319,6 +319,8 @@ def build_kmer_index(sequences, k: int, use_jax: Optional[bool] = None,
                 prefix_gid=res["prefix_gid"], suffix_gid=res["suffix_gid"],
                 out_count=out_count, in_count=in_count, succ=succ,
                 first_pos=first_pos, fwd_gid=fwd_gid)
+
+    codes = encode_bytes(buf)
 
     # byte start of every occurrence window, built per contiguous strand run
     # (avoids materialising seq/strand/pos arrays of size M)

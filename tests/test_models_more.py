@@ -86,3 +86,32 @@ def test_delete_outgoing_incoming_links():
     assert not graph.link_exists(3, FORWARD, 1, FORWARD)
     assert graph.link_count() == (0, 0)
     graph.check_links()
+
+
+def test_paths_cache_matches_position_reconstruction(tmp_path):
+    """The P-line paths cache must return exactly what position-based
+    reconstruction computes, and must be dropped on mutation."""
+    import sys
+    from pathlib import Path as _P
+    sys.path.insert(0, str(_P(__file__).parent))
+    from synthetic import make_assemblies
+
+    from autocycler_tpu.commands.compress import compress
+    from autocycler_tpu.models import UnitigGraph
+
+    make_assemblies(tmp_path, n_assemblies=3, chromosome_len=2000,
+                    plasmid_len=400, n_snps=4, seed=13)
+    compress(tmp_path / "assemblies", tmp_path / "out")
+    graph, sequences = UnitigGraph.from_gfa_file(
+        tmp_path / "out" / "input_assemblies.gfa")
+    ids = [s.id for s in sequences]
+    assert graph._paths_cache is not None
+    cached = graph.get_unitig_paths_for_sequences(ids)
+    graph.invalidate_paths_cache()
+    rebuilt = graph.get_unitig_paths_for_sequences(ids)
+    assert cached == rebuilt
+    # mutation drops the cache
+    graph, sequences = UnitigGraph.from_gfa_file(
+        tmp_path / "out" / "input_assemblies.gfa")
+    graph.remove_sequence_from_graph(ids[0])
+    assert graph._paths_cache is None
