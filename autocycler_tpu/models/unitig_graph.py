@@ -195,8 +195,22 @@ class UnitigGraph:
 
     def save_gfa(self, gfa_filename, sequences: List[Sequence],
                  use_other_colour: bool = False) -> None:
-        with open(gfa_filename, "w") as f:
-            f.write(self.gfa_text(sequences, use_other_colour))
+        """Streams the same bytes gfa_text produces, but writes each unitig's
+        sequence array directly instead of decoding Mbp of segments into
+        Python strings first."""
+        with open(gfa_filename, "wb") as f:
+            f.write(f"H\tVN:Z:1.0\tKM:i:{self.k_size}\n".encode())
+            for unitig in self.unitigs:
+                f.write(f"S\t{unitig.number}\t".encode())
+                f.write(unitig.forward_seq.tobytes())
+                f.write(f"\tDP:f:{unitig.depth:.2f}"
+                        f"{unitig.colour_tag(use_other_colour)}\n".encode())
+            for a, a_strand, b, b_strand in self.links_for_gfa():
+                f.write(f"L\t{a}\t{a_strand}\t{b}\t{b_strand}\t0M\n".encode())
+            paths = self.get_unitig_paths_for_sequences([s.id for s in sequences])
+            for seq in sequences:
+                f.write(self.gfa_path_line(seq, paths[seq.id]).encode())
+                f.write(b"\n")
 
     def gfa_text(self, sequences: List[Sequence], use_other_colour: bool = False) -> str:
         lines = [f"H\tVN:Z:1.0\tKM:i:{self.k_size}"]

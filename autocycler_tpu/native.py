@@ -139,6 +139,18 @@ def get_lib() -> Optional[ctypes.CDLL]:
         else:
             lib._has_dp_tb = True
         try:
+            lib.sk_collect_marked_begin.restype = ctypes.c_int64
+            lib.sk_collect_marked_begin.argtypes = [
+                ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_uint8)]
+            lib.sk_collect_marked_fetch.restype = ctypes.c_int32
+            lib.sk_collect_marked_fetch.argtypes = [
+                ctypes.POINTER(ctypes.c_int64)]
+        except AttributeError:
+            lib._has_collect = False
+        else:
+            lib._has_collect = True
+        try:
             lib.sk_chain_walk.restype = ctypes.c_int64
             lib.sk_chain_walk.argtypes = [
                 ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
@@ -256,6 +268,27 @@ def build_occ_index(seq_bytes: np.ndarray, fwd_off: np.ndarray, rev_off: np.ndar
     return dict(U=int(U), G=int(out_G.value), fwd_gid=fwd_gid, depth=depth,
                 rep_byte=rep_byte, rev_kid=rev_kid,
                 prefix_gid=prefix_gid, suffix_gid=suffix_gid)
+
+
+def collect_marked(gid: np.ndarray, mark: np.ndarray):
+    """Indices i with mark[gid[i]] set, as one native pass; None if
+    unavailable (caller falls back to the numpy gather)."""
+    lib = get_lib()
+    if lib is None or not getattr(lib, "_has_collect", False):
+        return None
+    gid = np.ascontiguousarray(gid, dtype=np.int32)
+    mark = np.ascontiguousarray(mark, dtype=np.uint8)
+    count = lib.sk_collect_marked_begin(
+        gid.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.c_int64(len(gid)),
+        mark.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    if count < 0:
+        return None
+    out = np.empty(count, dtype=np.int64)
+    if lib.sk_collect_marked_fetch(
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))) != 0:
+        return None
+    return out
 
 
 def chain_walk(next_int: np.ndarray):

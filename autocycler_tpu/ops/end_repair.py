@@ -121,8 +121,10 @@ def sequence_end_repair(sequences: List[Sequence], k_size: int) -> None:
             text_off_list.append(total)
             bufs.append(strand_seq)
             total += len(strand_seq)
+    # the rolling-hash scan only needs an injective byte alphabet, and the
+    # validated inputs are {., A, C, G, T} — raw bytes qualify, so the
+    # 5-symbol encode pass is only materialised for the grouping fallback
     buf = np.concatenate(bufs)
-    codes = encode_bytes(buf)
     text_len = np.array([len(b) for b in bufs], dtype=np.int64)
     text_off = np.array(text_off_list, dtype=np.int64)
 
@@ -136,9 +138,10 @@ def sequence_end_repair(sequences: List[Sequence], k_size: int) -> None:
         q_starts.append(fwd + P - 2 * h)  # end-pattern core (offset 0 in pattern)
     q_starts = np.array(q_starts, dtype=np.int64)
 
-    by_query = _matches_by_query_native(codes, text_off, text_len, h, q_starts)
+    by_query = _matches_by_query_native(buf, text_off, text_len, h, q_starts)
     if by_query is None:
-        by_query = _matches_by_query_grouped(codes, text_off, text_len, h, q_starts)
+        by_query = _matches_by_query_grouped(encode_bytes(buf), text_off,
+                                             text_len, h, q_starts)
 
     def best_candidate(q: int, core_offset: int) -> bytes:
         """Best non-overlapping (k-1)-byte candidate window for query q,

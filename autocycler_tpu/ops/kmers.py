@@ -217,7 +217,11 @@ class KmerIndex:
         need[self.rev_kid[kids]] = True
         fwd_win_off = np.zeros(len(self.seq_len) + 1, np.int64)
         np.cumsum(self.seq_len, out=fwd_win_off[1:])
-        hits = np.flatnonzero(need[self.fwd_gid])
+        from .. import native
+        hits = native.collect_marked(self.fwd_gid, need.view(np.uint8)) \
+            if native.available() else None
+        if hits is None:
+            hits = np.flatnonzero(need[self.fwd_gid])
         hg = self.fwd_gid[hits].astype(np.int64)
         seq_idx = np.searchsorted(fwd_win_off, hits, side="right") - 1
         q = hits - fwd_win_off[seq_idx]

@@ -1015,6 +1015,38 @@ int32_t sk_occ_index_finish(int32_t* fwd_gid, int64_t* depth, int64_t* rep_byte,
     }
 }
 
+// Collect indices i where mark[gid[i]] != 0 — the scan behind
+// KmerIndex.positions_for_kmers (one sequential pass instead of numpy's
+// gather-then-flatnonzero over a 147M-element temp). Stash protocol like
+// the gram scan: begin returns the hit count, fetch copies + frees.
+namespace collectscan {
+static std::unique_ptr<std::vector<int64_t>> g_hits;
+}
+
+int64_t sk_collect_marked_begin(const int32_t* gid, int64_t n,
+                                const uint8_t* mark) {
+    try {
+        auto hits = std::make_unique<std::vector<int64_t>>();
+        for (int64_t i = 0; i < n; ++i) {
+            if (mark[gid[i]]) hits->push_back(i);
+        }
+        const int64_t count = static_cast<int64_t>(hits->size());
+        collectscan::g_hits = std::move(hits);
+        return count;
+    } catch (...) {
+        collectscan::g_hits.reset();
+        return -1;
+    }
+}
+
+int32_t sk_collect_marked_fetch(int64_t* out) {
+    if (!collectscan::g_hits) return -1;
+    std::unique_ptr<std::vector<int64_t>> hits = std::move(collectscan::g_hits);
+    if (!hits->empty())
+        std::memcpy(out, hits->data(), sizeof(int64_t) * hits->size());
+    return 0;
+}
+
 // Weighted path-overlap DP (the trim kernel): fills the (kk+1)^2 scoring
 // matrix for ops/align.py's overlap_alignment — matches +w, mismatches
 // -(w_a+w_b)/2, indels -w, top/left edges zero, optionally skipping the
@@ -1146,46 +1178,89 @@ int64_t sk_chain_walk(const int64_t* next, int64_t U,
         std::vector<int32_t> has_prev(U, 0);
         for (int64_t g = 0; g < U; ++g)
             if (next[g] >= 0) has_prev[next[g]] = 1;
-        std::vector<uint8_t> visited(U, 0);
 
-        struct ChainRec { int64_t key, start, len; uint8_t cycle; };
+        // node -> (chain id in creation order, rank within chain);
+        // chain_of == -1 marks unvisited
+        std::vector<int32_t> chain_of(U, -1), rank_of(U, 0);
+        struct ChainRec { int64_t key, len; uint8_t cycle; };
         std::vector<ChainRec> recs;
-        std::vector<int64_t> buf;   // members of all chains, walk order
-        buf.reserve(U);
 
-        // paths first (ascending head), then cycles (ascending smallest
-        // member: scanning g ascending, the first unvisited node of a cycle
-        // is its minimum)
-        for (int pass = 0; pass < 2; ++pass) {
-            for (int64_t g = 0; g < U; ++g) {
-                if (visited[g]) continue;
-                if (pass == 0 && has_prev[g]) continue;
-                const int64_t start = static_cast<int64_t>(buf.size());
-                int64_t cur = g;
-                while (cur >= 0 && !visited[cur]) {
-                    visited[cur] = 1;
-                    buf.push_back(cur);
-                    cur = next[cur];
-                }
-                recs.push_back(ChainRec{g, start,
-                                        static_cast<int64_t>(buf.size()) - start,
-                                        static_cast<uint8_t>(pass)});
+        // --- paths, 16 chains walked in lockstep ---
+        // a serial walk is one dependent ~100ns load per node; interleaving
+        // independent chains keeps many misses in flight
+        std::vector<int64_t> heads;
+        for (int64_t g = 0; g < U; ++g)
+            if (!has_prev[g]) heads.push_back(g);
+        constexpr int LANES = 16;
+        int64_t lane_cur[LANES];
+        int32_t lane_chain[LANES], lane_rank[LANES];
+        int active = 0;
+        size_t next_head = 0;
+        auto feed = [&]() {
+            while (active < LANES && next_head < heads.size()) {
+                const int64_t h = heads[next_head++];
+                lane_cur[active] = h;
+                lane_chain[active] = static_cast<int32_t>(recs.size());
+                lane_rank[active] = 0;
+                recs.push_back(ChainRec{h, 0, 0});
+                ++active;
             }
+        };
+        feed();
+        while (active) {
+            for (int l = 0; l < active;) {
+                const int64_t cur = lane_cur[l];
+                chain_of[cur] = lane_chain[l];
+                rank_of[cur] = lane_rank[l]++;
+                const int64_t nxt = next[cur];
+                if (nxt < 0) {
+                    recs[lane_chain[l]].len = lane_rank[l];
+                    --active;              // retire lane, swap in the last one
+                    lane_cur[l] = lane_cur[active];
+                    lane_chain[l] = lane_chain[active];
+                    lane_rank[l] = lane_rank[active];
+                } else {
+                    __builtin_prefetch(&next[nxt], 0, 1);
+                    lane_cur[l] = nxt;
+                    ++l;
+                }
+            }
+            feed();
         }
-        // merge into ascending-key order (paths and cycles interleaved by
-        // head/rep node id, matching the fallback's chain numbering)
-        std::sort(recs.begin(), recs.end(),
-                  [](const ChainRec& a, const ChainRec& b) { return a.key < b.key; });
+
+        // --- cycles, serial (rare): scanning ascending, the first
+        // unvisited node of a cycle is its minimum ---
+        for (int64_t g = 0; g < U; ++g) {
+            if (chain_of[g] >= 0) continue;
+            const int32_t c = static_cast<int32_t>(recs.size());
+            int32_t r = 0;
+            int64_t cur = g;
+            do {
+                chain_of[cur] = c;
+                rank_of[cur] = r++;
+                cur = next[cur];
+            } while (cur != g);
+            recs.push_back(ChainRec{g, r, 1});
+        }
+
+        // chains emitted in ascending key order (head / cycle minimum),
+        // matching the pointer-doubling fallback's numbering
+        const int64_t C = static_cast<int64_t>(recs.size());
+        std::vector<int32_t> order(C), new_id(C);
+        for (int64_t c = 0; c < C; ++c) order[c] = static_cast<int32_t>(c);
+        std::sort(order.begin(), order.end(),
+                  [&](int32_t a, int32_t b) { return recs[a].key < recs[b].key; });
         int64_t off = 0;
-        for (size_t c = 0; c < recs.size(); ++c) {
+        for (int64_t c = 0; c < C; ++c) {
+            new_id[order[c]] = static_cast<int32_t>(c);
             out_chain_off[c] = off;
-            std::memcpy(out_members + off, buf.data() + recs[c].start,
-                        sizeof(int64_t) * recs[c].len);
-            out_is_cycle[c] = recs[c].cycle;
-            off += recs[c].len;
+            out_is_cycle[c] = recs[order[c]].cycle;
+            off += recs[order[c]].len;
         }
-        out_chain_off[recs.size()] = off;
-        return static_cast<int64_t>(recs.size());
+        out_chain_off[C] = off;
+        for (int64_t g = 0; g < U; ++g)
+            out_members[out_chain_off[new_id[chain_of[g]]] + rank_of[g]] = g;
+        return C;
     } catch (...) {
         return -1;
     }

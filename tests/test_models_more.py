@@ -115,3 +115,26 @@ def test_paths_cache_matches_position_reconstruction(tmp_path):
         tmp_path / "out" / "input_assemblies.gfa")
     graph.remove_sequence_from_graph(ids[0])
     assert graph._paths_cache is None
+
+
+def test_save_gfa_bytes_match_gfa_text(tmp_path):
+    """The streamed save_gfa writer must stay byte-identical to gfa_text
+    (both serializers exist: save_gfa avoids decoding Mbp into strings)."""
+    import sys
+    from pathlib import Path as _P
+    sys.path.insert(0, str(_P(__file__).parent))
+    from synthetic import make_assemblies
+
+    from autocycler_tpu.commands.compress import compress
+    from autocycler_tpu.models import UnitigGraph
+
+    make_assemblies(tmp_path, n_assemblies=3, chromosome_len=1500,
+                    plasmid_len=300, n_snps=3, seed=21)
+    compress(tmp_path / "assemblies", tmp_path / "out")
+    graph, sequences = UnitigGraph.from_gfa_file(
+        tmp_path / "out" / "input_assemblies.gfa")
+    for use_other in (False, True):
+        out = tmp_path / f"w{use_other}.gfa"
+        graph.save_gfa(out, sequences, use_other_colour=use_other)
+        assert out.read_bytes() == graph.gfa_text(
+            sequences, use_other_colour=use_other).encode()
