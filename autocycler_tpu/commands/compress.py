@@ -19,7 +19,8 @@ from ..models import Sequence, UnitigGraph
 from ..models.simplify import simplify_structure
 from ..ops.end_repair import sequence_end_repair
 from ..ops.graph_build import build_unitig_graph
-from ..utils import find_all_assemblies, format_duration, load_fasta, log, quit_with_error
+from ..utils import (Spinner, find_all_assemblies, format_duration, load_fasta,
+                     log, quit_with_error)
 from ..utils.timing import stage_timer
 
 MAX_INPUT_SEQUENCES = 32767  # position packing limit (reference compress.rs:112-114)
@@ -57,14 +58,15 @@ def compress(assemblies_dir, autocycler_dir, k_size: int = 51,
     log.explanation("K-mers are grouped with a sort-based device kernel, unitig chains "
                     "are assembled, and all non-branching paths are collapsed to form a "
                     "compacted De Bruijn graph, a.k.a. a unitig graph.")
-    with stage_timer("compress/build_graph"):
+    with stage_timer("compress/build_graph"), \
+            Spinner("adding k-mers to graph..."):
         graph = build_unitig_graph(sequences, k_size, use_jax=use_jax)
     graph.print_basic_graph_info()
 
     log.section_header("Simplifying unitig graph")
     log.explanation("The graph structure is now simplified by moving sequence into repeat "
                     "unitigs when possible.")
-    with stage_timer("compress/simplify"):
+    with stage_timer("compress/simplify"), Spinner("simplifying graph..."):
         simplify_structure(graph, sequences)
     graph.print_basic_graph_info()
 
@@ -113,7 +115,8 @@ def load_sequences(assemblies_dir, k_size: int, metrics: InputAssemblyMetrics,
         metrics.input_assembly_details.append(details)
     log.message()
     check_sequence_count(sequences, len(assemblies), max_contigs)
-    sequence_end_repair(sequences, k_size)
+    with Spinner("repairing sequence ends..."):
+        sequence_end_repair(sequences, k_size)
     n = seq_id
     log.message(f"{n} sequence{'' if n == 1 else 's'} loaded from {len(assemblies)} "
                 f"assembl{'y' if len(assemblies) == 1 else 'ies'}")

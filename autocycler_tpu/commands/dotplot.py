@@ -24,8 +24,8 @@ import numpy as np
 from ..models import UnitigGraph
 from ..ops.encode import encode_bytes
 from ..ops.kmers import group_windows
-from ..utils import (find_all_assemblies, load_fasta, log, quit_with_error,
-                     reverse_complement_bytes)
+from ..utils import (Spinner, find_all_assemblies, load_fasta, log,
+                     quit_with_error, reverse_complement_bytes)
 
 # layout constants (reference dotplot.rs:28-41)
 INITIAL_TOP_LEFT_GAP = 0.1
@@ -60,7 +60,8 @@ def dotplot(input_path, out_png, res: int = 2000, kmer: int = 32,
                     "trimming) and generate a dotplot image containing all pairwise "
                     "comparisons of the sequences.")
     seqs = load_dotplot_sequences(input_path)
-    create_dotplot(seqs, out_png, res, kmer, grid_mode)
+    with Spinner("creating dotplot..."):
+        create_dotplot(seqs, out_png, res, kmer, grid_mode)
     log.section_header("Finished!")
     log.message(f"Pairwise dotplots: {out_png}")
     log.message()

@@ -18,7 +18,7 @@ from pathlib import Path
 from typing import List, Set
 
 from ..metrics import ReadSetDetails, SubsampleMetrics
-from ..utils import fastq_reader, log, quit_with_error
+from ..utils import Spinner, fastq_reader, log, quit_with_error
 
 
 def parse_genome_size(genome_size_str: str) -> int:
@@ -119,14 +119,15 @@ def subsample(fastq_file, out_dir, genome_size: str, count: int = 4,
         log.message(f"subset {i + 1}: {path}")
         files.append(open(path, "w"))
     sample_read_lengths: List[List[int]] = [[] for _ in range(count)]
-    for read_i, (header, seq, quals) in enumerate(fastq_reader(fastq_file)):
-        record = f"@{header}\n{seq}\n+\n{quals}\n"
-        for subset_i in range(count):
-            if read_i in subset_index_sets[subset_i]:
-                files[subset_i].write(record)
-                sample_read_lengths[subset_i].append(len(seq))
-    for f in files:
-        f.close()
+    with Spinner("writing subsampled reads to files..."):
+        for read_i, (header, seq, quals) in enumerate(fastq_reader(fastq_file)):
+            record = f"@{header}\n{seq}\n+\n{quals}\n"
+            for subset_i in range(count):
+                if read_i in subset_index_sets[subset_i]:
+                    files[subset_i].write(record)
+                    sample_read_lengths[subset_i].append(len(seq))
+        for f in files:
+            f.close()
     for lengths in sample_read_lengths:
         metrics.output_reads.append(ReadSetDetails.from_sorted_lengths(sorted(lengths)))
     metrics.save_to_yaml(out_dir / "subsample.yaml")

@@ -12,10 +12,11 @@ A pattern of h dots + h real bases matches text at offset j iff
 text[j+off : j+off+h] equals the h real bases — every match is an occurrence
 of a query h-gram. Two providers find those occurrences:
 
-- the native rolling-hash multi-pattern scan (one sequential pass over all
-  texts for all 2S queries, native/seqkernel.cpp), or
-- sort-based grouping of ALL h-grams (ops.kmers.group_windows) as the
-  numpy fallback.
+- the native rolling-hash multi-pattern scan over the RAW byte buffer (one
+  sequential pass over all texts for all 2S queries, native/seqkernel.cpp —
+  hits are memcmp-verified, so no symbol encoding is needed), or
+- sort-based grouping of ALL h-grams of the 5-symbol-encoded buffer
+  (ops.kmers.group_windows) as the numpy fallback.
 """
 
 from __future__ import annotations
@@ -51,11 +52,14 @@ def _best_match_rows(rows: np.ndarray) -> bytes:
     return distinct[order[0]].tobytes()
 
 
-def _matches_by_query_native(codes, text_off, text_len, h, q_starts):
+def _matches_by_query_native(buf, text_off, text_len, h, q_starts):
+    """buf is the RAW byte buffer — the rolling-hash scan verifies hits with
+    memcmp, so any injective byte alphabet works (inputs are validated
+    ACGT+dots); only the grouping fallback needs the 5-symbol encoding."""
     from .. import native
     if not native.available():
         return None
-    result = native.scan_gram_matches_native(codes, text_off, text_len, h, q_starts)
+    result = native.scan_gram_matches_native(buf, text_off, text_len, h, q_starts)
     if result is None:
         return None
     q_idx, t_idx, pos = result

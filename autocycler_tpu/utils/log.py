@@ -20,8 +20,27 @@ def _colour_enabled() -> bool:
     return sys.stderr.isatty()
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def _spinner_guard():
+    """Clears any active Spinner line and holds its redraw lock, so log
+    output never interleaves with a spinner tick (utils.misc.Spinner)."""
+    from .misc import spinner_lock
+    with spinner_lock:
+        if sys.stderr.isatty():
+            sys.stderr.write("\r\x1b[2K")
+        yield
+
+
 def section_header(text: str) -> None:
     timestamp = datetime.datetime.now().strftime("%Y-%m-%d %H:%M:%S")
+    with _spinner_guard():
+        _section_header_write(timestamp, text)
+
+
+def _section_header_write(timestamp: str, text: str) -> None:
     if _colour_enabled():
         print(f"{DIM}{timestamp}{RESET}  {BOLD}{UNDERLINE}{text}{RESET}", file=sys.stderr)
     else:
@@ -30,6 +49,11 @@ def section_header(text: str) -> None:
 
 def explanation(text: str) -> None:
     wrapped = textwrap.fill(" ".join(text.split()), width=80)
+    with _spinner_guard():
+        _explanation_write(wrapped)
+
+
+def _explanation_write(wrapped: str) -> None:
     if _colour_enabled():
         print(f"{DIM}{wrapped}{RESET}", file=sys.stderr)
     else:
@@ -38,4 +62,5 @@ def explanation(text: str) -> None:
 
 
 def message(text: str = "") -> None:
-    print(text, file=sys.stderr)
+    with _spinner_guard():
+        print(text, file=sys.stderr)

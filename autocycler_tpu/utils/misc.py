@@ -133,3 +133,61 @@ def up_to_first_space(string: str) -> str:
 def after_first_space(string: str) -> str:
     parts = string.split(None, 1)
     return parts[1] if len(parts) > 1 else ""
+
+
+import threading as _threading
+
+# serialises spinner redraws with log writes (see log.py)
+spinner_lock = _threading.Lock()
+
+
+class Spinner:
+    """Terminal progress spinner (reference misc.rs:452-466: the dots3
+    animation from cli-spinners, 100 ms steady tick, cleared when done).
+    Animates only on an interactive stderr — hidden under tests, pipes and
+    log capture, like indicatif's auto-hidden bars. Log writes clear the
+    spinner line under a shared lock (log.py), so logging inside a spinner
+    scope never garbles the terminal."""
+
+    TICKS = "⠋⠙⠚⠞⠖⠦⠴⠲⠳⠓"
+
+    def __init__(self, message: str):
+        import sys
+        self.message = message
+        self._stop = None
+        self._thread = None
+        if not sys.stderr.isatty():
+            return
+        import threading
+
+        self._stop = threading.Event()
+
+        def tick():
+            i = 0
+            while not self._stop.wait(0.1):
+                with spinner_lock:
+                    sys.stderr.write(
+                        f"\r\x1b[2K{self.TICKS[i % len(self.TICKS)]} "
+                        f"{self.message}")
+                    sys.stderr.flush()
+                i += 1
+
+        self._thread = threading.Thread(target=tick, daemon=True)
+        self._thread.start()
+
+    def finish(self) -> None:
+        if self._thread is not None:
+            import sys
+            self._stop.set()
+            self._thread.join()
+            with spinner_lock:
+                sys.stderr.write("\r\x1b[2K")  # clear the spinner line
+                sys.stderr.flush()
+            self._thread = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.finish()
+        return False
