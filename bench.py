@@ -106,6 +106,69 @@ def bench_dotplot() -> None:
     }))
 
 
+def bench_configs() -> None:
+    """The remaining BASELINE.json component configs, one JSON line each:
+    compress on 4 assemblies of a 5 Mbp genome (k=51), cluster pairwise
+    distances on 12 mixed inputs, trim's overlap DP on a circular-contig
+    cluster, and the batched 96x12 multi-isolate distance step."""
+    import contextlib
+    import gc
+    import json as _json
+    import os
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent / "tests"))
+    from synthetic import make_assemblies_fast
+
+    from autocycler_tpu.commands.cluster import cluster as run_cluster
+    from autocycler_tpu.commands.compress import compress as run_compress
+    from autocycler_tpu.commands.trim import trim as run_trim
+    from autocycler_tpu.ops.distance import membership_matrix
+    from autocycler_tpu.parallel.batch import batched_membership_intersections
+    from autocycler_tpu.parallel.mesh import make_mesh
+
+    gc.disable()
+    results = []
+    devnull = open(os.devnull, "w")
+    with contextlib.redirect_stderr(devnull):
+        # compress: 4 assemblies x 5 Mbp, k=51
+        tmp = Path(tempfile.mkdtemp(prefix="autocycler_bench_"))
+        asm = make_assemblies_fast(tmp, n_assemblies=4, chromosome_len=5_000_000,
+                                   plasmid_len=100_000, n_snps=100)
+        t0 = time.perf_counter()
+        run_compress(asm, tmp / "out")
+        results.append(("compress_4x5Mbp", time.perf_counter() - t0, "s"))
+
+        # cluster: pairwise distances on 12 mixed inputs (6 Mbp scale)
+        tmp2 = Path(tempfile.mkdtemp(prefix="autocycler_bench_"))
+        asm2 = make_assemblies_fast(tmp2, n_assemblies=12, chromosome_len=6_000_000,
+                                    plasmid_len=120_000, n_snps=300)
+        run_compress(asm2, tmp2 / "out")
+        t0 = time.perf_counter()
+        run_cluster(tmp2 / "out")
+        results.append(("cluster_12x6Mbp", time.perf_counter() - t0, "s"))
+
+        # trim: overlap DP on the circular-contig cluster just produced
+        clusters = sorted((tmp2 / "out" / "clustering" / "qc_pass").glob("cluster_*"))
+        t0 = time.perf_counter()
+        run_trim(clusters[0])
+        results.append(("trim_circular_cluster", time.perf_counter() - t0, "s"))
+
+        # batched multi-isolate: 96 isolates' exact distance matrices in one
+        # mesh contraction (membership matrices reused from the 12x graph)
+        from autocycler_tpu.models import UnitigGraph
+        graph, sequences = UnitigGraph.from_gfa_file(
+            tmp2 / "out" / "input_assemblies.gfa")
+        M, w, _ = membership_matrix(graph, sequences)
+        mesh = make_mesh()
+        t0 = time.perf_counter()
+        inters = batched_membership_intersections(mesh, [M] * 96, [w] * 96)
+        assert len(inters) == 96
+        results.append(("batched_96_isolate_distances", time.perf_counter() - t0, "s"))
+    for name, val, unit in results:
+        print(_json.dumps({"metric": name, "value": round(val, 2), "unit": unit,
+                           "vs_baseline": 0}))
+
+
 def main() -> None:
     try:
         import jax
@@ -117,6 +180,8 @@ def main() -> None:
 
     if len(sys.argv) > 1 and sys.argv[1] == "dotplot":
         bench_dotplot()
+    elif len(sys.argv) > 1 and sys.argv[1] == "configs":
+        bench_configs()
     else:
         bench_headline()
 
