@@ -1120,6 +1120,7 @@ void sk_overlap_dp_tb(const int64_t* a_vals, const double* wa,
     for (int64_t j = 1; j <= kk; ++j) Wcum[j] = Wcum[j - 1] + wb[j - 1];
     std::vector<double> prev_row(kk + 1, 0.0), cur_row(kk + 1, 0.0), T(kk + 1);
     std::vector<double> bd(kk), mm(kk);  // b ids + mismatch halves as doubles
+    std::vector<uint8_t> byte_bits(kk + 1, 0);
     for (int64_t j = 0; j < kk; ++j) bd[j] = static_cast<double>(b_vals[j]);
     out_right[0] = 0.0;
     for (int64_t i = 1; i <= kk; ++i) {
@@ -1138,27 +1139,35 @@ void sk_overlap_dp_tb(const int64_t* a_vals, const double* wa,
             tp[j] = (match > del ? match : del) + Wcum[j];
         }
         const int64_t jd = skip_diagonal ? gi - (n - kk) + 1 : -1;
-        uint64_t* bits = out_bits + i * words;
-        uint64_t word = 0;
-        double running = 0.0;
-        for (int64_t j = 1; j <= kk; ++j) {
-            double v;
-            if (j == jd) {
-                v = NEG_INF;
-                running = NEG_INF;
-            } else {
+        // running max in branch-free segments: the skipped diagonal cell is
+        // -inf and RESTARTS the insert chain, so the scan splits there
+        auto scan = [&](int64_t lo, int64_t hi, double running) {
+            for (int64_t j = lo; j <= hi; ++j) {
                 if (tp[j] > running) running = tp[j];
-                v = running - Wcum[j];
+                cur[j] = running - Wcum[j];
             }
-            // traceback bit BEFORE overwriting: S[i-1][j] >= S[i][j-1]
-            if (prev[j] >= cur[j - 1]) word |= 1ull << (j & 63);
-            cur[j] = v;
-            if ((j & 63) == 63) {
-                bits[j >> 6] = word;
-                word = 0;
-            }
+        };
+        if (1 <= jd && jd <= kk) {
+            scan(1, jd - 1, 0.0);
+            cur[jd] = NEG_INF;
+            scan(jd + 1, kk, NEG_INF);
+        } else {
+            scan(1, kk, 0.0);
         }
-        if ((kk & 63) != 63) bits[kk >> 6] = word;  // flush partial tail word
+        // traceback bits as a separate pass (the compare vectorises):
+        // up_ge[j] = S[i-1][j] >= S[i][j-1]
+        uint64_t* bits = out_bits + i * words;
+        uint8_t* bb = reinterpret_cast<uint8_t*>(byte_bits.data());
+        for (int64_t j = 1; j <= kk; ++j)
+            bb[j] = prev[j] >= cur[j - 1];
+        for (int64_t w = 0; w < words; ++w) {
+            uint64_t word = 0;
+            const int64_t base = w << 6;
+            const int64_t end = std::min<int64_t>(64, kk + 1 - base);
+            for (int64_t t = (base == 0 ? 1 : 0); t < end; ++t)
+                word |= static_cast<uint64_t>(bb[base + t]) << t;
+            bits[w] = word;
+        }
         out_right[i] = cur[kk];
         prev_row.swap(cur_row);
     }
