@@ -50,7 +50,7 @@ class UnitigGraph:
         self.k_size = k_size
         self.index: Dict[int, Unitig] = {}
         # transient number -> (positions lists, length) map used while
-        # stamping many paths in one batch (see _add_positions_from_path)
+        # stamping many paths in one batch (see create_sequence_and_positions)
         self._path_helper = None
         # paths parsed from the GFA P-lines, valid until any mutation that
         # could change path composition (see invalidate_paths_cache callers);
@@ -157,39 +157,41 @@ class UnitigGraph:
                                       forward_path: List[Tuple[int, bool]]) -> Sequence:
         """Register a sequence's path through the graph by stamping Position
         records onto each traversed unitig, both strands
-        (reference unitig_graph.rs:151-174)."""
-        self.invalidate_paths_cache()
-        self._add_positions_from_path(forward_path, FORWARD, seq_id, length)
-        self._add_positions_from_path(reverse_path(forward_path), REVERSE, seq_id, length)
-        return Sequence.without_seq(seq_id, filename, header, length, cluster)
+        (reference unitig_graph.rs:151-174).
 
-    def _add_positions_from_path(self, path, path_strand: bool, seq_id: int,
-                                 length: int) -> None:
+        One pass covers both strands: the reverse-path position of the step
+        at forward position p is length - p - len(unitig). Position-list
+        ORDER is not part of the model's contract (every consumer sorts or
+        filters), so the reverse entries land in forward order."""
+        self.invalidate_paths_cache()
         helper = self._path_helper
-        pos = 0
         if helper is None:
             # single-path call: per-step index lookups beat building an
             # O(unitigs) helper for one path
             index_get = self.index.get
-            for unitig_num, unitig_strand in path:
-                unitig = index_get(unitig_num)
-                if unitig is None:
-                    quit_with_error(f"unitig {unitig_num} not found in unitig index")
-                (unitig.forward_positions if unitig_strand
-                 else unitig.reverse_positions).append(
-                    Position(seq_id, path_strand, pos))
-                pos += len(unitig.forward_seq)
+
+            def entry_for(num):
+                u = index_get(num)
+                if u is None:
+                    return None
+                return u.forward_positions, u.reverse_positions, len(u.forward_seq)
         else:
-            helper_get = helper.get
-            for unitig_num, unitig_strand in path:
-                entry = helper_get(unitig_num)
-                if entry is None:
-                    quit_with_error(f"unitig {unitig_num} not found in unitig index")
-                fwd, rev, ln = entry
-                (fwd if unitig_strand else rev).append(
-                    Position(seq_id, path_strand, pos))
-                pos += ln
+            entry_for = helper.get
+        pos = 0
+        for unitig_num, unitig_strand in forward_path:
+            entry = entry_for(unitig_num)
+            if entry is None:
+                quit_with_error(f"unitig {unitig_num} not found in unitig index")
+            fwd, rev, ln = entry
+            if unitig_strand:
+                fwd.append(Position(seq_id, FORWARD, pos))
+                rev.append(Position(seq_id, REVERSE, length - pos - ln))
+            else:
+                rev.append(Position(seq_id, FORWARD, pos))
+                fwd.append(Position(seq_id, REVERSE, length - pos - ln))
+            pos += ln
         assert pos == length, "Position calculation mismatch"
+        return Sequence.without_seq(seq_id, filename, header, length, cluster)
 
     # ---------------- saving ----------------
 
