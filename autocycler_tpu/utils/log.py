@@ -6,6 +6,7 @@ wrapped dim explanation text). Colour is suppressed when stderr is not a TTY.
 
 from __future__ import annotations
 
+import contextlib
 import datetime
 import sys
 import textwrap
@@ -20,45 +21,35 @@ def _colour_enabled() -> bool:
     return sys.stderr.isatty()
 
 
-import contextlib
-
-
 @contextlib.contextmanager
 def _spinner_guard():
     """Clears any active Spinner line and holds its redraw lock, so log
     output never interleaves with a spinner tick (utils.misc.Spinner)."""
-    from .misc import spinner_lock
+    from .misc import CLEAR_LINE, spinner_lock
     with spinner_lock:
         if sys.stderr.isatty():
-            sys.stderr.write("\r\x1b[2K")
+            sys.stderr.write(CLEAR_LINE)
         yield
 
 
 def section_header(text: str) -> None:
     timestamp = datetime.datetime.now().strftime("%Y-%m-%d %H:%M:%S")
     with _spinner_guard():
-        _section_header_write(timestamp, text)
-
-
-def _section_header_write(timestamp: str, text: str) -> None:
-    if _colour_enabled():
-        print(f"{DIM}{timestamp}{RESET}  {BOLD}{UNDERLINE}{text}{RESET}", file=sys.stderr)
-    else:
-        print(f"{timestamp}  {text}", file=sys.stderr)
+        if _colour_enabled():
+            print(f"{DIM}{timestamp}{RESET}  {BOLD}{UNDERLINE}{text}{RESET}",
+                  file=sys.stderr)
+        else:
+            print(f"{timestamp}  {text}", file=sys.stderr)
 
 
 def explanation(text: str) -> None:
     wrapped = textwrap.fill(" ".join(text.split()), width=80)
     with _spinner_guard():
-        _explanation_write(wrapped)
-
-
-def _explanation_write(wrapped: str) -> None:
-    if _colour_enabled():
-        print(f"{DIM}{wrapped}{RESET}", file=sys.stderr)
-    else:
-        print(wrapped, file=sys.stderr)
-    print(file=sys.stderr)
+        if _colour_enabled():
+            print(f"{DIM}{wrapped}{RESET}", file=sys.stderr)
+        else:
+            print(wrapped, file=sys.stderr)
+        print(file=sys.stderr)
 
 
 def message(text: str = "") -> None:

@@ -139,6 +139,7 @@ import threading as _threading
 
 # serialises spinner redraws with log writes (see log.py)
 spinner_lock = _threading.Lock()
+CLEAR_LINE = "\r\x1b[2K"
 
 
 class Spinner:
@@ -167,7 +168,7 @@ class Spinner:
             while not self._stop.wait(0.1):
                 with spinner_lock:
                     sys.stderr.write(
-                        f"\r\x1b[2K{self.TICKS[i % len(self.TICKS)]} "
+                        f"{CLEAR_LINE}{self.TICKS[i % len(self.TICKS)]} "
                         f"{self.message}")
                     sys.stderr.flush()
                 i += 1
@@ -181,7 +182,7 @@ class Spinner:
             self._stop.set()
             self._thread.join()
             with spinner_lock:
-                sys.stderr.write("\r\x1b[2K")  # clear the spinner line
+                sys.stderr.write(CLEAR_LINE)
                 sys.stderr.flush()
             self._thread = None
 

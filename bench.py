@@ -29,7 +29,9 @@ TARGET_SECONDS = 60.0
 
 
 def _run_headline_once() -> float:
-    sys.path.insert(0, str(Path(__file__).resolve().parent / "tests"))
+    tests_dir = str(Path(__file__).resolve().parent / "tests")
+    if tests_dir not in sys.path:
+        sys.path.insert(0, tests_dir)
     from synthetic import make_assemblies_fast
 
     from autocycler_tpu.commands.cluster import cluster
