@@ -133,3 +133,22 @@ def test_batch_command_bitwise_matches_cluster(tmp_path):
     inter = batched_membership_intersections(make_mesh(8), [M], [w])[0]
     expect_inter = (M.astype(np.int64) * w[None, :]) @ M.astype(np.int64).T
     assert np.array_equal(inter, expect_inter)
+
+
+def test_batched_membership_seq_axis_4():
+    """The exact contraction must hold under a deeper 'seq' sharding of the
+    unitig axis (2 data x 4 seq) with padding on both mesh axes."""
+    import numpy as np
+
+    from autocycler_tpu.parallel.batch import batched_membership_intersections
+
+    rng = np.random.default_rng(77)
+    M_list = [(rng.random((int(rng.integers(2, 6)), int(rng.integers(3, 90)))) < 0.4
+               ).astype(np.uint8) for _ in range(5)]   # 5 isolates: pads to 6
+    w_list = [rng.integers(1, 5000, size=m.shape[1]).astype(np.int64)
+              for m in M_list]
+    mesh = make_mesh(8, seq_parallel=4)
+    inters = batched_membership_intersections(mesh, M_list, w_list)
+    for m, w, inter in zip(M_list, w_list, inters):
+        expect = (m.astype(np.int64) * w[None, :]) @ m.astype(np.int64).T
+        assert np.array_equal(inter, expect)
