@@ -102,12 +102,12 @@ def get_lib() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
                 ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
                 ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int32,
-                ctypes.POINTER(ctypes.c_int64)]
+                ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32)]
             lib.sk_occ_index_finish.restype = ctypes.c_int32
             lib.sk_occ_index_finish.argtypes = [
-                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
-                ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
-                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32)]
+                ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32)]
         except AttributeError:
             lib._has_occ_index = False
         else:
@@ -241,23 +241,23 @@ def build_occ_index(seq_bytes: np.ndarray, fwd_off: np.ndarray, rev_off: np.ndar
     S = len(seq_len)
     n_f = int(seq_len.sum())
     out_G = ctypes.c_int64(0)
+    fwd_gid = np.empty(n_f, dtype=np.int32)  # written in place by the build
     U = lib.sk_occ_index_build(
         seq_bytes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         ctypes.c_int64(len(seq_bytes)),
         fwd_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         rev_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         seq_len.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-        ctypes.c_int64(S), ctypes.c_int32(k), ctypes.byref(out_G))
+        ctypes.c_int64(S), ctypes.c_int32(k), ctypes.byref(out_G),
+        fwd_gid.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
     if U < 0:
         return None
-    fwd_gid = np.empty(n_f, dtype=np.int32)
     depth = np.empty(U, dtype=np.int64)
     rep_byte = np.empty(U, dtype=np.int64)
     rev_kid = np.empty(U, dtype=np.int32)
     prefix_gid = np.empty(U, dtype=np.int32)
     suffix_gid = np.empty(U, dtype=np.int32)
     rc = lib.sk_occ_index_finish(
-        fwd_gid.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         depth.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         rep_byte.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         rev_kid.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),

@@ -652,7 +652,6 @@ struct State {
     int64_t S = 0, n_f = 0, U = 0, G = 0;
     int32_t k = 0;
     std::vector<int64_t> seq_len, occ_off;
-    std::vector<int32_t> gid_f;                     // per fwd window, FINAL rank
     std::vector<int64_t> depth, rep_byte;           // per final gid
     std::vector<int32_t> rev_kid, prefix_gid, suffix_gid;  // per final gid
 };
@@ -670,10 +669,13 @@ extern "C" {
 // Returns the number of distinct k-mers U (group ids are lexicographic
 // ranks), or -1 on failure. out_G receives the number of distinct
 // (k-1)-grams. State is retained for sk_occ_index_finish.
+// out_fwd_gid is the caller's [n_f] buffer: phase A writes provisional ids
+// straight into it and the rank rewrite finalises them in place — no
+// kernel-side copy of the largest output.
 static int64_t occ_index_build_impl(const uint8_t* codes, int64_t n_codes,
                                     const int64_t* fwd_off, const int64_t* rev_off,
                                     const int64_t* seq_len, int64_t S, int32_t k,
-                                    int64_t* out_G) {
+                                    int64_t* out_G, int32_t* out_fwd_gid) {
     using namespace occidx;
     (void)rev_off;
     if (k < 1 || k > 55) return -1;
@@ -700,7 +702,6 @@ static int64_t occ_index_build_impl(const uint8_t* codes, int64_t n_codes,
     if (!table.init(1 << 15)) return -1;
     std::vector<u128> keys;                // per provisional gid
     try {
-        state->gid_f.resize(n_f);
         keys.reserve(1 << 16);
     } catch (...) { return -1; }
 
@@ -710,7 +711,7 @@ static int64_t occ_index_build_impl(const uint8_t* codes, int64_t n_codes,
     for (int64_t s = 0; s < S; ++s) {
         const uint8_t* base = codes + fwd_off[s];
         const int64_t L = seq_len[s];
-        int32_t* gout = state->gid_f.data() +
+        int32_t* gout = out_fwd_gid +
             (state->occ_off[s] / 2);       // forward windows are the first half
         u128 cur = 0;
         for (int64_t p0 = 0; p0 < L; p0 += BLOCK) {
@@ -942,7 +943,7 @@ static int64_t occ_index_build_impl(const uint8_t* codes, int64_t n_codes,
             fwd_cnt.assign(U, 0);
             state->depth.resize(U);
         } catch (...) { return -1; }
-        int32_t* gf = state->gid_f.data();
+        int32_t* gf = out_fwd_gid;
         for (int64_t i = 0; i < n_f; ++i) {
             const int32_t r = lex_rank[gf[i]];
             gf[i] = r;
@@ -958,17 +959,15 @@ static int64_t occ_index_build_impl(const uint8_t* codes, int64_t n_codes,
     return U;
 }
 
-// Phase 2: fills caller-allocated buffers and releases the retained state.
-// No occurrence-level arrays are materialised — position queries run over
-// fwd_gid on the Python side (KmerIndex.positions_for_kmers).
-//   fwd_gid     [n_f] i32  group id per FORWARD window, sequence-major
+// Phase 2: fills caller-allocated buffers and releases the retained state
+// (fwd_gid was already written in place by sk_occ_index_build).
 //   depth       [U]  i64   occurrence count (both strands)
 //   rep_byte    [U]  i64   byte offset of one occurrence's window in codes
 //   rev_kid     [U]  i32   group id of the reverse-complement k-mer
 //   prefix_gid  [U]  i32   (k-1)-gram id of symbols 0..k-2
 //   suffix_gid  [U]  i32   (k-1)-gram id of symbols 1..k-1
 // Returns 0, or -1 if no build state is pending.
-static int32_t occ_index_finish_impl(int32_t* fwd_gid, int64_t* depth,
+static int32_t occ_index_finish_impl(int64_t* depth,
                                      int64_t* rep_byte, int32_t* rev_kid,
                                      int32_t* prefix_gid, int32_t* suffix_gid) {
     using namespace occidx;
@@ -977,7 +976,6 @@ static int32_t occ_index_finish_impl(int32_t* fwd_gid, int64_t* depth,
     std::unique_ptr<State> state = std::move(g_state);
     const int64_t U = state->U;
 
-    std::memcpy(fwd_gid, state->gid_f.data(), sizeof(int32_t) * state->n_f);
     std::memcpy(depth, state->depth.data(), sizeof(int64_t) * U);
     std::memcpy(rep_byte, state->rep_byte.data(), sizeof(int64_t) * U);
     std::memcpy(rev_kid, state->rev_kid.data(), sizeof(int32_t) * U);
@@ -993,21 +991,21 @@ static int32_t occ_index_finish_impl(int32_t* fwd_gid, int64_t* depth,
 int64_t sk_occ_index_build(const uint8_t* codes, int64_t n_codes,
                            const int64_t* fwd_off, const int64_t* rev_off,
                            const int64_t* seq_len, int64_t S, int32_t k,
-                           int64_t* out_G) {
+                           int64_t* out_G, int32_t* out_fwd_gid) {
     try {
         return occ_index_build_impl(codes, n_codes, fwd_off, rev_off, seq_len,
-                                    S, k, out_G);
+                                    S, k, out_G, out_fwd_gid);
     } catch (...) {
         occidx::g_state.reset();
         return -1;
     }
 }
 
-int32_t sk_occ_index_finish(int32_t* fwd_gid, int64_t* depth, int64_t* rep_byte,
+int32_t sk_occ_index_finish(int64_t* depth, int64_t* rep_byte,
                             int32_t* rev_kid, int32_t* prefix_gid,
                             int32_t* suffix_gid) {
     try {
-        return occ_index_finish_impl(fwd_gid, depth, rep_byte, rev_kid,
+        return occ_index_finish_impl(depth, rep_byte, rev_kid,
                                      prefix_gid, suffix_gid);
     } catch (...) {
         occidx::g_state.reset();
