@@ -17,6 +17,9 @@ import numpy as np
 
 _NATIVE_DIR = Path(__file__).resolve().parent.parent / "native"
 
+# must match sk_abi_version() in seqkernel.cpp
+ABI_VERSION = 3
+
 
 def _lib_path() -> Path:
     """AUTOCYCLER_NATIVE_LIB overrides the source-tree location — installed
@@ -69,6 +72,16 @@ def get_lib() -> Optional[ctypes.CDLL]:
             return None
     try:
         lib = ctypes.CDLL(str(lib_path))
+        # versioned feature set: a prebuilt library with a different ABI
+        # (e.g. pinned via AUTOCYCLER_NATIVE_LIB) must not be called through
+        # the newer signatures — fall back to numpy for those paths instead
+        try:
+            lib.sk_abi_version.restype = ctypes.c_int32
+            lib.sk_abi_version.argtypes = []
+            abi_ok = lib.sk_abi_version() == ABI_VERSION
+        except AttributeError:
+            abi_ok = False
+        lib._abi_ok = abi_ok
         lib.sk_group_windows.restype = ctypes.c_int64
         lib.sk_group_windows.argtypes = [
             ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int32,
@@ -111,7 +124,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
         except AttributeError:
             lib._has_occ_index = False
         else:
-            lib._has_occ_index = True
+            lib._has_occ_index = abi_ok
         try:
             lib.sk_scan_gram_begin.restype = ctypes.c_int64
             lib.sk_scan_gram_begin.argtypes = [
@@ -126,7 +139,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
         except AttributeError:
             lib._has_gram_begin = False
         else:
-            lib._has_gram_begin = True
+            lib._has_gram_begin = abi_ok
         try:
             lib.sk_overlap_dp_tb.restype = None
             lib.sk_overlap_dp_tb.argtypes = [
@@ -137,7 +150,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
         except AttributeError:
             lib._has_dp_tb = False
         else:
-            lib._has_dp_tb = True
+            lib._has_dp_tb = abi_ok
         try:
             lib.sk_collect_marked_begin.restype = ctypes.c_int64
             lib.sk_collect_marked_begin.argtypes = [
@@ -149,7 +162,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
         except AttributeError:
             lib._has_collect = False
         else:
-            lib._has_collect = True
+            lib._has_collect = abi_ok
         try:
             lib.sk_chain_walk.restype = ctypes.c_int64
             lib.sk_chain_walk.argtypes = [
@@ -159,7 +172,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
         except AttributeError:
             lib._has_chain_walk = False
         else:
-            lib._has_chain_walk = True
+            lib._has_chain_walk = abi_ok
         _lib = lib
         return lib
     except OSError:

@@ -63,6 +63,13 @@ static inline bool window_less(const int32_t* words, int64_t n, int32_t W,
 
 extern "C" {
 
+// Bumped whenever an exported signature changes; the Python loader refuses
+// the versioned feature set (occ index, stash protocols, chain walk, DP tb)
+// unless this matches, so a stale prebuilt library pinned via
+// AUTOCYCLER_NATIVE_LIB degrades to the numpy fallbacks instead of being
+// called with a mismatched argument layout.
+int32_t sk_abi_version(void) { return 3; }
+
 // Group n windows of W int32 words (row-major [W][n], most significant word
 // first) into dense group ids that are LEXICOGRAPHIC RANKS, exactly like a
 // full lexicographic sort would produce.

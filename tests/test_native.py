@@ -74,3 +74,39 @@ def test_native_many_duplicates():
     assert (gid == exp_gid).all()
     assert (order == exp_order).all()
     assert gid[-1] == 3  # only 4 distinct 21-mers in a period-4 sequence
+
+
+def test_mismatched_abi_library_degrades_to_fallbacks(tmp_path, monkeypatch):
+    """A prebuilt library without the current sk_abi_version must keep only
+    the stable entry points; every versioned feature flag goes off so the
+    numpy fallbacks run instead of calling mismatched signatures."""
+    import importlib
+    import subprocess
+
+    src = r"""
+#include <cstdint>
+extern "C" {
+int64_t sk_group_windows(const int32_t*, int64_t, int32_t, int64_t*, int64_t*) { return 0; }
+void sk_pack_words(const unsigned char*, const int64_t*, int64_t, int32_t, int32_t*) {}
+int64_t sk_group_kmers(const unsigned char*, const int64_t*, int64_t, int32_t, int64_t*, int64_t*) { return -1; }
+void sk_overlap_dp(const int64_t*, const double*, const int64_t*, const double*, int64_t, int64_t, int32_t, double*) {}
+int64_t sk_scan_gram_matches(const unsigned char*, const int64_t*, const int64_t*, int64_t, int32_t, const int64_t*, int64_t, int32_t*, int32_t*, int64_t*) { return 0; }
+int64_t sk_occ_index_build(const unsigned char*, int64_t, const int64_t*, const int64_t*, const int64_t*, int64_t, int32_t, int64_t*) { return -1; }
+int32_t sk_occ_index_finish(int64_t*, int64_t*, int32_t*, int32_t*, int32_t*) { return -1; }
+}
+"""
+    (tmp_path / "old.cpp").write_text(src)
+    subprocess.run(["g++", "-shared", "-fPIC", str(tmp_path / "old.cpp"),
+                    "-o", str(tmp_path / "old.so")], check=True)
+    monkeypatch.setenv("AUTOCYCLER_NATIVE_LIB", str(tmp_path / "old.so"))
+    import autocycler_tpu.native as native_mod
+    native = importlib.reload(native_mod)
+    try:
+        lib = native.get_lib()
+        assert lib is not None and not lib._abi_ok
+        for flag in ("_has_occ_index", "_has_gram_begin", "_has_dp_tb",
+                     "_has_chain_walk", "_has_collect"):
+            assert not getattr(lib, flag), flag
+    finally:
+        monkeypatch.delenv("AUTOCYCLER_NATIVE_LIB")
+        importlib.reload(native_mod)
