@@ -37,10 +37,19 @@ def quit_with_error(text: str):
 _COMPLEMENT = np.full(256, ord("N"), dtype=np.uint8)
 for _a, _b in [("A", "T"), ("T", "A"), ("C", "G"), ("G", "C"), (".", ".")]:
     _COMPLEMENT[ord(_a)] = ord(_b)
+_COMPLEMENT_TABLE = _COMPLEMENT.tobytes()  # same mapping for bytes.translate
 
 
 def reverse_complement_bytes(seq: np.ndarray) -> np.ndarray:
-    """Reverse-complement a uint8 sequence array."""
+    """Reverse-complement a uint8 sequence array.
+
+    Small arrays (graphs hold tens of thousands of short unitigs) go through
+    bytes.translate, which avoids numpy's per-call overhead; large arrays
+    use the table gather."""
+    if len(seq) < 4096:
+        return np.frombuffer(
+            seq.tobytes()[::-1].translate(_COMPLEMENT_TABLE),
+            dtype=np.uint8).copy()
     return _COMPLEMENT[seq[::-1]]
 
 
