@@ -7,9 +7,10 @@ axis): given a directory of isolate subdirectories (each a normal
 ``--assemblies_dir``), it compresses every isolate to its unitig graph,
 computes ALL isolates' exact all-vs-all contig distance matrices in one
 sharded device contraction (isolates on the mesh 'data' axis, the unitig
-axis on 'seq' — parallel.batch.batched_membership_intersections), and emits
-per-isolate clustering outputs (pairwise_distances.phylip +
-clustering.newick, same formats as `autocycler cluster`).
+axis on 'seq' — parallel.batch.batched_membership_intersections), and runs
+the FULL `cluster` stage per isolate from those matrices (UPGMA tree,
+refinement, QC, per-cluster GFAs, TSV/YAML) — so each isolate's output
+directory is ready for `trim`/`resolve`.
 
 The distances are bit-identical to what `autocycler cluster` computes per
 isolate (integer intersection matmul + the same float division), which is
@@ -29,8 +30,7 @@ from ..ops.graph_build import build_unitig_graph
 from ..parallel.batch import batched_membership_intersections
 from ..parallel.mesh import make_mesh
 from ..utils import log, quit_with_error
-from .cluster import (make_symmetrical_distances, normalise_tree,
-                      save_distance_matrix, save_tree_to_newick, upgma)
+from .cluster import cluster as run_cluster
 from .compress import load_sequences
 
 
@@ -89,16 +89,8 @@ def batch(assemblies_parent, out_parent, k_size: int = 51,
 
     for iso, (sequences, ids), inter in zip(isolates, seq_lists, inters):
         distances = intersections_to_distances(inter, ids)
-        clustering_dir = out_parent / iso.name / "clustering"
-        os.makedirs(clustering_dir, exist_ok=True)
-        save_distance_matrix(distances, sequences,
-                             clustering_dir / "pairwise_distances.phylip")
-        if len(sequences) > 1:
-            tree = upgma(make_symmetrical_distances(distances, sequences),
-                         sequences)
-            normalise_tree(tree)
-            save_tree_to_newick(tree, sequences,
-                                clustering_dir / "clustering.newick")
+        run_cluster(out_parent / iso.name, max_contigs=max_contigs,
+                    precomputed_distances=distances)
         log.message(f"{iso.name}: {len(sequences)} contigs clustered")
 
     log.section_header("Finished!")

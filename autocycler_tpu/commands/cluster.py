@@ -557,7 +557,12 @@ def save_data_to_tsv(sequences: List[Sequence], qc_results: Dict[int, ClusterQC]
 # ---------------- entry point ----------------
 
 def cluster(autocycler_dir, cutoff: float = 0.2, min_assemblies: Optional[int] = None,
-            max_contigs: int = 25, manual: Optional[str] = None, use_jax=None) -> None:
+            max_contigs: int = 25, manual: Optional[str] = None, use_jax=None,
+            precomputed_distances=None) -> None:
+    """precomputed_distances: optional {(id_a, id_b): float} replacing the
+    in-process distance computation — the `batch` subcommand passes each
+    isolate's matrix from the mesh-batched device contraction (bit-identical
+    to what pairwise_contig_distances would compute here)."""
     autocycler_dir = Path(autocycler_dir)
     gfa = autocycler_dir / "input_assemblies.gfa"
     clustering_dir = autocycler_dir / "clustering"
@@ -595,7 +600,8 @@ def cluster(autocycler_dir, cutoff: float = 0.2, min_assemblies: Optional[int] =
     log.section_header("Pairwise distances")
     log.explanation("Every pairwise distance between contigs is calculated based on the "
                     "similarity of their paths through the graph.")
-    asym = pairwise_contig_distances(graph, sequences, use_jax=use_jax)
+    asym = precomputed_distances if precomputed_distances is not None else \
+        pairwise_contig_distances(graph, sequences, use_jax=use_jax)
     save_distance_matrix(asym, sequences, clustering_dir / "pairwise_distances.phylip")
 
     log.section_header("Clustering sequences")
