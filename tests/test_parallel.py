@@ -120,6 +120,11 @@ def test_batch_command_bitwise_matches_cluster(tmp_path):
         got = (out / iso / "clustering" / "pairwise_distances.phylip").read_bytes()
         assert got == expected_phylip.read_bytes(), iso
         assert (out / iso / "clustering" / "clustering.newick").is_file()
+        assert (out / iso / "clustering" / "clustering.tsv").is_file()
+        # the full cluster stage ran: trim/resolve-ready checkpoints exist
+        passes = list((out / iso / "clustering" / "qc_pass").glob(
+            "cluster_*/1_untrimmed.gfa"))
+        assert passes, iso
 
     # integer-level: the sharded device contraction equals the host matmul
     # exactly (distances divide these by the diagonal with the same float
