@@ -150,7 +150,8 @@ def dispatch(args) -> None:
         combine(args.autocycler_dir, args.in_gfas)
     elif args.command == "compress":
         from .commands.compress import compress
-        compress(args.assemblies_dir, args.autocycler_dir, args.kmer, args.max_contigs)
+        compress(args.assemblies_dir, args.autocycler_dir, args.kmer,
+                 args.max_contigs, threads=args.threads)
     elif args.command == "decompress":
         from .commands.decompress import decompress
         decompress(args.in_gfa, args.out_dir, args.out_file)
@@ -177,7 +178,8 @@ def dispatch(args) -> None:
         table(args.autocycler_dir, args.name, args.fields, args.sigfigs)
     elif args.command == "trim":
         from .commands.trim import trim
-        trim(args.cluster_dir, args.min_identity, args.max_unitigs, args.mad)
+        trim(args.cluster_dir, args.min_identity, args.max_unitigs, args.mad,
+             args.threads)
 
 
 def main(argv=None) -> int:

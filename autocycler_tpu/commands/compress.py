@@ -19,8 +19,8 @@ from ..models import Sequence, UnitigGraph
 from ..models.simplify import simplify_structure
 from ..ops.end_repair import sequence_end_repair
 from ..ops.graph_build import build_unitig_graph
-from ..utils import (Spinner, find_all_assemblies, format_duration, load_fasta,
-                     log, quit_with_error)
+from ..utils import (Spinner, check_threads, find_all_assemblies,
+                     format_duration, load_fasta, log, quit_with_error)
 from ..utils.timing import stage_timer
 
 MAX_INPUT_SEQUENCES = 32767  # position packing limit (reference compress.rs:112-114)
@@ -41,9 +41,10 @@ def check_settings(assemblies_dir, autocycler_dir, k_size: int) -> None:
 
 
 def compress(assemblies_dir, autocycler_dir, k_size: int = 51,
-             max_contigs: int = 25, use_jax=None) -> None:
+             max_contigs: int = 25, use_jax=None, threads: int = 1) -> None:
     start_time = time.perf_counter()
     check_settings(assemblies_dir, autocycler_dir, k_size)
+    check_threads(threads)
     log.section_header("Starting autocycler compress")
     log.explanation("This command finds all assemblies in the given input directory and "
                     "compresses them into a compacted De Bruijn graph. This graph can then "
@@ -53,7 +54,7 @@ def compress(assemblies_dir, autocycler_dir, k_size: int = 51,
     metrics = InputAssemblyMetrics()
     with stage_timer("compress/load_and_repair"):
         sequences, assembly_count = load_sequences(assemblies_dir, k_size, metrics,
-                                                   max_contigs)
+                                                   max_contigs, threads)
     log.section_header("Building compacted unitig graph")
     log.explanation("K-mers are grouped with a sort-based device kernel, unitig chains "
                     "are assembled, and all non-branching paths are collapsed to form a "
@@ -85,7 +86,7 @@ def compress(assemblies_dir, autocycler_dir, k_size: int = 51,
 
 
 def load_sequences(assemblies_dir, k_size: int, metrics: InputAssemblyMetrics,
-                   max_contigs: int) -> Tuple[List[Sequence], int]:
+                   max_contigs: int, threads: int = 1) -> Tuple[List[Sequence], int]:
     """Load all contigs from all assemblies, skipping sub-k contigs and
     ignored headers, then repair dotted ends (reference compress.rs:98-133)."""
     log.section_header("Loading input assemblies")
@@ -116,7 +117,7 @@ def load_sequences(assemblies_dir, k_size: int, metrics: InputAssemblyMetrics,
     log.message()
     check_sequence_count(sequences, len(assemblies), max_contigs)
     with Spinner("repairing sequence ends..."):
-        sequence_end_repair(sequences, k_size)
+        sequence_end_repair(sequences, k_size, threads)
     n = seq_id
     log.message(f"{n} sequence{'' if n == 1 else 's'} loaded from {len(assemblies)} "
                 f"assembl{'y' if len(assemblies) == 1 else 'ies'}")

@@ -20,14 +20,14 @@ from ..metrics import TrimmedClusterMetrics
 from ..models import Sequence, UnitigGraph
 from ..models.simplify import merge_linear_paths
 from ..ops.align import GAP, Weights, find_midpoint, overlap_alignment
-from ..utils import (log, mad as mad_fn, median, quit_with_error,
-                     reverse_signed_path)
+from ..utils import (check_threads, log, mad as mad_fn, map_threaded, median,
+                     quit_with_error, reverse_signed_path)
 
 TrimResult = Optional[Tuple[List[int], int]]
 
 
 def trim(cluster_dir, min_identity: float = 0.75, max_unitigs: int = 5000,
-         mad: float = 5.0) -> None:
+         mad: float = 5.0, threads: int = 1) -> None:
     cluster_dir = Path(cluster_dir)
     untrimmed_gfa = cluster_dir / "1_untrimmed.gfa"
     trimmed_gfa = cluster_dir / "2_trimmed.gfa"
@@ -40,6 +40,7 @@ def trim(cluster_dir, min_identity: float = 0.75, max_unitigs: int = 5000,
         quit_with_error("--min_identity must be between 0.0 and 1 (inclusive)")
     if mad < 0.0:
         quit_with_error("--mad cannot be less than 0")
+    check_threads(threads)
 
     log.section_header("Starting autocycler trim")
     log.explanation("This command takes a single-cluster unitig graph (made by autocycler "
@@ -60,9 +61,9 @@ def trim(cluster_dir, min_identity: float = 0.75, max_unitigs: int = 5000,
     all_paths = graph.get_unitig_paths_for_sequences([s.id for s in sequences]) \
         if max_unitigs else {}
     start_end = trim_start_end_overlap(graph, sequences, weights, min_identity,
-                                       max_unitigs, all_paths)
+                                       max_unitigs, all_paths, threads)
     hairpin = trim_hairpin_overlap(graph, sequences, weights, min_identity,
-                                   max_unitigs, all_paths)
+                                   max_unitigs, all_paths, threads)
     sequences = choose_trim_type(start_end, hairpin, graph, sequences)
     sequences = exclude_outliers_in_length(graph, sequences, mad)
     clean_up_graph(graph, sequences)
@@ -75,23 +76,29 @@ def trim(cluster_dir, min_identity: float = 0.75, max_unitigs: int = 5000,
 
 def trim_start_end_overlap(graph: UnitigGraph, sequences: List[Sequence],
                            weights: Weights, min_identity: float,
-                           max_unitigs: int, all_paths=None) -> List[TrimResult]:
+                           max_unitigs: int, all_paths=None,
+                           threads: int = 1) -> List[TrimResult]:
     """Per-sequence circular start-end trimming (reference trim.rs:113-136).
     A max_unitigs of 0 disables trimming."""
     if max_unitigs == 0:
         return [None] * len(sequences)
     if all_paths is None:
         all_paths = graph.get_unitig_paths_for_sequences([s.id for s in sequences])
-    results: List[TrimResult] = []
-    for seq in sequences:
+
+    def one(seq: Sequence) -> TrimResult:
         path = [n if s else -n for n, s in all_paths[seq.id]]
         trimmed = trim_path_start_end(path, weights, min_identity, max_unitigs)
-        if trimmed is not None:
-            length = sum(weights[abs(u)] for u in trimmed)
-            results.append((trimmed, length))
-            log.message(f"{seq}: trimmed to {length} bp")
+        if trimmed is None:
+            return None
+        return trimmed, sum(weights[abs(u)] for u in trimmed)
+
+    # the DP work runs (possibly pooled) first; logging stays sequential so
+    # the output order matches the reference's
+    results = map_threaded(one, sequences, threads)
+    for seq, result in zip(sequences, results):
+        if result is not None:
+            log.message(f"{seq}: trimmed to {result[1]} bp")
         else:
-            results.append(None)
             log.message(f"{seq}: not trimmed")
     log.message()
     return results
@@ -99,14 +106,15 @@ def trim_start_end_overlap(graph: UnitigGraph, sequences: List[Sequence],
 
 def trim_hairpin_overlap(graph: UnitigGraph, sequences: List[Sequence],
                          weights: Weights, min_identity: float,
-                         max_unitigs: int, all_paths=None) -> List[TrimResult]:
+                         max_unitigs: int, all_paths=None,
+                         threads: int = 1) -> List[TrimResult]:
     """Per-sequence hairpin trimming at both path ends (reference trim.rs:139-186)."""
     if max_unitigs == 0:
         return [None] * len(sequences)
     if all_paths is None:
         all_paths = graph.get_unitig_paths_for_sequences([s.id for s in sequences])
-    results: List[TrimResult] = []
-    for seq in sequences:
+
+    def one(seq: Sequence):
         path = [n if s else -n for n, s in all_paths[seq.id]]
         trimmed_start = trimmed_end = False
         p2 = trim_path_hairpin_start(path, weights, min_identity, max_unitigs)
@@ -119,6 +127,11 @@ def trim_hairpin_overlap(graph: UnitigGraph, sequences: List[Sequence],
             trimmed_end = True
         else:
             p3 = p2
+        return p3, trimmed_start, trimmed_end
+
+    results: List[TrimResult] = []
+    for seq, (p3, trimmed_start, trimmed_end) in zip(
+            sequences, map_threaded(one, sequences, threads)):
         if not trimmed_start and not trimmed_end:
             results.append(None)
             log.message(f"{seq}: not trimmed")

@@ -33,6 +33,10 @@ def _lib_path() -> Path:
 
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
+# get_lib can be hit concurrently on first use (trim/compress --threads
+# pools); the lock keeps one thread building+loading while others wait
+import threading
+_lib_lock = threading.Lock()
 
 
 def _build(lib_path: Path) -> bool:
@@ -60,6 +64,11 @@ def _stale(lib_path: Path) -> bool:
 def get_lib() -> Optional[ctypes.CDLL]:
     """The loaded library, (re)building it first if missing or older than the
     source; None if unavailable."""
+    with _lib_lock:
+        return _get_lib_locked()
+
+
+def _get_lib_locked() -> Optional[ctypes.CDLL]:
     global _lib, _tried
     if _lib is not None:
         return _lib
@@ -70,6 +79,12 @@ def get_lib() -> Optional[ctypes.CDLL]:
     if (not lib_path.is_file() or _stale(lib_path)) and not _build(lib_path):
         if not lib_path.is_file():
             return None
+        if _stale(lib_path):
+            # the ABI gate below only catches signature changes; semantic
+            # fixes that keep the ABI would otherwise run old code silently
+            import sys
+            print(f"autocycler: rebuild of {lib_path} failed; loading the "
+                  f"STALE binary (older than seqkernel.cpp)", file=sys.stderr)
     try:
         lib = ctypes.CDLL(str(lib_path))
         # versioned feature set: a prebuilt library with a different ABI
@@ -176,6 +191,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
         _lib = lib
         return lib
     except OSError:
+        return None
+    except AttributeError:
+        # a pinned AUTOCYCLER_NATIVE_LIB predating even the stable symbol set
+        # (sk_group_windows, sk_overlap_dp, ...) — treat as unavailable
         return None
 
 

@@ -24,6 +24,17 @@ import numpy as np
 _JAX_THRESHOLD = 512 * 4096  # M elements; above this the device matmul wins
 
 
+def exceeds_int32_accumulation(weighted: np.ndarray) -> bool:
+    """Whether a device int32 contraction of ``weighted`` (a 0/1 membership
+    matrix times per-column weights, rows = contigs) could wrap: the largest
+    possible intersection cell is a full weighted row sum. Shared by the
+    single-isolate matmul here and parallel/batch.py's mesh contraction so
+    the exactness guard can't drift between them."""
+    if not weighted.size:
+        return False
+    return int(weighted.sum(axis=-1).max()) > np.iinfo(np.int32).max
+
+
 def membership_matrix(graph, sequences) -> Tuple[np.ndarray, np.ndarray, List[int]]:
     """(M: contigs × unitigs uint8, w: unitig lengths int64, seq ids)."""
     numbers = [u.number for u in graph.unitigs]
@@ -63,6 +74,8 @@ def pairwise_distance_matrix(M: np.ndarray, w: np.ndarray,
     if use_jax is None:
         use_jax = M.size >= _JAX_THRESHOLD
     Mw = M.astype(np.int64) * w[None, :]
+    if use_jax and exceeds_int32_accumulation(Mw):
+        use_jax = False
     if use_jax:
         try:
             import jax.numpy as jnp
@@ -70,7 +83,11 @@ def pairwise_distance_matrix(M: np.ndarray, w: np.ndarray,
                 jnp.matmul(jnp.asarray(Mw, dtype=jnp.int32),
                            jnp.asarray(M.T, dtype=jnp.int32)),
             ).astype(np.int64)
-        except Exception:
+        except (ImportError, RuntimeError, ValueError, MemoryError) as e:
+            import sys
+            print(f"autocycler: device distance matmul failed "
+                  f"({type(e).__name__}: {e}); falling back to host matmul",
+                  file=sys.stderr)
             inter = Mw @ M.astype(np.int64).T
     else:
         inter = Mw @ M.astype(np.int64).T

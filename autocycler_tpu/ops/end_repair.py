@@ -103,11 +103,15 @@ def _matches_by_query_grouped(codes, text_off, text_len, h, q_starts):
     return by_query
 
 
-def sequence_end_repair(sequences: List[Sequence], k_size: int) -> None:
+def sequence_end_repair(sequences: List[Sequence], k_size: int,
+                        threads: int = 1) -> None:
     """In-place repair of every sequence's dotted ends (compress.rs:202-236).
 
     Matches are searched in the ORIGINAL (pre-repair) sequences, like the
-    reference's cloned all_seqs snapshot (compress.rs:209).
+    reference's cloned all_seqs snapshot (compress.rs:209). The reference
+    rayon-parallelises the per-sequence repair (compress.rs:210); here the
+    occurrence scan is one batched native pass and only the per-sequence
+    candidate selection distributes over ``threads``.
     """
     if not sequences:
         return
@@ -167,7 +171,8 @@ def sequence_end_repair(sequences: List[Sequence], k_size: int) -> None:
         rows = buf[starts[:, None] + np.arange(overlap)]
         return _best_match_rows(rows)
 
-    for i, s in enumerate(sequences):
+    def repair_one(i: int) -> None:
+        s = sequences[i]
         P = len(s.forward_seq)
         best_start = best_candidate(2 * i, h)
         best_end = best_candidate(2 * i + 1, 0)
@@ -176,3 +181,6 @@ def sequence_end_repair(sequences: List[Sequence], k_size: int) -> None:
         repaired[P - overlap:] = np.frombuffer(best_end, dtype=np.uint8)
         s.forward_seq = repaired
         s.reverse_seq = reverse_complement_bytes(repaired)
+
+    from ..utils import map_threaded
+    map_threaded(repair_one, range(len(sequences)), threads)

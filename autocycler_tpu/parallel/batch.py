@@ -194,14 +194,26 @@ def batched_membership_intersections(mesh, M_list: List[np.ndarray],
 
     Mw = np.zeros((Bp, S, U), dtype=np.int32)
     M = np.zeros((Bp, S, U), dtype=np.int32)
+    from ..ops.distance import exceeds_int32_accumulation
+    host_only = []   # isolates whose intersections could exceed int32
     for i, (m, w) in enumerate(zip(M_list, w_list)):
         s, u = m.shape
+        weighted = m.astype(np.int64) * w[None, :]
+        # past int32 range the device accumulation would silently wrap, so
+        # those isolates take the exact host matmul instead
+        if exceeds_int32_accumulation(weighted):
+            host_only.append(i)
+            continue
         M[i, :s, :u] = m
-        Mw[i, :s, :u] = m.astype(np.int64) * w[None, :]
+        Mw[i, :s, :u] = weighted
 
     step = shard_map(functools.partial(_membership_body, seq_axis="seq"),
                      mesh=mesh,
                      in_specs=(P("data", None, "seq"), P("data", None, "seq")),
                      out_specs=P("data", None, None))
     inter = np.asarray(jax.jit(step)(Mw, M)).astype(np.int64)
-    return [inter[i, :m.shape[0], :m.shape[0]] for i, m in enumerate(M_list)]
+    out = [inter[i, :m.shape[0], :m.shape[0]] for i, m in enumerate(M_list)]
+    for i in host_only:
+        m, w = M_list[i], w_list[i]
+        out[i] = (m.astype(np.int64) * w[None, :]) @ m.astype(np.int64).T
+    return out

@@ -144,6 +144,25 @@ def after_first_space(string: str) -> str:
     return parts[1] if len(parts) > 1 else ""
 
 
+def check_threads(threads: int) -> None:
+    """--threads range validation (reference main.rs:145-146)."""
+    if not 1 <= threads <= 100:
+        quit_with_error("--threads must be between 1 and 100 (inclusive)")
+
+
+def map_threaded(fn, items, threads: int) -> list:
+    """Order-preserving map over items with a thread pool. The hot per-item
+    work in the callers is native ctypes calls / numpy kernels, which release
+    the GIL — the analogue of the reference's rayon par_iter pools
+    (compress.rs:59-62, trim.rs:122,148). threads<=1 is a plain map."""
+    items = list(items)
+    if threads <= 1 or len(items) <= 1:
+        return [fn(x) for x in items]
+    from concurrent.futures import ThreadPoolExecutor
+    with ThreadPoolExecutor(max_workers=min(threads, len(items))) as pool:
+        return list(pool.map(fn, items))
+
+
 import threading as _threading
 
 # serialises spinner redraws with log writes (see log.py)

@@ -76,14 +76,20 @@ def _run_headline_once() -> float:
 
 
 def bench_headline() -> None:
-    # best of 2: the shared VM shows ~±20% host noise run to run, and the
-    # algorithmic cost is the quantity being tracked
-    elapsed = min(_run_headline_once() for _ in range(2))
+    # The shared VM shows ±20-50% host-noise episodes run to run; the
+    # headline value is the MEDIAN of 3 runs (the honest central statistic),
+    # with best/all alongside so noise-free capability is visible too
+    # (VERDICT r2 item 6).
+    runs = sorted(round(_run_headline_once(), 2) for _ in range(3))
+    elapsed = runs[len(runs) // 2]
     print(json.dumps({
         "metric": "headline_pipeline_24x6Mbp",
-        "value": round(elapsed, 2),
+        "value": elapsed,
         "unit": "s",
         "vs_baseline": round(TARGET_SECONDS / elapsed, 3),
+        "median_s": elapsed,
+        "best_s": runs[0],
+        "runs_s": runs,
     }))
 
 

@@ -370,7 +370,9 @@ struct Res {
     std::vector<int32_t> q, t;
     std::vector<int64_t> p;
 };
-static std::unique_ptr<Res> g_res;
+// thread_local: each thread's begin/fetch pair is independent, so concurrent
+// Python threads (compress/trim --threads) cannot clobber each other's stash
+static thread_local std::unique_ptr<Res> g_res;
 
 template <typename Emit>
 static int64_t scan_impl(const uint8_t* codes,
@@ -663,7 +665,7 @@ struct State {
     std::vector<int32_t> rev_kid, prefix_gid, suffix_gid;  // per final gid
 };
 
-static std::unique_ptr<State> g_state;
+static thread_local std::unique_ptr<State> g_state;
 
 }  // namespace occidx
 
@@ -1025,7 +1027,7 @@ int32_t sk_occ_index_finish(int64_t* depth, int64_t* rep_byte,
 // gather-then-flatnonzero over a 147M-element temp). Stash protocol like
 // the gram scan: begin returns the hit count, fetch copies + frees.
 namespace collectscan {
-static std::unique_ptr<std::vector<int64_t>> g_hits;
+static thread_local std::unique_ptr<std::vector<int64_t>> g_hits;
 }
 
 int64_t sk_collect_marked_begin(const int32_t* gid, int64_t n,

@@ -33,6 +33,24 @@ def test_group_windows_jax_flag():
     assert (got[0] == exp[0]).all() and (got[1] == exp[1]).all()
 
 
+def test_device_failure_falls_back_loudly(monkeypatch, capsys):
+    """A device-path failure must still produce the right answer AND surface
+    a one-line stderr note — never a silent swallow (VERDICT r2 item 7)."""
+    import autocycler_tpu.ops.kmers as kmers_mod
+
+    def boom(codes, starts, k):
+        raise RuntimeError("synthetic device failure")
+
+    monkeypatch.setattr(kmers_mod, "_pack_and_rank_jax", boom)
+    codes, starts, k = _case(11)
+    exp = group_windows(codes, starts, k, use_jax=False)
+    got = group_windows(codes, starts, k, use_jax=True)
+    assert (got[0] == exp[0]).all() and (got[1] == exp[1]).all()
+    err = capsys.readouterr().err
+    assert "device k-mer grouping failed" in err
+    assert "synthetic device failure" in err
+
+
 def test_full_index_identical_across_backends():
     """The fused native kernel, the numpy fallback, and the jax path must
     agree on every semantic field; the fused path additionally answers

@@ -81,3 +81,34 @@ def test_full_pipeline_to_consensus(tmp_path):
 
     gfa2fasta(out_dir / "consensus_assembly.gfa", out_dir / "via_gfa2fasta.fasta")
     assert (out_dir / "via_gfa2fasta.fasta").is_file()
+
+
+def test_threads_identical_output(tmp_path):
+    """compress/trim with a thread pool must be byte-identical to the
+    sequential run, and --threads range-validates like the reference
+    (main.rs:145-146)."""
+    import pytest
+    from autocycler_tpu.utils import AutocyclerError
+
+    asm_dir = make_assemblies(tmp_path, n_assemblies=4, chromosome_len=3000,
+                              plasmid_len=600, seed=23)
+    out1 = tmp_path / "out_t1"
+    out4 = tmp_path / "out_t4"
+    compress(asm_dir, out1, k_size=51, use_jax=False, threads=1)
+    compress(asm_dir, out4, k_size=51, use_jax=False, threads=4)
+    assert (out1 / "input_assemblies.gfa").read_bytes() == \
+        (out4 / "input_assemblies.gfa").read_bytes()
+
+    cluster(out1, use_jax=False)
+    cluster(out4, use_jax=False)
+    for cdir1, cdir4 in zip(sorted((out1 / "clustering" / "qc_pass").iterdir()),
+                            sorted((out4 / "clustering" / "qc_pass").iterdir())):
+        trim(cdir1, threads=1)
+        trim(cdir4, threads=4)
+        assert (cdir1 / "2_trimmed.gfa").read_bytes() == \
+            (cdir4 / "2_trimmed.gfa").read_bytes()
+
+    with pytest.raises(AutocyclerError, match="--threads"):
+        compress(asm_dir, tmp_path / "bad", threads=0)
+    with pytest.raises(AutocyclerError, match="--threads"):
+        trim(cdir1, threads=101)
