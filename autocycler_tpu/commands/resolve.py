@@ -13,6 +13,8 @@ fresh graph. Writes 3_bridged.gfa, 4_merged.gfa, 5_final.gfa.
 from __future__ import annotations
 
 from pathlib import Path
+
+import numpy as np
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..models import Sequence, Unitig, UnitigGraph, UnitigType
@@ -93,11 +95,11 @@ class Bridge:
 def find_anchor_unitigs(graph: UnitigGraph, sequences: List[Sequence]) -> List[int]:
     """Anchors occur once and only once in every sequence
     (reference resolve.rs:134-163)."""
-    all_seq_ids = sorted(s.id for s in sequences)
+    all_seq_ids = np.sort(np.array([s.id for s in sequences], np.int32))
     anchor_ids = []
     for unitig in graph.unitigs:
-        forward_seq_ids = sorted(p.seq_id for p in unitig.forward_positions)
-        if forward_seq_ids == all_seq_ids:
+        forward_seq_ids = np.sort(unitig.forward_positions.seq_id)
+        if np.array_equal(forward_seq_ids, all_seq_ids):
             unitig.unitig_type = UnitigType.ANCHOR
             anchor_ids.append(unitig.number)
     n = len(anchor_ids)

@@ -155,16 +155,21 @@ def choose_trim_type(start_end_results: List[TrimResult],
     if start_end_count == 0 and hairpin_count == 0:
         return list(sequences)
     results = start_end_results if start_end_count >= hairpin_count else hairpin_results
+    # one batched removal + one batched stamping for ALL trimmed sequences
+    graph.remove_sequences_from_graph(
+        [seq.id for seq, r in zip(sequences, results) if r is not None])
     trimmed_sequences = []
+    entries = []
     for seq, result in zip(sequences, results):
         if result is None:
             trimmed_sequences.append(seq)
         else:
-            graph.remove_sequence_from_graph(seq.id)
             path, length = result
-            trimmed_sequences.append(graph.create_sequence_and_positions(
-                seq.id, length, seq.filename, seq.contig_header, seq.cluster,
-                [(abs(u), u > 0) for u in path]))
+            arr = np.asarray(path, np.int64)
+            entries.append((seq.id, length, np.abs(arr), arr > 0))
+            trimmed_sequences.append(Sequence.without_seq(
+                seq.id, seq.filename, seq.contig_header, length, seq.cluster))
+    graph.stamp_paths_batch(entries)
     return trimmed_sequences
 
 
@@ -183,14 +188,15 @@ def exclude_outliers_in_length(graph: UnitigGraph, sequences: List[Sequence],
     log.message(f"Median absolute deviation: {deviation} bp")
     log.message(f"Allowed length range:      {min_length}-{max_length} bp")
     log.message()
-    kept = []
+    kept, excluded = [], []
     for seq in sequences:
         if min_length <= seq.length <= max_length:
             kept.append(seq)
             log.message(f"{seq}: kept")
         else:
             log.message(f"{seq}: excluded")
-            graph.remove_sequence_from_graph(seq.id)
+            excluded.append(seq.id)
+    graph.remove_sequences_from_graph(excluded)
     log.message()
     return kept
 

@@ -130,8 +130,11 @@ def _avoid_start_of_path(common: np.ndarray, dest: Unitig,
     if len(common) == 0:
         return common
     positions = dest.forward_positions if trim_from_start else dest.reverse_positions
-    while len(common) and any(p.pos <= len(common) for p in positions):
-        common = common[1:] if trim_from_start else common[:-1]
+    if len(positions):
+        # the while-loop's fixpoint is min_pos > len(common); min is invariant
+        min_pos = int(positions.pos.min())
+        keep = min(len(common), max(0, min_pos - 1))
+        common = common[len(common) - keep:] if trim_from_start else common[:keep]
     return common
 
 
@@ -305,10 +308,10 @@ def _merge_path(graph: UnitigGraph, path: List[UnitigStrand], new_number: int) -
     self links (reference graph_simplification.rs:410-485)."""
     merged_seq = np.concatenate([u.get_seq() for u in path])
     first, last = path[0], path[-1]
-    forward_positions = list(first.unitig.forward_positions if first.strand
-                             else first.unitig.reverse_positions)
-    reverse_positions = list(last.unitig.reverse_positions if last.strand
-                             else last.unitig.forward_positions)
+    forward_positions = (first.unitig.forward_positions if first.strand
+                         else first.unitig.reverse_positions).copy()
+    reverse_positions = (last.unitig.reverse_positions if last.strand
+                         else last.unitig.forward_positions).copy()
 
     end_to_start = graph.link_exists(last.number, last.strand, first.number, first.strand)
     start_flip = graph.link_exists(first.number, not first.strand, first.number, first.strand)
@@ -368,7 +371,7 @@ def _merge_path(graph: UnitigGraph, path: List[UnitigStrand], new_number: int) -
 def _merge_path_depth(path: List[UnitigStrand], forward_positions) -> float:
     """Position count if available, else anchor depth, else length-weighted
     mean (reference graph_simplification.rs:501-526)."""
-    if forward_positions:
+    if len(forward_positions):
         return float(len(forward_positions))
     for u in path:
         if u.is_anchor():

@@ -20,6 +20,7 @@ from typing import List, Optional
 import numpy as np
 
 from ..utils import FORWARD, REVERSE, quit_with_error, reverse_complement_bytes
+from .position import PositionArray
 
 ANCHOR_COLOUR = "forestgreen"
 BRIDGE_COLOUR = "pink"
@@ -59,8 +60,8 @@ class Unitig:
         self._reverse_seq = reverse_seq
         self.depth = depth
         self.unitig_type = unitig_type
-        self.forward_positions: list = []
-        self.reverse_positions: list = []
+        self.forward_positions = PositionArray()
+        self.reverse_positions = PositionArray()
         self.forward_next: List[UnitigStrand] = []
         self.forward_prev: List[UnitigStrand] = []
         self.reverse_next: List[UnitigStrand] = []
@@ -176,27 +177,23 @@ class Unitig:
 
     def remove_seq_from_start(self, amount: int) -> None:
         assert amount <= len(self.forward_seq)
-        for p in self.forward_positions:
-            p.pos += amount
+        self.forward_positions.shift_pos(amount)
         self.forward_seq = self.forward_seq[amount:]
         self._reverse_seq = None  # rederived lazily from the trimmed forward
 
     def remove_seq_from_end(self, amount: int) -> None:
         assert amount <= len(self.forward_seq)
-        for p in self.reverse_positions:
-            p.pos += amount
+        self.reverse_positions.shift_pos(amount)
         self.forward_seq = self.forward_seq[:len(self.forward_seq) - amount]
         self._reverse_seq = None  # rederived lazily from the trimmed forward
 
     def add_seq_to_start(self, seq: np.ndarray) -> None:
-        for p in self.forward_positions:
-            p.pos -= len(seq)
+        self.forward_positions.shift_pos(-len(seq))
         self.forward_seq = np.concatenate([seq, self.forward_seq])
         self._reverse_seq = None
 
     def add_seq_to_end(self, seq: np.ndarray) -> None:
-        for p in self.reverse_positions:
-            p.pos -= len(seq)
+        self.reverse_positions.shift_pos(-len(seq))
         self.forward_seq = np.concatenate([self.forward_seq, seq])
         self._reverse_seq = None
 
@@ -205,8 +202,13 @@ class Unitig:
     def remove_sequence(self, seq_id: int) -> None:
         """Drop all positions with the given sequence ID and recalculate depth
         (unitig.rs:250-257)."""
-        self.forward_positions = [p for p in self.forward_positions if p.seq_id != seq_id]
-        self.reverse_positions = [p for p in self.reverse_positions if p.seq_id != seq_id]
+        self.remove_sequences((seq_id,))
+
+    def remove_sequences(self, seq_ids) -> None:
+        """Batch form of :meth:`remove_sequence` — one mask per strand for
+        the whole id set."""
+        self.forward_positions = self.forward_positions.without_seq_ids(seq_ids)
+        self.reverse_positions = self.reverse_positions.without_seq_ids(seq_ids)
         assert len(self.forward_positions) == len(self.reverse_positions)
         self.recalculate_depth()
 
@@ -214,8 +216,8 @@ class Unitig:
         self.depth = float(len(self.forward_positions))
 
     def clear_positions(self) -> None:
-        self.forward_positions = []
-        self.reverse_positions = []
+        self.forward_positions = PositionArray()
+        self.reverse_positions = PositionArray()
 
     def reduce_depth_by_one(self) -> None:
         self.depth = max(0.0, self.depth - 1.0)

@@ -20,7 +20,7 @@ from typing import Dict, List, Tuple
 import numpy as np
 
 from ..utils import FORWARD, REVERSE, load_file_lines, quit_with_error
-from .position import Position
+from .position import Position, PositionArray
 from .sequence import Sequence
 from .unitig import Unitig, UnitigStrand
 
@@ -40,6 +40,39 @@ def parse_unitig_path(path_str: str) -> List[Tuple[int, bool]]:
     return path
 
 
+def parse_unitig_path_arrays(path_str: str) -> Tuple[np.ndarray, np.ndarray]:
+    """Vectorised :func:`parse_unitig_path`: '1+,2-' -> (numbers int64[],
+    strands bool[]). The whole P-line path is parsed with array ops (digit
+    place-value accumulation per token) instead of per-token string slicing;
+    malformed input falls back to the scalar parser for its error message."""
+    b = np.frombuffer(path_str.encode(), np.uint8)
+    if len(b) == 0:
+        quit_with_error("Invalid path strand: ")
+    is_comma = b == 44
+    sign_idx = np.flatnonzero((b == 43) | (b == 45))
+    comma_idx = np.flatnonzero(is_comma)
+    T = len(comma_idx) + 1
+    starts = np.concatenate([[0], comma_idx + 1])
+    ends = np.concatenate([comma_idx, [len(b)]])
+    digit_mask = (b >= 48) & (b <= 57)
+    ok = (len(sign_idx) == T
+          and np.array_equal(sign_idx, ends - 1)       # sign char ends token
+          and (sign_idx - starts >= 1).all()           # >=1 digit per token
+          # >15-digit ids would lose precision in the f64 place-value sum
+          and (sign_idx - starts <= 15).all()
+          and (digit_mask | is_comma | (b == 43) | (b == 45)).all())
+    if not ok:
+        path = parse_unitig_path(path_str)              # scalar error parity
+        return (np.array([n for n, _ in path], np.int64),
+                np.array([s for _, s in path], bool))
+    # place-value accumulation: digit at i in token t weighs 10^(end_t-2-i)
+    di = np.flatnonzero(digit_mask)
+    tok = np.searchsorted(starts, di, side="right") - 1
+    exp = (sign_idx[tok] - 1 - di).astype(np.float64)
+    vals = np.bincount(tok, weights=(b[di] - 48) * 10.0 ** exp, minlength=T)
+    return vals.astype(np.int64), b[sign_idx] == 43
+
+
 def reverse_path(path: List[Tuple[int, bool]]) -> List[Tuple[int, bool]]:
     return [(num, not strand) for num, strand in reversed(path)]
 
@@ -49,9 +82,6 @@ class UnitigGraph:
         self.unitigs: List[Unitig] = []
         self.k_size = k_size
         self.index: Dict[int, Unitig] = {}
-        # transient number -> (positions lists, length) map used while
-        # stamping many paths in one batch (see create_sequence_and_positions)
-        self._path_helper = None
         # paths parsed from the GFA P-lines, valid until any mutation that
         # could change path composition (see invalidate_paths_cache callers);
         # position-COORDINATE edits (repeat expansion) keep it valid because
@@ -121,13 +151,7 @@ class UnitigGraph:
 
     def _build_paths_from_gfa(self, path_lines: List[List[str]]) -> List[Sequence]:
         sequences = []
-        # one lookup table for all paths: number -> (fwd positions list,
-        # rev positions list, length); keeps the hot stamping loop free of
-        # attribute lookups (big SNPy graphs have millions of path steps)
-        self._path_helper = {
-            u.number: (u.forward_positions, u.reverse_positions,
-                       len(u.forward_seq))
-            for u in self.unitigs}
+        entries = []
         paths_cache = {}
         for parts in path_lines:
             seq_id = int(parts[1])
@@ -144,54 +168,105 @@ class UnitigGraph:
                     cluster = int(p[5:])
             if length is None or filename is None or header is None:
                 quit_with_error("missing required tag in GFA path line.")
-            path = parse_unitig_path(parts[2])
-            sequences.append(self.create_sequence_and_positions(
-                seq_id, length, filename, header, cluster, path))
-            paths_cache[seq_id] = path
-        self._path_helper = None
+            numbers, strands = parse_unitig_path_arrays(parts[2])
+            entries.append((seq_id, length, numbers, strands))
+            sequences.append(Sequence.without_seq(seq_id, filename, header,
+                                                  length, cluster))
+            paths_cache[seq_id] = list(zip(numbers.tolist(), strands.tolist()))
+        self.stamp_paths_batch(entries)
         self._paths_cache = paths_cache
         return sequences
 
     def create_sequence_and_positions(self, seq_id: int, length: int, filename: str,
                                       header: str, cluster: int,
                                       forward_path: List[Tuple[int, bool]]) -> Sequence:
-        """Register a sequence's path through the graph by stamping Position
-        records onto each traversed unitig, both strands
-        (reference unitig_graph.rs:151-174).
+        """Register a sequence's path through the graph by stamping positions
+        onto each traversed unitig, both strands (reference
+        unitig_graph.rs:151-174). Single-path wrapper over
+        :meth:`stamp_paths_batch`."""
+        numbers = np.array([n for n, _ in forward_path], np.int64)
+        strands = np.array([s for _, s in forward_path], bool)
+        self.stamp_paths_batch([(seq_id, length, numbers, strands)])
+        return Sequence.without_seq(seq_id, filename, header, length, cluster)
+
+    def stamp_paths_batch(self, entries) -> None:
+        """Stamp many sequence paths in one vectorised pass. ``entries`` is a
+        list of (seq_id, length, numbers int64[], strands bool[]).
 
         One pass covers both strands: the reverse-path position of the step
-        at forward position p is length - p - len(unitig). Position-list
-        ORDER is not part of the model's contract (every consumer sorts or
-        filters), so the reverse entries land in forward order."""
+        at forward position p is length - p - len(unitig)
+        (reference unitig_graph.rs:151-174). All stamps of the batch are
+        grouped per (unitig, strand) with one sort, then assigned as array
+        slices — positions become views into two batch-level SoA blocks.
+        Position ORDER within a unitig is not part of the model's contract
+        (every consumer sorts or filters)."""
         self.invalidate_paths_cache()
-        helper = self._path_helper
-        if helper is None:
-            # single-path call: per-step index lookups beat building an
-            # O(unitigs) helper for one path
-            index_get = self.index.get
+        entries = [e for e in entries if len(e[2])]
+        if not entries:
+            return
+        numbers_all = np.concatenate([e[2] for e in entries])
+        strands_all = np.concatenate([e[3] for e in entries])
+        sid_all = np.concatenate([np.full(len(e[2]), e[0], np.int32)
+                                  for e in entries])
+        L_all = np.concatenate([np.full(len(e[2]), e[1], np.int64)
+                                for e in entries])
+        path_off = np.zeros(len(entries) + 1, np.int64)
+        np.cumsum([len(e[2]) for e in entries], out=path_off[1:])
 
-            def entry_for(num):
-                u = index_get(num)
-                if u is None:
-                    return None
-                return u.forward_positions, u.reverse_positions, len(u.forward_seq)
-        else:
-            entry_for = helper.get
-        pos = 0
-        for unitig_num, unitig_strand in forward_path:
-            entry = entry_for(unitig_num)
-            if entry is None:
-                quit_with_error(f"unitig {unitig_num} not found in unitig index")
-            fwd, rev, ln = entry
-            if unitig_strand:
-                fwd.append(Position(seq_id, FORWARD, pos))
-                rev.append(Position(seq_id, REVERSE, length - pos - ln))
+        # dense number -> (row, length) lookup
+        max_num = max((u.number for u in self.unitigs), default=0)
+        row_of = np.full(max_num + 1, -1, np.int64)
+        lengths = np.zeros(max_num + 1, np.int64)
+        for r, u in enumerate(self.unitigs):
+            row_of[u.number] = r
+            lengths[u.number] = len(u.forward_seq)
+        if numbers_all.max(initial=0) > max_num or \
+                (row_of[numbers_all] < 0).any():
+            bad = numbers_all[(numbers_all > max_num) |
+                              (row_of[np.minimum(numbers_all, max_num)] < 0)][0]
+            quit_with_error(f"unitig {int(bad)} not found in unitig index")
+        ln = lengths[numbers_all]
+        rows = row_of[numbers_all]
+
+        # per-path exclusive cumsum of step lengths = forward positions
+        cum = np.cumsum(ln)
+        base = np.zeros(len(ln), np.int64)
+        base[path_off[1:-1]] = cum[path_off[1:-1] - 1]
+        pos = cum - ln - np.maximum.accumulate(base)
+        # every path must sum to its declared length
+        ends = cum[path_off[1:] - 1] - np.concatenate(
+            [[0], cum[path_off[1:-1] - 1]])
+        assert np.array_equal(ends, np.array([e[1] for e in entries])), \
+            "Position calculation mismatch"
+
+        mirror = L_all - pos - ln
+        # first half: FORWARD stamps at pos; second half: REVERSE at mirror.
+        # A + step stamps FORWARD onto the forward list (side True); a - step
+        # stamps FORWARD onto the reverse list.
+        side = np.concatenate([strands_all, ~strands_all])
+        st = np.concatenate([np.ones(len(pos), bool), np.zeros(len(pos), bool)])
+        sp = np.concatenate([pos, mirror])
+        ssid = np.concatenate([sid_all, sid_all])
+        srow = np.concatenate([rows, rows])
+
+        key = srow * 2 + side
+        order = np.argsort(key, kind="stable")
+        ssid = ssid[order]
+        st = st[order]
+        sp = sp[order]
+        touched = np.unique(key[order])
+        bounds = np.searchsorted(key[order], np.concatenate([touched,
+                                                             [key.max() + 1]]))
+        for t in range(len(touched)):
+            r, is_fwd = divmod(int(touched[t]), 2)
+            u = self.unitigs[r]
+            arr = PositionArray(ssid[bounds[t]:bounds[t + 1]],
+                                st[bounds[t]:bounds[t + 1]],
+                                sp[bounds[t]:bounds[t + 1]])
+            if is_fwd:
+                u.forward_positions = u.forward_positions.concat(arr)
             else:
-                rev.append(Position(seq_id, FORWARD, pos))
-                fwd.append(Position(seq_id, REVERSE, length - pos - ln))
-            pos += ln
-        assert pos == length, "Position calculation mismatch"
-        return Sequence.without_seq(seq_id, filename, header, length, cluster)
+                u.reverse_positions = u.reverse_positions.concat(arr)
 
     # ---------------- saving ----------------
 
@@ -269,69 +344,53 @@ class UnitigGraph:
         paths are returned directly (identical by construction — asserted
         in tests/test_models_more.py).
 
-        Entries are packed as (pos << 22 | number << 1 | strand) ints so the
-        per-position loop allocates nothing but one int, and sorting /
-        contiguity checking run in numpy."""
+        The sweep is pure array work on the per-unitig position SoAs: one
+        concatenate per field, one mask, one lexsort."""
         cache = self._paths_cache
         if cache is not None and all(sid in cache for sid in seq_ids):
             return {sid: list(cache[sid]) for sid in seq_ids}
-        max_num = max((u.number for u in self.unitigs), default=0)
-        if max_num >= (1 << 21):
-            return self._get_unitig_paths_tuples(seq_ids)
-        by_seq: Dict[int, List[int]] = {i: [] for i in set(seq_ids)}
-        by_seq_get = by_seq.get
-        for unitig in self.unitigs:
-            code_f = (unitig.number << 1) | 1
-            code_r = unitig.number << 1
-            for p in unitig.forward_positions:
-                if p.strand:
-                    lst = by_seq_get(p.seq_id)
-                    if lst is not None:
-                        lst.append((p.pos << 22) | code_f)
-            for p in unitig.reverse_positions:
-                if p.strand:
-                    lst = by_seq_get(p.seq_id)
-                    if lst is not None:
-                        lst.append((p.pos << 22) | code_r)
-        lengths = np.zeros(max_num + 1, np.int64)
-        for u in self.unitigs:
-            lengths[u.number] = len(u.forward_seq)
-        out: Dict[int, List[Tuple[int, bool]]] = {}
-        for sid, items in by_seq.items():
-            arr = np.array(items, dtype=np.int64)
-            arr.sort()
-            numbers = (arr >> 1) & ((1 << 21) - 1)
-            pos = arr >> 22
-            expected = np.zeros(len(arr), np.int64)
-            if len(arr):
-                np.cumsum(lengths[numbers[:-1]], out=expected[1:])
-            assert np.array_equal(pos, expected), "sequence path is not contiguous"
-            strands = arr & 1
-            out[sid] = list(zip(numbers.tolist(), (strands != 0).tolist()))
-        return out
-
-    def _get_unitig_paths_tuples(self, seq_ids) -> Dict[int, List[Tuple[int, bool]]]:
-        """Tuple-based fallback for unitig numbers >= 2^21 (no packing)."""
         wanted = set(seq_ids)
-        by_seq: Dict[int, List[Tuple[int, int, bool, int]]] = {i: [] for i in wanted}
-        for unitig in self.unitigs:
-            length = unitig.length()
-            for p in unitig.forward_positions:
-                if p.strand and p.seq_id in wanted:
-                    by_seq[p.seq_id].append((p.pos, unitig.number, FORWARD, length))
-            for p in unitig.reverse_positions:
-                if p.strand and p.seq_id in wanted:
-                    by_seq[p.seq_id].append((p.pos, unitig.number, REVERSE, length))
-        out: Dict[int, List[Tuple[int, bool]]] = {}
-        for sid, items in by_seq.items():
-            items.sort()
-            expected = 0
-            path = []
-            for pos, number, strand, length in items:
-                assert pos == expected, "sequence path is not contiguous"
-                path.append((number, strand))
-                expected += length
-            out[sid] = path
+        out: Dict[int, List[Tuple[int, bool]]] = {sid: [] for sid in wanted}
+        if not self.unitigs:
+            return out
+        sid = np.concatenate([a for u in self.unitigs
+                              for a in (u.forward_positions.seq_id,
+                                        u.reverse_positions.seq_id)])
+        occ_strand = np.concatenate([a for u in self.unitigs
+                                     for a in (u.forward_positions.strand,
+                                               u.reverse_positions.strand)])
+        pos = np.concatenate([a for u in self.unitigs
+                              for a in (u.forward_positions.pos,
+                                        u.reverse_positions.pos)])
+        counts = np.fromiter((c for u in self.unitigs
+                              for c in (len(u.forward_positions),
+                                        len(u.reverse_positions))),
+                             np.int64, count=2 * len(self.unitigs))
+        codes = np.fromiter((c for u in self.unitigs
+                             for c in ((u.number << 1) | 1, u.number << 1)),
+                            np.int64, count=2 * len(self.unitigs))
+        code = np.repeat(codes, counts)
+        lens = np.repeat(
+            np.fromiter((len(u.forward_seq) for u in self.unitigs),
+                        np.int64, count=len(self.unitigs)).repeat(2), counts)
+
+        m = occ_strand  # forward-strand occurrences define the path
+        sid, pos, code, lens = sid[m], pos[m], code[m], lens[m]
+        order = np.lexsort((pos, sid))
+        sid, pos, code, lens = sid[order], pos[order], code[order], lens[order]
+        starts = np.searchsorted(sid, np.unique(sid))
+        bounds = np.concatenate([starts, [len(sid)]])
+        uniq = sid[starts] if len(starts) else np.zeros(0, np.int32)
+        for i, s in enumerate(uniq.tolist()):
+            if s not in wanted:
+                continue
+            lo, hi = bounds[i], bounds[i + 1]
+            p = pos[lo:hi]
+            expected = np.zeros(hi - lo, np.int64)
+            np.cumsum(lens[lo:hi - 1], out=expected[1:])
+            assert np.array_equal(p, expected), "sequence path is not contiguous"
+            c = code[lo:hi]
+            out[s] = list(zip((c >> 1).tolist(), (c & 1).astype(bool).tolist()))
         return out
 
     def get_unitig_path_for_sequence(self, seq: Sequence) -> List[Tuple[int, bool]]:
@@ -531,9 +590,17 @@ class UnitigGraph:
     # ---------------- unitig-level surgery ----------------
 
     def remove_sequence_from_graph(self, seq_id: int) -> None:
+        self.remove_sequences_from_graph((seq_id,))
+
+    def remove_sequences_from_graph(self, seq_ids) -> None:
+        """Batched removal: one position mask per unitig strand for the whole
+        id set instead of a sweep per sequence."""
         self.invalidate_paths_cache()
+        seq_ids = np.asarray(list(seq_ids), np.int32)
+        if not len(seq_ids):
+            return
         for u in self.unitigs:
-            u.remove_sequence(seq_id)
+            u.remove_sequences(seq_ids)
 
     def recalculate_depths(self) -> None:
         for u in self.unitigs:
@@ -583,8 +650,8 @@ class UnitigGraph:
         for new_num in (a_num, b_num):
             copy = Unitig(new_num, target.forward_seq.copy(), target.reverse_seq.copy(),
                           depth=target.depth / 2.0, unitig_type=target.unitig_type)
-            copy.forward_positions = [p.copy() for p in target.forward_positions]
-            copy.reverse_positions = [p.copy() for p in target.reverse_positions]
+            copy.forward_positions = target.forward_positions.copy()
+            copy.reverse_positions = target.reverse_positions.copy()
             copies.append(copy)
         self.unitigs.extend(copies)
         self.remove_unitigs_by_number({unitig_num})

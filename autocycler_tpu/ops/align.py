@@ -90,6 +90,33 @@ def overlap_alignment(path_a: Sequence[int], path_b: Sequence[int],
     a_vals = pa
     b_vals = pb[b_glob]
 
+    # Exact no-overlap short-circuit: matches are the only positive score
+    # contribution, so if no off-diagonal (a_window, b_window) pair is equal,
+    # every right-edge score is <= 0 and the DP provably returns [] — an
+    # O(k log k) test replacing the O(k^2) matrix for the common
+    # nothing-to-trim case (most sequences in both trim passes).
+    a_win = pa[:k]
+    if len(np.intersect1d(a_win, b_vals)) == 0:
+        return []
+    if skip_diagonal:
+        # total equal pairs vs equal pairs that sit exactly on the (skipped)
+        # diagonal j == gi - (n-k) + 1, i.e. b_glob == gi
+        common, ca, cb = np.intersect1d(a_win, b_vals, return_indices=True)
+        a_sort = np.sort(a_win)
+        b_sort = np.sort(b_vals)
+        a_counts = np.searchsorted(a_sort, common, side="right") - \
+            np.searchsorted(a_sort, common, side="left")
+        b_counts = np.searchsorted(b_sort, common, side="right") - \
+            np.searchsorted(b_sort, common, side="left")
+        total_pairs = int((a_counts.astype(np.int64) * b_counts).sum())
+        # column j = gi-(n-k)+1 has global b index n-k+j-1 = gi, and is in
+        # range 1..k only for gi in [max(0, n-k), k)
+        gi_range = np.arange(max(0, n - k), k)
+        diag_pairs = int((pa[gi_range] == pb[gi_range]).sum()) \
+            if len(gi_range) else 0
+        if total_pairs == diag_pairs:
+            return []
+
     from .. import native
     matrix = None
     tb = native.overlap_dp_tb_native(pa, wa, b_vals, wcol, n, k, skip_diagonal) \

@@ -15,7 +15,7 @@ from typing import List
 
 import numpy as np
 
-from ..models import Position, Sequence, Unitig, UnitigGraph, UnitigStrand
+from ..models import PositionArray, Sequence, Unitig, UnitigGraph, UnitigStrand
 from ..utils import FORWARD, REVERSE, reverse_complement_bytes
 from .debruijn import Chains, build_chains
 from .kmers import KmerIndex, build_kmer_index
@@ -57,11 +57,11 @@ def unitig_graph_from_chains(index: KmerIndex, chains: Chains) -> UnitigGraph:
     positions = index.positions_for_kmers(
         np.concatenate([heads, rev_tails])) if C else {}
 
-    def _mk_positions(kid: int) -> List[Position]:
+    def _mk_positions(kid: int) -> PositionArray:
         seq_idx, strand, pos = positions[int(kid)]
-        ids = index.seq_ids[seq_idx]
-        return [Position(int(i), bool(s), int(p))
-                for i, s, p in zip(ids, strand, pos)]
+        return PositionArray(index.seq_ids[seq_idx].astype(np.int32),
+                             np.asarray(strand, bool),
+                             np.asarray(pos, np.int64))
 
     for c in range(C):
         unitig = Unitig(number=c + 1,

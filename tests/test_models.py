@@ -7,7 +7,7 @@ unitig.rs and unitig_graph.rs test modules, over the same fixture graphs.
 import numpy as np
 import pytest
 
-from autocycler_tpu.models import Position, Sequence, Unitig, UnitigGraph, UnitigStrand, UnitigType
+from autocycler_tpu.models import Position, PositionArray, Sequence, Unitig, UnitigGraph, UnitigStrand, UnitigType
 from autocycler_tpu.utils import AutocyclerError, FORWARD, REVERSE, reverse_complement
 
 from fixtures_gfa import (TEST_GFA_1, TEST_GFA_2, TEST_GFA_4, TEST_GFA_5, TEST_GFA_6,
@@ -98,8 +98,10 @@ def test_unitig_get_seq():
 
 def _posed_unitig():
     u = Unitig.from_segment_line("S\t1\tGCTGAAGGGC\tDP:f:1")
-    u.forward_positions = [Position(1, FORWARD, 100), Position(2, REVERSE, 200)]
-    u.reverse_positions = [Position(2, REVERSE, 890), Position(2, FORWARD, 790)]
+    u.forward_positions = PositionArray.from_list(
+        [Position(1, FORWARD, 100), Position(2, REVERSE, 200)])
+    u.reverse_positions = PositionArray.from_list(
+        [Position(2, REVERSE, 890), Position(2, FORWARD, 790)])
     return u
 
 
