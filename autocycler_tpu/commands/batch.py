@@ -31,7 +31,10 @@ from ..parallel.batch import batched_membership_intersections
 from ..parallel.mesh import make_mesh
 from ..utils import log, quit_with_error
 from .cluster import cluster as run_cluster
+from .combine import combine
 from .compress import load_sequences
+from .resolve import resolve
+from .trim import trim
 
 
 def find_isolate_dirs(parent) -> List[Path]:
@@ -93,6 +96,80 @@ def batch(assemblies_parent, out_parent, k_size: int = 51,
                     precomputed_distances=distances)
         log.message(f"{iso.name}: {len(sequences)} contigs clustered")
 
-    log.section_header("Finished!")
-    log.message(f"Per-isolate outputs: {out_parent}/<isolate>/clustering/")
+    log.section_header("Batched trim screen")
+    log.explanation("Every isolate's trim overlap DPs (start-end + both hairpin "
+                    "passes for every sequence of every QC-pass cluster) are screened "
+                    "in ONE batched device DP — the vmapped right-edge recurrence; "
+                    "only sequences the screen proves could align run the full host "
+                    "DP + traceback, so the final graphs are bitwise identical to "
+                    "sequential trim.")
+    cluster_dirs = []
+    for iso in isolates:
+        qc_pass = out_parent / iso.name / "clustering" / "qc_pass"
+        if qc_pass.is_dir():
+            cluster_dirs.extend(sorted(d for d in qc_pass.iterdir()
+                                       if d.is_dir()))
+    screens, graphs = _batched_trim_screens(cluster_dirs, mesh=mesh)
+    n_dp = sum(v for s in screens.values() for v in s.values())
+    n_all = sum(len(s) for s in screens.values())
+    log.message(f"{n_all} trim DPs screened; {n_dp} need the full host DP")
     log.message()
+
+    for cdir in cluster_dirs:
+        trim(cdir, dp_screen=screens[cdir], preloaded=graphs.pop(cdir))
+        resolve(cdir)
+        gc.collect()
+    for iso in isolates:
+        qc_pass = out_parent / iso.name / "clustering" / "qc_pass"
+        finals = sorted(qc_pass.glob("cluster_*/5_final.gfa")) \
+            if qc_pass.is_dir() else []
+        if finals:
+            combine(out_parent / iso.name, finals)
+
+    log.section_header("Finished!")
+    log.message(f"Per-isolate outputs: {out_parent}/<isolate>/clustering/ "
+                f"+ consensus_assembly.gfa/.fasta")
+    log.message()
+
+
+def _batched_trim_screens(cluster_dirs, max_unitigs: int = 5000, mesh=None):
+    """One batched screen call covering every (sequence, trim kind) of every
+    cluster; returns {cluster_dir: {(seq_id, kind): bool}}. With a mesh the
+    jobs shard over every device (parallel.batch.sharded_overlap_screen).
+    Job construction mirrors trim_path_start_end / trim_path_hairpin_*
+    (trim.rs:288-326): start_end aligns path vs itself off-diagonal,
+    hairpin_start aligns path vs its signed reverse, hairpin_end the
+    mirror."""
+    import numpy as np
+
+    from ..models import UnitigGraph
+    from ..ops.align import overlap_positive_batch
+    from ..parallel.batch import sharded_overlap_screen
+    from ..utils import reverse_signed_path
+
+    jobs, keys = [], []
+    graphs = {}
+    for cdir in cluster_dirs:
+        graph, sequences = UnitigGraph.from_gfa_file(cdir / "1_untrimmed.gfa")
+        graphs[cdir] = (graph, sequences)
+        max_num = max((u.number for u in graph.unitigs), default=0)
+        weights = np.zeros(max_num + 1, dtype=np.int64)
+        for u in graph.unitigs:
+            weights[u.number] = u.length()
+        all_paths = graph.get_unitig_paths_for_sequences(
+            [s.id for s in sequences])
+        for seq in sequences:
+            path = [n if st else -n for n, st in all_paths[seq.id]]
+            rev = reverse_signed_path(path)
+            jobs.append((path, path, weights, True))
+            keys.append((cdir, seq.id, "start_end"))
+            jobs.append((path, rev, weights, False))
+            keys.append((cdir, seq.id, "hairpin_start"))
+            jobs.append((rev, path, weights, False))
+            keys.append((cdir, seq.id, "hairpin_end"))
+    verdicts = sharded_overlap_screen(mesh, jobs, max_unitigs) \
+        if mesh is not None else overlap_positive_batch(jobs, max_unitigs)
+    screens = {cdir: {} for cdir in cluster_dirs}
+    for (cdir, seq_id, kind), v in zip(keys, verdicts):
+        screens[cdir][(seq_id, kind)] = bool(v)
+    return screens, graphs

@@ -478,31 +478,15 @@ def save_distance_matrix(distances: Dict[Tuple[int, int], float],
             f.write("\n")
 
 
-def filter_gfa_lines(gfa_lines: List[str], paths_to_remove: List[int]) -> List[str]:
-    """Drop the P-lines of other clusters (reference cluster.rs:806-821)."""
-    removed = set(paths_to_remove)
-    out = []
-    for line in gfa_lines:
-        if line.startswith("P\t"):
-            name = line.split("\t")[1]
-            try:
-                if int(name) in removed:
-                    continue
-            except ValueError:
-                pass
-        out.append(line)
-    return out
-
-
 def save_cluster_gfa(sequences: List[Sequence], cluster_num: int,
-                     gfa_lines: List[str], out_gfa) -> None:
-    """Per-cluster graph: filter P-lines, re-load, recalc depths, drop
-    zero-depth unitigs, merge linear paths (reference cluster.rs:794-822)."""
+                     graph: UnitigGraph, out_gfa) -> None:
+    """Per-cluster graph: subset the in-memory graph to the cluster's
+    sequences, recalc depths, drop zero-depth unitigs, merge linear paths
+    (reference cluster.rs:794-822, which filters P-lines and re-loads the
+    GFA text — the subset produces the identical graph without the text
+    round trip)."""
     cluster_seqs = [_clone_seq(s) for s in sequences if s.cluster == cluster_num]
-    to_remove = [s.id for s in sequences if s.cluster != cluster_num]
-    filtered = filter_gfa_lines(gfa_lines, to_remove)
-    # these lines were generated (and invariant-checked) by this process
-    cluster_graph, _ = UnitigGraph.from_gfa_lines(filtered, check=False)
+    cluster_graph = graph.subset_for_sequences([s.id for s in cluster_seqs])
     cluster_graph.recalculate_depths()
     cluster_graph.remove_zero_depth_unitigs()
     merge_linear_paths(cluster_graph, cluster_seqs)
@@ -515,7 +499,7 @@ def _clone_seq(s: Sequence) -> Sequence:
 
 
 def save_clusters(sequences: List[Sequence], qc_results: Dict[int, ClusterQC],
-                  clustering_dir, gfa_lines: List[str]) -> None:
+                  clustering_dir, graph: UnitigGraph) -> None:
     for c in range(1, get_max_cluster(sequences) + 1):
         qc = qc_results[c]
         sub = "qc_pass" if qc.passed() else "qc_fail"
@@ -533,7 +517,7 @@ def save_clusters(sequences: List[Sequence], qc_results: Dict[int, ClusterQC],
         else:
             for reason in qc.failure_reasons:
                 log.message(f"  failed QC: {reason}")
-        save_cluster_gfa(sequences, c, gfa_lines, cluster_dir / "1_untrimmed.gfa")
+        save_cluster_gfa(sequences, c, graph, cluster_dir / "1_untrimmed.gfa")
         UntrimmedClusterMetrics.new(lengths, qc.cluster_dist).save_to_yaml(
             cluster_dir / "1_untrimmed.yaml")
         log.message()
@@ -614,7 +598,7 @@ def cluster(autocycler_dir, cutoff: float = 0.2, min_assemblies: Optional[int] =
 
     qc_results = generate_clusters(tree, sequences, asym, cutoff, min_asm,
                                    manual_clusters)
-    save_clusters(sequences, qc_results, clustering_dir, gfa_lines)
+    save_clusters(sequences, qc_results, clustering_dir, graph)
     save_data_to_tsv(sequences, qc_results, clustering_dir / "clustering.tsv")
     clustering_metrics(sequences, qc_results).save_to_yaml(
         clustering_dir / "clustering.yaml")

@@ -16,6 +16,7 @@ holds the brute-force Pallas grid kernel used for benchmarking).
 from __future__ import annotations
 
 import os
+import sys
 from pathlib import Path
 from typing import Dict, List, Optional, Tuple
 
@@ -316,6 +317,21 @@ def create_dotplot(seqs, png_filename, res: int, kmer: int,
 
 
 def _find_font():
+    """Scalable label font, checked in order (reference dotplot.rs:26
+    embeds DejaVuSans; here discovery spans the usual homes so labels scale
+    with or without matplotlib installed):
+    1. AUTOCYCLER_DOTPLOT_FONT (any .ttf/.otf path),
+    2. matplotlib's bundled DejaVuSans,
+    3. standard fontconfig directories (DejaVu/Liberation/Noto/FreeSans),
+    4. `fc-match` if fontconfig's CLI is available.
+    Falls back to PIL's bitmap font with a stderr note (labels then cannot
+    scale)."""
+    override = os.environ.get("AUTOCYCLER_DOTPLOT_FONT")
+    if override:
+        if Path(override).is_file():
+            return override
+        print(f"autocycler: AUTOCYCLER_DOTPLOT_FONT={override} not found; "
+              "continuing with discovery", file=sys.stderr)
     try:
         import matplotlib
         path = Path(matplotlib.get_data_path()) / "fonts" / "ttf" / "DejaVuSans.ttf"
@@ -323,6 +339,29 @@ def _find_font():
             return str(path)
     except Exception:
         pass
+    for candidate in (
+            "/usr/share/fonts/truetype/dejavu/DejaVuSans.ttf",
+            "/usr/share/fonts/dejavu/DejaVuSans.ttf",
+            "/usr/share/fonts/TTF/DejaVuSans.ttf",
+            "/usr/share/fonts/truetype/liberation/LiberationSans-Regular.ttf",
+            "/usr/share/fonts/truetype/noto/NotoSans-Regular.ttf",
+            "/usr/share/fonts/truetype/freefont/FreeSans.ttf",
+            "/System/Library/Fonts/Helvetica.ttc",
+            "C:/Windows/Fonts/arial.ttf"):
+        if Path(candidate).is_file():
+            return candidate
+    try:
+        import subprocess
+        out = subprocess.run(["fc-match", "-f", "%{file}", "sans"],
+                             capture_output=True, text=True, timeout=10)
+        path = out.stdout.strip()
+        if out.returncode == 0 and path and Path(path).is_file():
+            return path
+    except Exception:
+        pass
+    print("autocycler: no scalable font found — dotplot labels will use "
+          "PIL's fixed-size bitmap font (set AUTOCYCLER_DOTPLOT_FONT to fix)",
+          file=sys.stderr)
     return None
 
 

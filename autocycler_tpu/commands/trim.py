@@ -27,7 +27,17 @@ TrimResult = Optional[Tuple[List[int], int]]
 
 
 def trim(cluster_dir, min_identity: float = 0.75, max_unitigs: int = 5000,
-         mad: float = 5.0, threads: int = 1) -> None:
+         mad: float = 5.0, threads: int = 1, dp_screen=None,
+         preloaded=None) -> None:
+    """dp_screen: optional {(seq_id, kind): bool} where kind is 'start_end',
+    'hairpin_start' or 'hairpin_end' — False means a batched exact screen
+    (ops.align.overlap_positive_batch) proved that DP returns no alignment,
+    so it is skipped. `autocycler batch` screens every isolate's DPs in one
+    device dispatch and passes the verdicts here; results are bitwise
+    identical to an unscreened run.
+    preloaded: optional (graph, sequences) already parsed from
+    1_untrimmed.gfa (batch parses it for screen-job construction and hands
+    it over instead of re-reading the file)."""
     cluster_dir = Path(cluster_dir)
     untrimmed_gfa = cluster_dir / "1_untrimmed.gfa"
     trimmed_gfa = cluster_dir / "2_trimmed.gfa"
@@ -47,7 +57,8 @@ def trim(cluster_dir, min_identity: float = 0.75, max_unitigs: int = 5000,
                     "cluster) and trims any overlaps. It looks for both start-end overlaps "
                     "(can occur with circular sequences) and hairpin overlaps (can occur "
                     "with linear sequences).")
-    graph, sequences = UnitigGraph.from_gfa_file(untrimmed_gfa)
+    graph, sequences = preloaded if preloaded is not None else \
+        UnitigGraph.from_gfa_file(untrimmed_gfa)
     graph.print_basic_graph_info()
     # dense number -> length array: scalar indexing works like the dict and
     # the alignment kernels can gather whole paths in one vector op
@@ -61,9 +72,10 @@ def trim(cluster_dir, min_identity: float = 0.75, max_unitigs: int = 5000,
     all_paths = graph.get_unitig_paths_for_sequences([s.id for s in sequences]) \
         if max_unitigs else {}
     start_end = trim_start_end_overlap(graph, sequences, weights, min_identity,
-                                       max_unitigs, all_paths, threads)
+                                       max_unitigs, all_paths, threads,
+                                       dp_screen)
     hairpin = trim_hairpin_overlap(graph, sequences, weights, min_identity,
-                                   max_unitigs, all_paths, threads)
+                                   max_unitigs, all_paths, threads, dp_screen)
     sequences = choose_trim_type(start_end, hairpin, graph, sequences)
     sequences = exclude_outliers_in_length(graph, sequences, mad)
     clean_up_graph(graph, sequences)
@@ -77,7 +89,7 @@ def trim(cluster_dir, min_identity: float = 0.75, max_unitigs: int = 5000,
 def trim_start_end_overlap(graph: UnitigGraph, sequences: List[Sequence],
                            weights: Weights, min_identity: float,
                            max_unitigs: int, all_paths=None,
-                           threads: int = 1) -> List[TrimResult]:
+                           threads: int = 1, dp_screen=None) -> List[TrimResult]:
     """Per-sequence circular start-end trimming (reference trim.rs:113-136).
     A max_unitigs of 0 disables trimming."""
     if max_unitigs == 0:
@@ -86,6 +98,8 @@ def trim_start_end_overlap(graph: UnitigGraph, sequences: List[Sequence],
         all_paths = graph.get_unitig_paths_for_sequences([s.id for s in sequences])
 
     def one(seq: Sequence) -> TrimResult:
+        if dp_screen is not None and not dp_screen.get((seq.id, "start_end"), True):
+            return None
         path = [n if s else -n for n, s in all_paths[seq.id]]
         trimmed = trim_path_start_end(path, weights, min_identity, max_unitigs)
         if trimmed is None:
@@ -107,22 +121,31 @@ def trim_start_end_overlap(graph: UnitigGraph, sequences: List[Sequence],
 def trim_hairpin_overlap(graph: UnitigGraph, sequences: List[Sequence],
                          weights: Weights, min_identity: float,
                          max_unitigs: int, all_paths=None,
-                         threads: int = 1) -> List[TrimResult]:
+                         threads: int = 1, dp_screen=None) -> List[TrimResult]:
     """Per-sequence hairpin trimming at both path ends (reference trim.rs:139-186)."""
     if max_unitigs == 0:
         return [None] * len(sequences)
     if all_paths is None:
         all_paths = graph.get_unitig_paths_for_sequences([s.id for s in sequences])
 
+    def screened_out(seq_id: int, kind: str) -> bool:
+        return dp_screen is not None and not dp_screen.get((seq_id, kind), True)
+
     def one(seq: Sequence):
         path = [n if s else -n for n, s in all_paths[seq.id]]
         trimmed_start = trimmed_end = False
-        p2 = trim_path_hairpin_start(path, weights, min_identity, max_unitigs)
+        p2 = None if screened_out(seq.id, "hairpin_start") else \
+            trim_path_hairpin_start(path, weights, min_identity, max_unitigs)
         if p2 is not None:
             trimmed_start = True
         else:
             p2 = list(path)
-        p3 = trim_path_hairpin_end(p2, weights, min_identity, max_unitigs)
+        # the hairpin_end screen was computed on the ORIGINAL path; it only
+        # applies when hairpin_start left the path unchanged
+        if not trimmed_start and screened_out(seq.id, "hairpin_end"):
+            p3 = None
+        else:
+            p3 = trim_path_hairpin_end(p2, weights, min_identity, max_unitigs)
         if p3 is not None:
             trimmed_end = True
         else:

@@ -106,6 +106,14 @@ class PositionArray:
         return PositionArray(self.seq_id[keep], self.strand[keep],
                              self.pos[keep])
 
+    def only_seq_ids(self, seq_ids: np.ndarray) -> "PositionArray":
+        """Copy holding only occurrences of the given (int32 ndarray) ids.
+        Always copies, so the result mutates independently of this array."""
+        if not len(self.seq_id):
+            return PositionArray()
+        m = np.isin(self.seq_id, seq_ids)
+        return PositionArray(self.seq_id[m], self.strand[m], self.pos[m])
+
     def concat(self, other: "PositionArray") -> "PositionArray":
         if not len(other):
             return self

@@ -177,18 +177,6 @@ class UnitigGraph:
         self._paths_cache = paths_cache
         return sequences
 
-    def create_sequence_and_positions(self, seq_id: int, length: int, filename: str,
-                                      header: str, cluster: int,
-                                      forward_path: List[Tuple[int, bool]]) -> Sequence:
-        """Register a sequence's path through the graph by stamping positions
-        onto each traversed unitig, both strands (reference
-        unitig_graph.rs:151-174). Single-path wrapper over
-        :meth:`stamp_paths_batch`."""
-        numbers = np.array([n for n, _ in forward_path], np.int64)
-        strands = np.array([s for _, s in forward_path], bool)
-        self.stamp_paths_batch([(seq_id, length, numbers, strands)])
-        return Sequence.without_seq(seq_id, filename, header, length, cluster)
-
     def stamp_paths_batch(self, entries) -> None:
         """Stamp many sequence paths in one vectorised pass. ``entries`` is a
         list of (seq_id, length, numbers int64[], strands bool[]).
@@ -706,6 +694,38 @@ class UnitigGraph:
             self.unitigs = [x for x in self.unitigs if x.number != u.number]
             self.delete_dangling_links()
             self.build_index()
+
+    def subset_for_sequences(self, keep_ids) -> "UnitigGraph":
+        """Independent copy of the graph restricted to the given sequence
+        ids: unitigs keep (copied) positions of only those sequences, links
+        are rewired onto the new Unitig objects, sequence byte arrays are
+        shared (all mutation paths rebind rather than write in place).
+        Replaces the reference's filter-P-lines-and-reload flow
+        (cluster.rs:794-822) without the GFA round trip; the caller then
+        recalculates depths / drops zero-depth unitigs exactly as after a
+        reload."""
+        keep = np.asarray(sorted(set(keep_ids)), np.int32)
+        g = UnitigGraph(self.k_size)
+        mapping: Dict[int, Unitig] = {}
+        for u in self.unitigs:
+            nu = Unitig(u.number, u.forward_seq, u._reverse_seq,
+                        depth=u.depth, unitig_type=u.unitig_type)
+            nu.forward_positions = u.forward_positions.only_seq_ids(keep)
+            nu.reverse_positions = u.reverse_positions.only_seq_ids(keep)
+            mapping[u.number] = nu
+            g.unitigs.append(nu)
+        for u in self.unitigs:
+            nu = mapping[u.number]
+            nu.forward_next = [UnitigStrand(mapping[l.number], l.strand)
+                               for l in u.forward_next]
+            nu.forward_prev = [UnitigStrand(mapping[l.number], l.strand)
+                               for l in u.forward_prev]
+            nu.reverse_next = [UnitigStrand(mapping[l.number], l.strand)
+                               for l in u.reverse_next]
+            nu.reverse_prev = [UnitigStrand(mapping[l.number], l.strand)
+                               for l in u.reverse_prev]
+        g.build_index()
+        return g
 
     # ---------------- components ----------------
 

@@ -101,7 +101,7 @@ def overlap_alignment(path_a: Sequence[int], path_b: Sequence[int],
     if skip_diagonal:
         # total equal pairs vs equal pairs that sit exactly on the (skipped)
         # diagonal j == gi - (n-k) + 1, i.e. b_glob == gi
-        common, ca, cb = np.intersect1d(a_win, b_vals, return_indices=True)
+        common = np.intersect1d(a_win, b_vals)
         a_sort = np.sort(a_win)
         b_sort = np.sort(b_vals)
         a_counts = np.searchsorted(a_sort, common, side="right") - \
@@ -194,6 +194,149 @@ def overlap_alignment(path_a: Sequence[int], path_b: Sequence[int],
     if mean_length == 0 or matches / mean_length < min_identity:
         return []
     return pieces
+
+
+def _overlap_windows(path_a, path_b, weights, max_unitigs: int):
+    """Shared window extraction for the overlap DP (trim.rs:366-386)."""
+    n = len(path_a)
+    k = min(max_unitigs, n)
+    pa = np.asarray(path_a, dtype=np.int64)
+    pb = np.asarray(path_b, dtype=np.int64)
+    wa = weights[np.abs(pa[:k])].astype(np.int64)
+    b_glob = n - k + np.arange(k)
+    b_vals = pb[b_glob]
+    wcol = weights[np.abs(b_vals)].astype(np.int64)
+    return n, k, pa[:k], b_vals, wa, wcol
+
+
+_NEG_BIG = -(1 << 28)  # worse than any true score (>= -2*total_length)
+
+
+def pack_overlap_jobs(jobs, max_unitigs: int, pad_to: int = 1):
+    """Pack (path_a, path_b, weights, skip_diagonal) jobs into the padded
+    int32 arrays :func:`overlap_screen_scores` consumes. P is padded up to a
+    multiple of ``pad_to`` (for sharding); padded rows have k=0 and always
+    screen negative. A/B padding is 0, which only ever "matches" at
+    columns/rows the kernel masks out (path values are nonzero signed ints).
+    Returns (arrays dict, P_real) or None when there is nothing to run."""
+    P = len(jobs)
+    prepared = [_overlap_windows(pa, pb, w, max_unitigs)
+                for pa, pb, w, _ in jobs]
+    K = max((k for _, k, *_ in prepared), default=0)
+    if P == 0 or K == 0:
+        return None
+    Pp = -(-P // pad_to) * pad_to
+    A = np.zeros((Pp, K), np.int64)
+    B = np.zeros((Pp, K), np.int64)
+    WA = np.zeros((Pp, K), np.int64)
+    WC = np.zeros((Pp, K), np.int64)
+    k_arr = np.zeros(Pp, np.int64)
+    n_arr = np.zeros(Pp, np.int64)
+    skip_arr = np.zeros(Pp, bool)
+    for p, ((_, _, _, skip), (n, k, a, b, wa, wcol)) in enumerate(
+            zip(jobs, prepared)):
+        A[p, :k] = a
+        B[p, :k] = b
+        WA[p, :k] = wa
+        WC[p, :k] = wcol
+        k_arr[p] = k
+        n_arr[p] = n
+        skip_arr[p] = skip
+    if np.abs(A).max(initial=0) >= 2**31 or np.abs(B).max(initial=0) >= 2**31:
+        raise ValueError("path values exceed int32 range")
+    Wcum2 = np.zeros((Pp, K + 1), np.int64)
+    np.cumsum(2 * WC, axis=1, out=Wcum2[:, 1:])
+    valid_col = np.arange(1, K + 1)[None, :] <= k_arr[:, None]
+    return {
+        "A": A.astype(np.int32), "B": B.astype(np.int32),
+        "WA": WA.astype(np.int32), "WC": WC.astype(np.int32),
+        "Wc2": Wcum2.astype(np.int32), "k": k_arr.astype(np.int32),
+        "jd_off": (n_arr - k_arr).astype(np.int32), "skip": skip_arr,
+        "vcol": valid_col,
+    }, P
+
+
+def overlap_screen_scores(arrs):
+    """Pure-jnp kernel: packed job arrays -> doubled best right-edge score
+    per job ([P] int32). The vmapped form of the single overlap DP — the
+    same recurrence, one lax.scan over rows, scores doubled so everything is
+    integer and exact in int32; values clamp at a sentinel far below any
+    reachable score, which cannot change any comparison against 0. Jittable
+    and shard_map-able along axis 0 (jobs are independent)."""
+    import jax
+    import jax.numpy as jnp
+
+    A32, Bd = arrs["A"], arrs["B"]
+    WAd, WCd, Wc2 = arrs["WA"], arrs["WC"], arrs["Wc2"]
+    k_j, jd_off, skip_j, vcol = arrs["k"], arrs["jd_off"], arrs["skip"], arrs["vcol"]
+    P, K = A32.shape
+
+    def seg_cummax(X, boundary):
+        """Segmented running max along axis 1: positions where boundary is
+        True start a new segment."""
+        def op(l, r):
+            lb, lv = l
+            rb, rv = r
+            return lb | rb, jnp.where(rb, rv, jnp.maximum(lv, rv))
+        _, out = jax.lax.associative_scan(op, (boundary, X), axis=1)
+        return out
+
+    idx = jnp.arange(K + 1)[None, :]             # X index = column number
+
+    def step(carry, i):
+        prev, best = carry
+        gi = jnp.minimum(i - 1, K - 1)
+        active = i <= k_j
+        wi = WAd[:, gi][:, None]
+        a_col = A32[:, gi][:, None]
+        match2 = jnp.where((a_col == Bd) & vcol, 2 * wi, -(wi + WCd))
+        base = jnp.maximum(prev[:, :-1] + match2, prev[:, 1:] - 2 * wi)
+        base = jnp.where(vcol, base, _NEG_BIG)
+        X = jnp.concatenate(
+            [jnp.zeros((P, 1), jnp.int32), base + Wc2[:, 1:]], axis=1)
+        jd = jnp.where(skip_j, i - jd_off, 0)[:, None]       # 0 = no reset
+        in_range = (jd >= 1) & (jd <= k_j[:, None])          # [P, 1]
+        boundary = in_range & ((idx == jd) | (idx == jd + 1))
+        X = jnp.where(boundary & (idx == jd), _NEG_BIG, X)
+        run = seg_cummax(X, boundary)
+        row = run - Wc2
+        row = jnp.where(in_range & (idx == jd), _NEG_BIG, row)
+        row = row.at[:, 0].set(0)
+        row = jnp.maximum(row, _NEG_BIG)
+        row = jnp.where(active[:, None], row, prev)
+        edge = jnp.take_along_axis(row, k_j[:, None].astype(jnp.int32),
+                                   axis=1)[:, 0]
+        best = jnp.maximum(best, jnp.where(active, edge, _NEG_BIG))
+        return (row, best), None
+
+    # initial carry derived from the inputs (k_j * 0) so that under
+    # shard_map it carries the same varying-manual-axes type as the body's
+    # outputs (a plain zeros() is unvarying and scan rejects the mismatch)
+    zero_row = (k_j * 0)[:, None]
+    prev0 = jnp.zeros((P, K + 1), jnp.int32) + zero_row   # row 0: all zeros
+    best0 = jnp.full(P, _NEG_BIG, jnp.int32) + zero_row[:, 0]
+    (_, best), _ = jax.lax.scan(step, (prev0, best0),
+                                jnp.arange(1, K + 1, dtype=jnp.int32))
+    return best
+
+
+def overlap_positive_batch(jobs, max_unitigs: int) -> np.ndarray:
+    """Batched exact screen for :func:`overlap_alignment`: for each job
+    (path_a, path_b, weights, skip_diagonal), does the overlap DP reach a
+    POSITIVE right-edge score?
+
+    Used by `autocycler batch` to screen MANY isolates' trim DPs in one
+    device dispatch; jobs screened False provably return [] from
+    overlap_alignment, jobs screened True run the full host DP + traceback.
+    """
+    import jax
+
+    packed = pack_overlap_jobs(jobs, max_unitigs)
+    if packed is None:
+        return np.zeros(len(jobs), bool)
+    arrs, P = packed
+    best = np.asarray(jax.jit(overlap_screen_scores)(arrs))
+    return best[:P] > 0
 
 
 def find_midpoint(alignment: List[AlignmentPiece], weights: Weights) -> int:

@@ -55,12 +55,21 @@ def _pad_to(words: np.ndarray, tile: int, fill: int) -> np.ndarray:
     return out
 
 
-def _grid_kernel(a_ref, b_ref, out_ref):
+def _grid_kernel(n_a, n_b, tile_a, tile_b, a_ref, b_ref, out_ref):
+    import jax
     import jax.numpy as jnp
+    from jax.experimental import pallas as pl
 
     eq = a_ref[0, :].reshape(-1, 1) == b_ref[0, :].reshape(1, -1)
     for w in range(1, a_ref.shape[0]):
         eq &= a_ref[w, :].reshape(-1, 1) == b_ref[w, :].reshape(1, -1)
+    # mask tile padding by global index: 2-bit packing has no out-of-band
+    # fill value (an all-T k-mer word is -1, colliding with any constant)
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    row = jax.lax.broadcasted_iota(jnp.int32, (tile_a, 1), 0) + i * tile_a
+    col = jax.lax.broadcasted_iota(jnp.int32, (1, tile_b), 1) + j * tile_b
+    eq &= (row < n_a) & (col < n_b)
     # Each program owns one (8, 128) output tile with the count broadcast
     # across it, strided back out afterwards. Mosaic rejects smaller output
     # blocks — (1, 1), including in SMEM space, fails its divisible-by-
@@ -69,7 +78,6 @@ def _grid_kernel(a_ref, b_ref, out_ref):
     out_ref[:, :] = jnp.broadcast_to(eq.sum(dtype=jnp.int32), out_ref.shape)
 
 
-@functools.partial(lambda f: f)
 def match_grid(a_words: np.ndarray, b_words: np.ndarray,
                tile_a: int = TILE_A, tile_b: int = TILE_B):
     """[W, nA] × [W, nB] k-mer words -> [ceil(nA/tile), ceil(nB/tile)] match
@@ -78,11 +86,9 @@ def match_grid(a_words: np.ndarray, b_words: np.ndarray,
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
     W, n_a = a_words.shape
     _, n_b = b_words.shape
-    # pad A with -1 and B with -2 so padding never matches anything
     a_pad = _pad_to(a_words, tile_a, -1)
     b_pad = _pad_to(b_words, tile_b, -2)
     ga = a_pad.shape[1] // tile_a
@@ -90,7 +96,7 @@ def match_grid(a_words: np.ndarray, b_words: np.ndarray,
 
     interpret = jax.default_backend() != "tpu"
     tiles = pl.pallas_call(
-        _grid_kernel,
+        functools.partial(_grid_kernel, n_a, n_b, tile_a, tile_b),
         grid=(ga, gb),
         in_specs=[
             pl.BlockSpec((W, tile_a), lambda i, j: (0, i)),
@@ -101,6 +107,99 @@ def match_grid(a_words: np.ndarray, b_words: np.ndarray,
         interpret=interpret,
     )(jnp.asarray(a_pad), jnp.asarray(b_pad))
     return tiles[::8, ::128]
+
+
+TILE_MXU = 1024
+
+
+def expand_onehot_words(words, k: int, n_valid: int = None):
+    """Device-side one-hot expansion: [W, n] packed int32 words ->
+    [n, 4k] int8 where column 4*p + c is 1 iff base p of the k-mer is c.
+    Two k-mers are equal iff their one-hot rows dot to k — the equality
+    test becomes an int8 matmul, which is MXU work instead of VPU compares.
+
+    Rows at index >= n_valid are zeroed: a zero row dots to 0 < k against
+    anything, so tile padding can NEVER register a match (2-bit packing has
+    no out-of-band sentinel — every int32 is a real all-base word)."""
+    import jax.numpy as jnp
+
+    W, n = words.shape
+    wd = jnp.asarray(words)
+    cols = []
+    for p in range(k):
+        w, t = divmod(p, 16)
+        cols.append((wd[w] >> (2 * (15 - t))) & 3)  # base t at bits 2*(15-t)
+    base = jnp.stack(cols, axis=1)                      # [n, k] values 0..3
+    oh = (base[:, :, None] == jnp.arange(4, dtype=base.dtype)).astype(jnp.int8)
+    oh = oh.reshape(n, 4 * k)
+    if n_valid is not None and n_valid < n:
+        oh = oh * (jnp.arange(n)[:, None] < n_valid).astype(jnp.int8)
+    return oh
+
+
+def _mxu_kernel(k_val, a_ref, b_ref, out_ref):
+    import jax
+    import jax.numpy as jnp
+
+    # bf16 everywhere: one-hot products are 0/1 and row dots are <= k <= 128,
+    # all exactly representable in bf16 (7 explicit mantissa bits cover
+    # integers to 256), so the half-width M matrix halves the VMEM traffic
+    # that bounds this kernel while staying exact
+    m = jax.lax.dot_general(a_ref[:, :].astype(jnp.bfloat16),
+                            b_ref[:, :].astype(jnp.bfloat16),
+                            (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.bfloat16)
+    count = jnp.sum((m == k_val).astype(jnp.float32)).astype(jnp.int32)
+    out_ref[:, :] = jnp.broadcast_to(count, out_ref.shape)
+
+
+def match_grid_mxu(a_words: np.ndarray, b_words: np.ndarray, k: int,
+                   tile: int = TILE_MXU, tile_a: int = None,
+                   tile_b: int = None):
+    """MXU formulation of :func:`match_grid`: one-hot rows are expanded on
+    device and each program contracts a [tile_a, 4k] x [tile_b, 4k] pair on
+    the MXU. Arithmetic is bf16 in, bf16 out: products are 0/1 and row dots
+    are <= k, integers which bf16 represents exactly up to 256 — hence the
+    k <= 256 guard below (k <= 55 in practice, main.rs flag range). A cell
+    matches iff its base-match count equals k. Output matches match_grid's
+    tile counts; asymmetric tiles amortise per-program overhead."""
+    import functools as ft
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    if k > 256:
+        raise ValueError("match_grid_mxu requires k <= 256 (bf16-exact "
+                         "match counts)")
+    tile_a = tile if tile_a is None else tile_a
+    tile_b = tile if tile_b is None else tile_b
+    W, n_a = a_words.shape
+    _, n_b = b_words.shape
+    a_pad = _pad_to(a_words, tile_a, -1)
+    b_pad = _pad_to(b_words, tile_b, -2)
+    ga = a_pad.shape[1] // tile_a
+    gb = b_pad.shape[1] // tile_b
+    D = 4 * k
+
+    @jax.jit
+    def run(a_w, b_w):
+        a_oh = expand_onehot_words(a_w, k, n_valid=n_a)
+        b_oh = expand_onehot_words(b_w, k, n_valid=n_b)
+        tiles = pl.pallas_call(
+            ft.partial(_mxu_kernel, k),
+            grid=(ga, gb),
+            in_specs=[
+                pl.BlockSpec((tile_a, D), lambda i, j: (i, 0)),
+                pl.BlockSpec((tile_b, D), lambda i, j: (j, 0)),
+            ],
+            out_specs=pl.BlockSpec((8, 128), lambda i, j: (i, j)),
+            out_shape=jax.ShapeDtypeStruct((ga * 8, gb * 128), jnp.int32),
+            interpret=jax.default_backend() != "tpu",
+        )(a_oh, b_oh)
+        return tiles[::8, ::128]
+
+    return run(jnp.asarray(a_pad), jnp.asarray(b_pad))
 
 
 def match_grid_reference(a_words: np.ndarray, b_words: np.ndarray,
@@ -124,8 +223,9 @@ def match_grid_reference(a_words: np.ndarray, b_words: np.ndarray,
 
 def benchmark_gcells(n_a: int = 524288, n_b: int = 524288, k: int = 32,
                      repeats: int = 3, tile: int = 2048,
-                     seed: int = 0) -> Tuple[float, float]:
+                     seed: int = 0, kernel: str = "vpu") -> Tuple[float, float]:
     """Time the match grid; returns (best seconds, Gcells/s).
+    kernel="vpu" is the word-compare kernel, "mxu" the one-hot matmul.
 
     Honest-measurement rules for remote-execution backends: every trial uses
     freshly generated inputs (identical requests can be deduplicated
@@ -143,7 +243,11 @@ def benchmark_gcells(n_a: int = 524288, n_b: int = 524288, k: int = 32,
         return pack_2bit_words(rng.integers(1, 5, size=n + k - 1).astype(np.uint8), k)
 
     def run(a_w, b_w):
-        return np.asarray(jnp.sum(match_grid(a_w, b_w, tile_a=tile, tile_b=tile)))
+        if kernel == "mxu":
+            grid = match_grid_mxu(a_w, b_w, k, tile=tile)
+        else:
+            grid = match_grid(a_w, b_w, tile_a=tile, tile_b=tile)
+        return np.asarray(jnp.sum(grid))
 
     run(fresh_words(n_a), fresh_words(n_b))  # compile + warm up
     best = float("inf")

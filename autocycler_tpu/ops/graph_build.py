@@ -53,23 +53,33 @@ def unitig_graph_from_chains(index: KmerIndex, chains: Chains) -> UnitigGraph:
     depths = (np.add.reduceat(index.depth[members_all].astype(np.float64),
                               chain_off[:-1]) / sizes) if C else np.zeros(0)
 
-    # batched position query for every chain head and reverse-complement tail
-    positions = index.positions_for_kmers(
-        np.concatenate([heads, rev_tails])) if C else {}
-
-    def _mk_positions(kid: int) -> PositionArray:
-        seq_idx, strand, pos = positions[int(kid)]
-        return PositionArray(index.seq_ids[seq_idx].astype(np.int32),
-                             np.asarray(strand, bool),
-                             np.asarray(pos, np.int64))
-
-    for c in range(C):
-        unitig = Unitig(number=c + 1,
-                        forward_seq=seq_bytes[chain_off[c]:chain_off[c + 1]].copy())
-        unitig.depth = float(depths[c])
-        unitig.forward_positions = _mk_positions(heads[c])
-        unitig.reverse_positions = _mk_positions(rev_tails[c])
-        graph.unitigs.append(unitig)
+    # batched position query for every chain head and reverse-complement
+    # tail, in flat SoA form: per-chain PositionArrays are views into the
+    # query result, and sequences are views into the chain byte block — the
+    # construction loop allocates only the Unitig shells
+    if C:
+        uniq, offs, seq_idx_f, strand_f, pos_f = index.positions_for_kmers_flat(
+            np.concatenate([heads, rev_tails]))
+        seqid_f = index.seq_ids[seq_idx_f].astype(np.int32, copy=False)
+        strand_f = np.asarray(strand_f, bool)
+        pos_f = np.asarray(pos_f, np.int64)
+        h_at = np.searchsorted(uniq, heads)
+        r_at = np.searchsorted(uniq, rev_tails)
+        h_lo, h_hi = offs[h_at], offs[h_at + 1]
+        r_lo, r_hi = offs[r_at], offs[r_at + 1]
+        depths_list = depths.tolist()
+        unitigs = graph.unitigs
+        for c in range(C):
+            unitig = Unitig(number=c + 1,
+                            forward_seq=seq_bytes[chain_off[c]:chain_off[c + 1]])
+            unitig.depth = depths_list[c]
+            unitig.forward_positions = PositionArray(
+                seqid_f[h_lo[c]:h_hi[c]], strand_f[h_lo[c]:h_hi[c]],
+                pos_f[h_lo[c]:h_hi[c]])
+            unitig.reverse_positions = PositionArray(
+                seqid_f[r_lo[c]:r_hi[c]], strand_f[r_lo[c]:r_hi[c]],
+                pos_f[r_lo[c]:r_hi[c]])
+            unitigs.append(unitig)
 
     fwd_start_gram = index.prefix_gid[heads].astype(np.int64)
     fwd_end_gram = index.suffix_gid[tails].astype(np.int64)

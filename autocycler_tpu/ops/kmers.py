@@ -204,13 +204,31 @@ class KmerIndex:
         """{kid: (seq_idx, strand(bool), pos)} for every requested k-mer, in
         occurrence order (seq ascending; forward windows before reverse
         windows within a sequence; position ascending)."""
+        uniq, offsets, seq_idx, strand, pos = self.positions_for_kmers_flat(kids)
+        return {int(kid): (seq_idx[offsets[i]:offsets[i + 1]],
+                           strand[offsets[i]:offsets[i + 1]],
+                           pos[offsets[i]:offsets[i + 1]])
+                for i, kid in enumerate(uniq)}
+
+    def positions_for_kmers_flat(self, kids: np.ndarray):
+        """Flat form of :meth:`positions_for_kmers` for bulk consumers:
+        (uniq_kids, offsets, seq_idx, strand, pos) where kid ``uniq_kids[i]``
+        owns rows ``offsets[i]:offsets[i+1]`` of the three parallel arrays
+        (same per-kid occurrence order as the dict form)."""
         kids = np.unique(np.asarray(kids, dtype=np.int64))
         if self.occ_sorted is not None:
-            out = {}
-            for kid in kids:
-                occ = self.kmer_occurrences(int(kid))
-                out[int(kid)] = self.occ_coords(occ)
-            return out
+            per_kid = [self.occ_coords(self.kmer_occurrences(int(kid)))
+                       for kid in kids]
+            counts = np.array([len(t[0]) for t in per_kid], np.int64)
+            offsets = np.zeros(len(kids) + 1, np.int64)
+            np.cumsum(counts, out=offsets[1:])
+            if len(per_kid):
+                return (kids, offsets,
+                        np.concatenate([t[0] for t in per_kid]),
+                        np.concatenate([t[1] for t in per_kid]),
+                        np.concatenate([t[2] for t in per_kid]))
+            empty = np.zeros(0, np.int64)
+            return kids, offsets, empty, empty.astype(bool), empty
 
         # fused backend: one scan over the forward-window ids. A forward
         # window of group g is a forward occurrence of g AND the mirror of a
@@ -242,11 +260,10 @@ class KmerIndex:
             [q[m_fwd], self.seq_len[seq_idx[m_rev]] - 1 - q[m_rev]])
         order = np.lexsort((pos_all, ~strand_all, seq_all, kid_all))
         kid_sorted = kid_all[order]
-        lo = np.searchsorted(kid_sorted, kids, side="left")
-        hi = np.searchsorted(kid_sorted, kids, side="right")
-        return {int(kid): (seq_all[order[a:b]], strand_all[order[a:b]],
-                           pos_all[order[a:b]])
-                for kid, a, b in zip(kids, lo, hi)}
+        offsets = np.searchsorted(kid_sorted, np.concatenate([kids, [U]]))
+        offsets[-1] = len(kid_sorted)
+        return (kids, offsets, seq_all[order], strand_all[order],
+                pos_all[order])
 
     @property
     def num_kmers(self) -> int:

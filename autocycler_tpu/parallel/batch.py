@@ -217,3 +217,33 @@ def batched_membership_intersections(mesh, M_list: List[np.ndarray],
         m, w = M_list[i], w_list[i]
         out[i] = (m.astype(np.int64) * w[None, :]) @ m.astype(np.int64).T
     return out
+
+
+# ---------------------------------------------------------------------------
+# Sharded trim-DP screen (batch's trim stage on the mesh)
+# ---------------------------------------------------------------------------
+
+def sharded_overlap_screen(mesh, jobs, max_unitigs: int) -> np.ndarray:
+    """The batched trim overlap-DP screen (ops.align.overlap_screen_scores)
+    sharded over EVERY device of the mesh: DP jobs are independent, so they
+    ride a flattened ('data', 'seq') axis — pure data parallelism, no
+    collectives. Bit-identical to the single-device screen (integer DP).
+
+    Returns the bool verdicts for `jobs` (padding rows dropped)."""
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.align import overlap_screen_scores, pack_overlap_jobs
+
+    n_dev = mesh.devices.size
+    packed = pack_overlap_jobs(jobs, max_unitigs, pad_to=n_dev)
+    if packed is None:
+        return np.zeros(len(jobs), bool)
+    arrs, n_real = packed
+    spec = {k: P(("data", "seq")) if v.ndim == 1 else P(("data", "seq"), None)
+            for k, v in arrs.items()}
+    step = shard_map(overlap_screen_scores, mesh=mesh,
+                     in_specs=(spec,), out_specs=P(("data", "seq")))
+    best = np.asarray(jax.jit(step)(arrs))
+    return best[:n_real] > 0

@@ -707,6 +707,10 @@ static int64_t occ_index_build_impl(const uint8_t* codes, int64_t n_codes,
     for (int32_t i = 1; i < k; ++i) pow5k1 *= 5;
 
     // ---- phase A: hash forward windows (rolling base-5 keys) ----
+    // NOTE: presizing the table from n_f (e.g. n_f/8) to skip the doubling
+    // rehashes was measured SLOWER (6.5-7.2s vs 6.1-6.2s phase A on the
+    // headline input) — the smaller grown table's footprint wins, same
+    // pattern as the round-1 entry-size finding.
     Table table;
     if (!table.init(1 << 15)) return -1;
     std::vector<u128> keys;                // per provisional gid

@@ -29,3 +29,41 @@ def test_match_grid_matches_reference():
     assert got.shape == expected.shape
     assert (got == expected).all()
     assert expected.sum() >= 280  # the 300-base shared chunk -> 280 k-mer matches
+
+
+def test_match_grid_mxu_matches_reference():
+    """The one-hot MXU formulation must agree with the numpy oracle,
+    including on partial edge tiles."""
+    from autocycler_tpu.ops.dotplot_pallas import match_grid_mxu
+
+    rng = np.random.default_rng(7)
+    k = 32
+    codes_a = rng.integers(1, 5, size=500 + k - 1).astype(np.uint8)
+    codes_b = np.concatenate([codes_a[50:350],
+                              rng.integers(1, 5, size=200 + k - 1).astype(np.uint8)])
+    a_words = pack_2bit_words(codes_a, k)
+    b_words = pack_2bit_words(codes_b, k)
+    got = np.asarray(match_grid_mxu(a_words, b_words, k, tile=256))
+    expected = match_grid_reference(a_words, b_words, tile_a=256, tile_b=256)
+    assert got.shape == expected.shape
+    assert (got == expected).all()
+    assert expected.sum() >= 250
+
+
+def test_padding_cannot_match_all_t():
+    """An all-T k-mer packs to -1 — identical to the old pad fill. Partial
+    edge tiles must still count only real cells (both kernels)."""
+    from autocycler_tpu.ops.dotplot_pallas import match_grid_mxu
+
+    k = 16
+    n = 100  # not a multiple of the tile -> padded edge tile
+    codes_a = np.full(n + k - 1, 4, dtype=np.uint8)  # poly-T
+    codes_b = np.full(n + k - 1, 4, dtype=np.uint8)
+    a_words = pack_2bit_words(codes_a, k)
+    b_words = pack_2bit_words(codes_b, k)
+    expected = match_grid_reference(a_words, b_words, tile_a=128, tile_b=128)
+    assert expected[0, 0] == n * n  # every real cell matches...
+    got_vpu = np.asarray(match_grid(a_words, b_words, tile_a=128, tile_b=128))
+    got_mxu = np.asarray(match_grid_mxu(a_words, b_words, k, tile=128))
+    assert (got_vpu == expected).all()  # ...and padding adds nothing
+    assert (got_mxu == expected).all()

@@ -125,6 +125,29 @@ def test_batch_command_bitwise_matches_cluster(tmp_path):
         passes = list((out / iso / "clustering" / "qc_pass").glob(
             "cluster_*/1_untrimmed.gfa"))
         assert passes, iso
+        # ... and batch continued through trim + resolve + combine
+        for p in passes:
+            assert (p.parent / "2_trimmed.gfa").is_file(), iso
+            assert (p.parent / "5_final.gfa").is_file(), iso
+        assert (out / iso / "consensus_assembly.fasta").is_file(), iso
+
+    # screened batch trim/resolve output is BITWISE identical to the
+    # sequential unscreened pipeline on the same cluster inputs
+    import shutil
+
+    from autocycler_tpu.commands.resolve import resolve as run_resolve
+    from autocycler_tpu.commands.trim import trim as run_trim
+    for i in (0, 34):
+        iso = f"iso_{i:03d}"
+        for cdir in sorted((out / iso / "clustering" / "qc_pass").glob("cluster_*")):
+            ref_dir = tmp_path / "seq_ref" / iso / cdir.name
+            ref_dir.mkdir(parents=True)
+            shutil.copy(cdir / "1_untrimmed.gfa", ref_dir / "1_untrimmed.gfa")
+            run_trim(ref_dir)
+            run_resolve(ref_dir)
+            for name in ("2_trimmed.gfa", "5_final.gfa"):
+                assert (cdir / name).read_bytes() == \
+                    (ref_dir / name).read_bytes(), (iso, cdir.name, name)
 
     # integer-level: the sharded device contraction equals the host matmul
     # exactly (distances divide these by the diagonal with the same float
