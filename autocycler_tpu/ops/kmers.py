@@ -21,14 +21,31 @@ when present); small inputs fall back to numpy to skip dispatch overhead.
 
 from __future__ import annotations
 
+import functools
+import os
+
 from dataclasses import dataclass
-from typing import List, Optional, Tuple
+from typing import List, Optional, Tuple, Union
 
 import numpy as np
 
 from .encode import encode_bytes
 
 SYMS_PER_WORD = 10  # 3 bits per symbol in an int32
+
+# use_jax accepts True (direct device sort), "bucketed" (fixed-shape,
+# persistently-cacheable device sort), False, or None (resolve via env)
+UseJax = Union[bool, str, None]
+
+
+def _resolve_use_jax(use_jax: UseJax) -> UseJax:
+    """None resolves through AUTOCYCLER_DEVICE_GROUPING: a truthy value
+    (anything but '', '0', 'false', 'no') opts into the bucketed device
+    sort; otherwise the native/host default stays."""
+    if use_jax is not None:
+        return use_jax
+    value = os.environ.get("AUTOCYCLER_DEVICE_GROUPING", "").strip().lower()
+    return "bucketed" if value not in ("", "0", "false", "no") else False
 
 
 def _num_words(k: int) -> int:
@@ -61,31 +78,90 @@ def _pack_and_rank_numpy(codes: np.ndarray, starts: np.ndarray, k: int):
     return order, gid_sorted
 
 
-def _pack_and_rank_jax(codes: np.ndarray, starts: np.ndarray, k: int):
+def _rank_windows_traced(codes_d, starts_d, k: int, real=None):
+    """Traced pack + lexsort + group-id body shared by the direct and
+    bucketed jax paths. ``real`` (optional bool mask) forces pad windows'
+    words to int32 max so they sort after every real window (3-bit packing
+    never sets the top bit, so the value is out of band)."""
     import jax.numpy as jnp
 
-    codes_d = jnp.asarray(codes)
-    starts_d = jnp.asarray(starts.astype(np.int32))
+    n = starts_d.shape[0]
     words = []
     for j in range(_num_words(k)):
-        w = jnp.zeros(len(starts), dtype=jnp.int32)
+        w = jnp.zeros(n, dtype=jnp.int32)
         for t in range(SYMS_PER_WORD):
             idx = j * SYMS_PER_WORD + t
             w = w << 3
             if idx < k:
                 w = w | codes_d[starts_d + idx].astype(jnp.int32)
+        if real is not None:
+            w = jnp.where(real, w, jnp.int32(2**31 - 1))
         words.append(w)
     order = jnp.lexsort(tuple(reversed(words)))
     sorted_words = [w[order] for w in words]
-    new_group = jnp.zeros(len(starts), dtype=bool).at[0].set(True)
+    new_group = jnp.zeros(n, dtype=bool).at[0].set(True)
     for w in sorted_words:
         new_group = new_group.at[1:].set(new_group[1:] | (w[1:] != w[:-1]))
     gid_sorted = jnp.cumsum(new_group) - 1
+    return order, gid_sorted
+
+
+def _pack_and_rank_jax(codes: np.ndarray, starts: np.ndarray, k: int):
+    import jax.numpy as jnp
+
+    order, gid_sorted = _rank_windows_traced(
+        jnp.asarray(codes), jnp.asarray(starts.astype(np.int32)), k)
     return np.asarray(order), np.asarray(gid_sorted)
 
 
+def _bucket_size(n: int, floor: int = 1 << 16) -> int:
+    """Fixed padded sizes so the expensive device sort compiles once per
+    bucket into the persistent cache (XLA's variadic sort costs minutes to
+    compile per shape on the current platform): powers of 4 from 64k."""
+    b = floor
+    while b < n:
+        b <<= 2
+    return b
+
+
+@functools.lru_cache(maxsize=None)
+def _bucketed_rank_fn(bucket: int, codes_bucket: int, kk: int):
+    """One compiled (window-bucket, codes-bucket, k) sort executable. The
+    real window count is a traced argument, so every input size within the
+    bucket reuses the same executable (and the persistent compilation cache
+    serves it across processes)."""
+    import jax
+    import jax.numpy as jnp
+
+    def run(codes_d, starts_d, n_real):
+        real = jnp.arange(bucket) < n_real
+        return _rank_windows_traced(codes_d, starts_d, kk, real=real)
+
+    return jax.jit(run)
+
+
+def _pack_and_rank_jax_bucketed(codes: np.ndarray, starts: np.ndarray, k: int):
+    """Fixed-shape variant of :func:`_pack_and_rank_jax`: windows AND codes
+    are padded to bucket sizes so device sorts compile once per bucket; pad
+    windows sort to the end, leaving the real windows' (order, gid) results
+    unchanged (pad entries are sliced away before returning)."""
+    import jax.numpy as jnp
+
+    n = len(starts)
+    b = _bucket_size(n)
+    cb = _bucket_size(len(codes))
+    pad_starts = np.zeros(b, np.int64)
+    pad_starts[:n] = starts
+    pad_codes = np.zeros(cb, codes.dtype)
+    pad_codes[:len(codes)] = codes
+    order, gid_sorted = _bucketed_rank_fn(b, cb, k)(
+        jnp.asarray(pad_codes), jnp.asarray(pad_starts.astype(np.int32)),
+        jnp.int32(n))
+    return np.asarray(order)[:n], np.asarray(gid_sorted)[:n]
+
+
 def group_windows_full(codes: np.ndarray, starts: np.ndarray, k: int,
-                       use_jax: Optional[bool] = None
+                       use_jax: UseJax = None
                        ) -> Tuple[np.ndarray, np.ndarray]:
     """Group length-k windows of ``codes`` beginning at ``starts``.
 
@@ -100,14 +176,17 @@ def group_windows_full(codes: np.ndarray, starts: np.ndarray, k: int,
     if k == 0:
         # zero-length windows are all identical (k=1's (k-1)-grams)
         return np.zeros(n, np.int64), np.arange(n, dtype=np.int64)
-    if use_jax is None:
-        # XLA's variadic sort has multi-minute compile times on the current
-        # TPU platform, so the device path is opt-in; the native hash
-        # grouping below is the fast default at every scale.
-        use_jax = False
+    # XLA's variadic sort has multi-minute compile times on the current
+    # TPU platform, so the device path is opt-in (AUTOCYCLER_DEVICE_GROUPING
+    # or use_jax="bucketed" for the fixed-shape persistently-cached
+    # variant); the native hash grouping below is the fast default.
+    use_jax = _resolve_use_jax(use_jax)
     if use_jax:
         try:
-            order, gid_sorted = _pack_and_rank_jax(codes, starts, k)
+            if use_jax == "bucketed":
+                order, gid_sorted = _pack_and_rank_jax_bucketed(codes, starts, k)
+            else:
+                order, gid_sorted = _pack_and_rank_jax(codes, starts, k)
             gid = np.empty(n, np.int64)
             gid[order] = gid_sorted
             return gid, order
@@ -132,7 +211,7 @@ def group_windows_full(codes: np.ndarray, starts: np.ndarray, k: int,
 
 
 def group_windows(codes: np.ndarray, starts: np.ndarray, k: int,
-                  use_jax: Optional[bool] = None) -> Tuple[np.ndarray, np.ndarray]:
+                  use_jax: UseJax = None) -> Tuple[np.ndarray, np.ndarray]:
     """(order, gid_sorted) view of :func:`group_windows_full` — ``order`` is
     the stable permutation sorting windows lexicographically and
     ``gid_sorted[i]`` the group id of window ``order[i]``."""
@@ -284,7 +363,7 @@ def _adjacency(prefix_gid: np.ndarray, suffix_gid: np.ndarray, G: int):
     return out_count, in_count, succ
 
 
-def build_kmer_index(sequences, k: int, use_jax: Optional[bool] = None,
+def build_kmer_index(sequences, k: int, use_jax: UseJax = None,
                      use_fused: Optional[bool] = None) -> KmerIndex:
     """Build the k-mer index from Sequence objects (padded, with bytes).
 
@@ -321,8 +400,9 @@ def build_kmer_index(sequences, k: int, use_jax: Optional[bool] = None,
         occ_off[1:] = np.cumsum(2 * seq_len)[:-1]
     M = int(2 * seq_len.sum())
 
+    use_jax = _resolve_use_jax(use_jax)
     if use_fused is None:
-        use_fused = use_jax is not True
+        use_fused = not use_jax
     from .. import native
     if use_fused and M and native.available():
         # the kernel translates ASCII -> symbols inline; no encode pass

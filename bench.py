@@ -94,7 +94,9 @@ def bench_headline() -> None:
 
 
 def bench_dotplot() -> None:
-    """TPU showcase: Pallas brute-force k-mer match grid vs single-core host."""
+    """TPU showcase: Pallas brute-force k-mer match grid vs single-core
+    host. Both device kernels are measured — the VPU word-compare grid and
+    the MXU one-hot-matmul grid — and the better rate is the headline."""
     import numpy as np
 
     from autocycler_tpu.ops.dotplot_pallas import (benchmark_gcells,
@@ -103,7 +105,9 @@ def bench_dotplot() -> None:
 
     k = 32
     n = 524288  # a full all-vs-all plasmid-cluster grid: 512k x 512k k-mers
-    _, tpu_rate = benchmark_gcells(n_a=n, n_b=n, k=k, repeats=5)
+    _, vpu_rate = benchmark_gcells(n_a=n, n_b=n, k=k, repeats=5, kernel="vpu")
+    _, mxu_rate = benchmark_gcells(n_a=n, n_b=n, k=k, repeats=5, kernel="mxu")
+    tpu_rate = max(vpu_rate, mxu_rate)
 
     rng = np.random.default_rng(1)
     m = 16384
@@ -118,6 +122,8 @@ def bench_dotplot() -> None:
         "value": round(tpu_rate, 2),
         "unit": "Gcells/s",
         "vs_baseline": round(tpu_rate / host_rate, 2),
+        "vpu_gcells": round(vpu_rate, 2),
+        "mxu_gcells": round(mxu_rate, 2),
     }))
 
 

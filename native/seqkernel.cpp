@@ -958,6 +958,9 @@ static int64_t occ_index_build_impl(const uint8_t* codes, int64_t n_codes,
             fwd_cnt.assign(U, 0);
             state->depth.resize(U);
         } catch (...) { return -1; }
+        // NOTE: prefetching lex_rank[gf[i+24]] ahead of this loop measured
+        // no improvement (1.55-1.76s either way on the headline input) —
+        // the dependent fwd_cnt increment still serialises on the miss.
         int32_t* gf = out_fwd_gid;
         for (int64_t i = 0; i < n_f; ++i) {
             const int32_t r = lex_rank[gf[i]];

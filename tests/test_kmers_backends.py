@@ -129,3 +129,13 @@ def test_fused_index_fuzz_vs_fallback():
         for kid in pa:
             for x, y in zip(pa[kid], pb[kid]):
                 assert np.array_equal(np.asarray(x), np.asarray(y)), (trial, kid)
+
+
+def test_bucketed_device_grouping_matches():
+    """The fixed-shape (persistently-cacheable) device grouping must return
+    exactly the unbucketed results for every input size in a bucket."""
+    for n_windows in (100, 1000, 2500):
+        codes, starts, k = _case(5, n_windows=n_windows)
+        exp = group_windows(codes, starts, k, use_jax=False)
+        got = group_windows(codes, starts, k, use_jax="bucketed")
+        assert (got[0] == exp[0]).all() and (got[1] == exp[1]).all()
