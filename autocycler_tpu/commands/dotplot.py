@@ -318,12 +318,13 @@ def create_dotplot(seqs, png_filename, res: int, kmer: int,
 
 def _find_font():
     """Scalable label font, checked in order (reference dotplot.rs:26
-    embeds DejaVuSans; here discovery spans the usual homes so labels scale
-    with or without matplotlib installed):
+    embeds DejaVuSans; this package bundles the same free font so labels
+    always scale):
     1. AUTOCYCLER_DOTPLOT_FONT (any .ttf/.otf path),
-    2. matplotlib's bundled DejaVuSans,
-    3. standard fontconfig directories (DejaVu/Liberation/Noto/FreeSans),
-    4. `fc-match` if fontconfig's CLI is available.
+    2. the bundled DejaVuSans (autocycler_tpu/assets/),
+    3. matplotlib's bundled DejaVuSans,
+    4. standard fontconfig directories (DejaVu/Liberation/Noto/FreeSans),
+    5. `fc-match` if fontconfig's CLI is available.
     Falls back to PIL's bitmap font with a stderr note (labels then cannot
     scale)."""
     override = os.environ.get("AUTOCYCLER_DOTPLOT_FONT")
@@ -332,6 +333,9 @@ def _find_font():
             return override
         print(f"autocycler: AUTOCYCLER_DOTPLOT_FONT={override} not found; "
               "continuing with discovery", file=sys.stderr)
+    bundled = Path(__file__).resolve().parent.parent / "assets" / "DejaVuSans.ttf"
+    if bundled.is_file():
+        return str(bundled)
     try:
         import matplotlib
         path = Path(matplotlib.get_data_path()) / "fonts" / "ttf" / "DejaVuSans.ttf"

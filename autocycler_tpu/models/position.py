@@ -92,27 +92,49 @@ class PositionArray:
         if len(self.pos):
             self.pos += amount
 
-    def without_seq_ids(self, seq_ids) -> "PositionArray":
+    def without_seq_ids(self, seq_ids, lut: np.ndarray = None
+                        ) -> "PositionArray":
         """Occurrences not belonging to any of the given sequence ids
         (reference unitig.rs:250-257). Pass an int32 ndarray when calling in
-        a loop — it goes through without conversion."""
+        a loop — it goes through without conversion — or a ``seq_id_lut``
+        for the one-gather fast path."""
         if not len(self.seq_id):
             return self
-        if not isinstance(seq_ids, np.ndarray):
-            seq_ids = np.asarray(list(seq_ids), np.int32)
-        keep = ~np.isin(self.seq_id, seq_ids)
+        if lut is not None:
+            keep = ~lut[self.seq_id]
+        else:
+            if not isinstance(seq_ids, np.ndarray):
+                seq_ids = np.asarray(list(seq_ids), np.int32)
+            keep = ~np.isin(self.seq_id, seq_ids)
         if keep.all():
             return self
         return PositionArray(self.seq_id[keep], self.strand[keep],
                              self.pos[keep])
 
-    def only_seq_ids(self, seq_ids: np.ndarray) -> "PositionArray":
+    def only_seq_ids(self, seq_ids: np.ndarray, lut: np.ndarray = None
+                     ) -> "PositionArray":
         """Copy holding only occurrences of the given (int32 ndarray) ids.
-        Always copies, so the result mutates independently of this array."""
+        Always copies, so the result mutates independently of this array.
+        ``lut`` (bool array indexed by seq id) skips the per-call set
+        machinery — callers filtering many position lists against the same
+        id set (one LUT gather per list vs np.isin's sort per call) should
+        build it once with :func:`seq_id_lut`."""
         if not len(self.seq_id):
             return PositionArray()
-        m = np.isin(self.seq_id, seq_ids)
+        m = lut[self.seq_id] if lut is not None else np.isin(self.seq_id, seq_ids)
         return PositionArray(self.seq_id[m], self.strand[m], self.pos[m])
+
+    @staticmethod
+    def seq_id_lut(seq_ids) -> np.ndarray:
+        """Bool LUT (indexed by seq id) for :meth:`only_seq_ids` /
+        :meth:`without_seq_ids` loops. Sized to the full sequence-id space
+        (ids are capped at 32767, compress.rs:112-114) so indexing with ANY
+        stored seq id is in range regardless of the filter set."""
+        ids = np.asarray(list(seq_ids) if not isinstance(seq_ids, np.ndarray)
+                         else seq_ids, np.int64)
+        lut = np.zeros(MAX_SEQ_ID + 1, bool)
+        lut[ids] = True
+        return lut
 
     def concat(self, other: "PositionArray") -> "PositionArray":
         if not len(other):

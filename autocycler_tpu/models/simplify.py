@@ -39,20 +39,59 @@ def simplify_structure(graph: UnitigGraph, seqs: List[Sequence]) -> None:
     untouched), so the sets are invariant across iterations — the reference
     recomputes them each sweep with the same result."""
     fixed = get_fixed_unitig_starts_and_ends(graph, seqs)
-    while expand_repeats(graph, seqs, fixed) > 0:
-        pass
+    candidates = None  # first sweep visits everything
+    while True:
+        shifted, affected = _expand_repeats_pass(graph, seqs, fixed, candidates)
+        if shifted == 0:
+            break
+        candidates = affected
     graph.renumber_unitigs()
 
 
 def expand_repeats(graph: UnitigGraph, seqs: List[Sequence], fixed=None) -> int:
-    """One sweep of repeat expansion; returns total bases shifted
+    """One full sweep of repeat expansion; returns total bases shifted
     (reference graph_simplification.rs:43-86)."""
     if fixed is None:
         fixed = get_fixed_unitig_starts_and_ends(graph, seqs)
+    return _expand_repeats_pass(graph, seqs, fixed, None)[0]
+
+
+def _expand_repeats_pass(graph: UnitigGraph, seqs: List[Sequence], fixed,
+                         candidates) -> Tuple[int, Set[int]]:
+    """One sweep in graph order; returns (bases shifted, the running
+    ``affected`` set — every unitig a shift touched plus its immediate
+    neighbours).
+
+    ``candidates`` (None = visit all) restricts the sweep: a unitig is
+    visited when it is in ``candidates`` OR already in ``affected`` (a shift
+    EARLIER IN THIS SWEEP touched its neighbourhood). This reproduces the
+    reference's re-sweep-everything fixpoint (graph_simplification.rs:33-39)
+    exactly: a unitig's outcome depends only on its own seq/positions and
+    its sources' (all within one link), so a skipped unitig — one no shift
+    has touched since it last evaluated to 0 — would evaluate to 0 again,
+    and every potentially non-zero unitig is visited at the same position
+    in the same sweep as the reference's full sweep would visit it (units
+    enabled mid-sweep by an earlier shift enter ``affected`` immediately;
+    units before the enabling shift are re-visited next sweep, when the
+    reference also re-visits them)."""
     fixed_starts, fixed_ends = fixed
     total_shifted = 0
+    affected: Set[int] = set()
+
+    def note_shift(centre: int, sources) -> None:
+        touched = [centre] + [s.number for s in sources]
+        affected.update(touched)
+        for n in touched:
+            u = graph.index[n]
+            for links in (u.forward_next, u.forward_prev,
+                          u.reverse_next, u.reverse_prev):
+                affected.update(l.number for l in links)
+
     for unitig in graph.unitigs:
         number = unitig.number
+        if (candidates is not None and number not in candidates
+                and number not in affected):
+            continue
         inputs = get_exclusive_inputs(unitig)
         if len(inputs) >= 2 and number not in fixed_starts:
             can_shift = all(
@@ -60,7 +99,10 @@ def expand_repeats(graph: UnitigGraph, seqs: List[Sequence], fixed=None) -> int:
                      or not inp.strand and inp.number in fixed_starts)
                 for inp in inputs)
             if can_shift:
-                total_shifted += _shift_seq_into_start(inputs, unitig)
+                amount = _shift_seq_into_start(inputs, unitig)
+                if amount:
+                    total_shifted += amount
+                    note_shift(number, inputs)
         outputs = get_exclusive_outputs(unitig)
         if len(outputs) >= 2 and number not in fixed_ends:
             can_shift = all(
@@ -68,8 +110,11 @@ def expand_repeats(graph: UnitigGraph, seqs: List[Sequence], fixed=None) -> int:
                      or not out.strand and out.number in fixed_ends)
                 for out in outputs)
             if can_shift:
-                total_shifted += _shift_seq_into_end(unitig, outputs)
-    return total_shifted
+                amount = _shift_seq_into_end(unitig, outputs)
+                if amount:
+                    total_shifted += amount
+                    note_shift(number, outputs)
+    return total_shifted, affected
 
 
 def _shift_seq_into_start(sources: List[UnitigStrand], destination: Unitig) -> int:

@@ -204,11 +204,11 @@ class Unitig:
         (unitig.rs:250-257)."""
         self.remove_sequences((seq_id,))
 
-    def remove_sequences(self, seq_ids) -> None:
+    def remove_sequences(self, seq_ids, lut=None) -> None:
         """Batch form of :meth:`remove_sequence` — one mask per strand for
         the whole id set."""
-        self.forward_positions = self.forward_positions.without_seq_ids(seq_ids)
-        self.reverse_positions = self.reverse_positions.without_seq_ids(seq_ids)
+        self.forward_positions = self.forward_positions.without_seq_ids(seq_ids, lut)
+        self.reverse_positions = self.reverse_positions.without_seq_ids(seq_ids, lut)
         assert len(self.forward_positions) == len(self.reverse_positions)
         self.recalculate_depth()
 

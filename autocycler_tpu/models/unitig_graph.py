@@ -20,7 +20,7 @@ from typing import Dict, List, Tuple
 import numpy as np
 
 from ..utils import FORWARD, REVERSE, load_file_lines, quit_with_error
-from .position import Position, PositionArray
+from .position import MAX_SEQ_ID, Position, PositionArray
 from .sequence import Sequence
 from .unitig import Unitig, UnitigStrand
 
@@ -155,6 +155,10 @@ class UnitigGraph:
         paths_cache = {}
         for parts in path_lines:
             seq_id = int(parts[1])
+            if not 0 <= seq_id <= MAX_SEQ_ID:
+                quit_with_error(f"P-line sequence id {seq_id} outside the "
+                                f"supported range 0..{MAX_SEQ_ID} (15-bit "
+                                "id space, reference position.rs:21)")
             length = filename = header = None
             cluster = 0
             for p in parts[2:]:
@@ -587,8 +591,9 @@ class UnitigGraph:
         seq_ids = np.asarray(list(seq_ids), np.int32)
         if not len(seq_ids):
             return
+        lut = PositionArray.seq_id_lut(seq_ids)
         for u in self.unitigs:
-            u.remove_sequences(seq_ids)
+            u.remove_sequences(seq_ids, lut)
 
     def recalculate_depths(self) -> None:
         for u in self.unitigs:
@@ -705,13 +710,14 @@ class UnitigGraph:
         recalculates depths / drops zero-depth unitigs exactly as after a
         reload."""
         keep = np.asarray(sorted(set(keep_ids)), np.int32)
+        lut = PositionArray.seq_id_lut(keep)
         g = UnitigGraph(self.k_size)
         mapping: Dict[int, Unitig] = {}
         for u in self.unitigs:
             nu = Unitig(u.number, u.forward_seq, u._reverse_seq,
                         depth=u.depth, unitig_type=u.unitig_type)
-            nu.forward_positions = u.forward_positions.only_seq_ids(keep)
-            nu.reverse_positions = u.reverse_positions.only_seq_ids(keep)
+            nu.forward_positions = u.forward_positions.only_seq_ids(keep, lut)
+            nu.reverse_positions = u.reverse_positions.only_seq_ids(keep, lut)
             mapping[u.number] = nu
             g.unitigs.append(nu)
         for u in self.unitigs:

@@ -39,13 +39,25 @@ UseJax = Union[bool, str, None]
 
 
 def _resolve_use_jax(use_jax: UseJax) -> UseJax:
-    """None resolves through AUTOCYCLER_DEVICE_GROUPING: a truthy value
-    (anything but '', '0', 'false', 'no') opts into the bucketed device
-    sort; otherwise the native/host default stays."""
+    """None resolves through AUTOCYCLER_DEVICE_GROUPING: an explicit enable
+    value opts into the device sort ('direct' = per-shape jit, anything else
+    truthy = the bucketed persistently-cached variant); explicit disable
+    spellings and '' keep the native/host default. Unrecognised values keep
+    the default too, with a stderr note — guessing an operator's intent the
+    expensive way ('off' enabling a ~170 s/sort tunnel path) is worse than
+    ignoring a typo."""
     if use_jax is not None:
         return use_jax
     value = os.environ.get("AUTOCYCLER_DEVICE_GROUPING", "").strip().lower()
-    return "bucketed" if value not in ("", "0", "false", "no") else False
+    if value in ("1", "true", "yes", "on", "bucketed"):
+        return "bucketed"
+    if value == "direct":
+        return True
+    if value not in ("", "0", "false", "no", "off", "disabled"):
+        import sys
+        print(f"autocycler: unrecognised AUTOCYCLER_DEVICE_GROUPING="
+              f"{value!r}; keeping the host grouping default", file=sys.stderr)
+    return False
 
 
 def _num_words(k: int) -> int:

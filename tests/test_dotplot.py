@@ -76,3 +76,16 @@ def test_device_grid_falls_back_on_non_acgt():
     from autocycler_tpu.commands.dotplot import kmer_match_positions_device
     seq = b("ACGTNNNNACGTACGTACGT")
     assert kmer_match_positions_device(seq, seq, 10) is None
+
+
+def test_bundled_font_is_found_first(monkeypatch):
+    """The package vendors DejaVuSans (reference dotplot.rs:26 embeds the
+    same font), so label scaling never depends on matplotlib being
+    installed."""
+    from autocycler_tpu.commands import dotplot as dp
+    monkeypatch.delenv("AUTOCYCLER_DOTPLOT_FONT", raising=False)
+    path = dp._find_font()
+    assert path is not None and path.endswith("DejaVuSans.ttf")
+    assert "autocycler_tpu" in path  # the bundled copy, not a system one
+    from PIL import ImageFont
+    assert ImageFont.truetype(path, 24).getlength("cluster_001") > 0

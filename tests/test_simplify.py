@@ -139,3 +139,52 @@ def test_merge_linear_paths_4():
     assert len(graph.unitigs) == 13
     merge_linear_paths(graph, seqs)
     assert len(graph.unitigs) == 11
+
+
+def test_worklist_fixpoint_matches_full_sweeps():
+    """simplify_structure's candidate-restricted sweeps must produce exactly
+    the state the reference's re-sweep-everything fixpoint produces
+    (graph_simplification.rs:33-39), including on randomized graphs where
+    shifts enable further shifts mid-sweep."""
+    import random
+    from autocycler_tpu.models.sequence import Sequence
+    from autocycler_tpu.ops.graph_build import build_unitig_graph
+    from autocycler_tpu.models.simplify import (
+        expand_repeats, get_fixed_unitig_starts_and_ends, simplify_structure)
+
+    for seed in range(6):
+        rng = random.Random(seed)
+        k = rng.choice([5, 9, 13])
+        seqs = []
+        base = "".join(rng.choice("ACGT") for _ in range(rng.randint(60, 400)))
+        for i in range(rng.randint(2, 5)):
+            s = list(base)
+            for _ in range(rng.randint(0, 6)):   # mutations create branches
+                s[rng.randrange(len(s))] = rng.choice("ACGT")
+            seqs.append(Sequence.with_seq(i + 1, "".join(s), "f.fasta",
+                                          f"s{i}", k // 2))
+        g1 = build_unitig_graph(seqs, k)
+        g2 = build_unitig_graph(seqs, k)
+
+        simplify_structure(g1, seqs)            # worklist fixpoint
+        fixed = get_fixed_unitig_starts_and_ends(g2, seqs)
+        while expand_repeats(g2, seqs, fixed) > 0:   # full sweeps
+            pass
+        g2.renumber_unitigs()
+
+        s1 = [(u.number, u.forward_seq.tobytes()) for u in g1.unitigs]
+        s2 = [(u.number, u.forward_seq.tobytes()) for u in g2.unitigs]
+        assert s1 == s2, seed
+
+
+def test_pline_seq_id_out_of_range_rejected():
+    from fixtures_gfa import TEST_GFA_14
+    lines = TEST_GFA_14.splitlines()
+    bad = [l.replace("P\t2\t", "P\t40000\t", 1) if l.startswith("P\t2\t")
+           else l for l in lines]
+    assert bad != lines
+    import pytest
+    from autocycler_tpu.models import UnitigGraph
+    from autocycler_tpu.utils.misc import AutocyclerError
+    with pytest.raises(AutocyclerError, match="outside the supported range"):
+        UnitigGraph.from_gfa_lines(bad)
