@@ -60,22 +60,41 @@ def _grid_kernel(n_a, n_b, tile_a, tile_b, a_ref, b_ref, out_ref):
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
-    eq = a_ref[0, :].reshape(-1, 1) == b_ref[0, :].reshape(1, -1)
-    for w in range(1, a_ref.shape[0]):
-        eq &= a_ref[w, :].reshape(-1, 1) == b_ref[w, :].reshape(1, -1)
-    # mask tile padding by global index: 2-bit packing has no out-of-band
-    # fill value (an all-T k-mer word is -1, colliding with any constant)
     i = pl.program_id(0)
     j = pl.program_id(1)
-    row = jax.lax.broadcasted_iota(jnp.int32, (tile_a, 1), 0) + i * tile_a
-    col = jax.lax.broadcasted_iota(jnp.int32, (1, tile_b), 1) + j * tile_b
-    eq &= (row < n_a) & (col < n_b)
-    # Each program owns one (8, 128) output tile with the count broadcast
-    # across it, strided back out afterwards. Mosaic rejects smaller output
-    # blocks — (1, 1), including in SMEM space, fails its divisible-by-
-    # (8, 128) store constraint — so the 1024x output padding is the price
-    # of scalar-per-program results.
-    out_ref[:, :] = jnp.broadcast_to(eq.sum(dtype=jnp.int32), out_ref.shape)
+
+    def count(masked):
+        eq = a_ref[0, :].reshape(-1, 1) == b_ref[0, :].reshape(1, -1)
+        for w in range(1, a_ref.shape[0]):
+            eq &= a_ref[w, :].reshape(-1, 1) == b_ref[w, :].reshape(1, -1)
+        if masked:
+            # mask tile padding by global index: 2-bit packing has no
+            # out-of-band fill value (an all-T k-mer word is -1, colliding
+            # with any constant)
+            row = (jax.lax.broadcasted_iota(jnp.int32, (tile_a, 1), 0)
+                   + i * tile_a)
+            col = (jax.lax.broadcasted_iota(jnp.int32, (1, tile_b), 1)
+                   + j * tile_b)
+            eq &= (row < n_a) & (col < n_b)
+        # Each program owns one (8, 128) output tile with the count
+        # broadcast across it, strided back out afterwards. Mosaic rejects
+        # smaller output blocks — (1, 1), including in SMEM space, fails its
+        # divisible-by-(8, 128) store constraint — so the 1024x output
+        # padding is the price of scalar-per-program results.
+        return jnp.broadcast_to(eq.sum(dtype=jnp.int32), out_ref.shape)
+
+    # Only the last tile row/column can contain padding; interior programs
+    # skip the two iota compares + and per cell (measured 315 -> 459
+    # Gcells/s at 512k^2 on v5e with 2048x4096 tiles).
+    interior = ((i + 1) * tile_a <= n_a) & ((j + 1) * tile_b <= n_b)
+
+    @pl.when(interior)
+    def _():
+        out_ref[:, :] = count(False)
+
+    @pl.when(~interior)
+    def _():
+        out_ref[:, :] = count(True)
 
 
 def match_grid(a_words: np.ndarray, b_words: np.ndarray,
@@ -141,14 +160,15 @@ def _mxu_kernel(k_val, a_ref, b_ref, out_ref):
     import jax
     import jax.numpy as jnp
 
-    # bf16 everywhere: one-hot products are 0/1 and row dots are <= k <= 128,
-    # all exactly representable in bf16 (7 explicit mantissa bits cover
-    # integers to 256), so the half-width M matrix halves the VMEM traffic
-    # that bounds this kernel while staying exact
+    # bf16 inputs, f32 accumulation: one-hot products are 0/1 and row dots
+    # are <= k, exact in f32 trivially. Mosaic REQUIRES a 32-bit matmul
+    # accumulator ('Expected matmul acc to be 32-bit' — a bf16
+    # preferred_element_type compiles under interpret mode but fails
+    # verification on the chip), so the M tile is materialised at 4 B/cell.
     m = jax.lax.dot_general(a_ref[:, :].astype(jnp.bfloat16),
                             b_ref[:, :].astype(jnp.bfloat16),
                             (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.bfloat16)
+                            preferred_element_type=jnp.float32)
     count = jnp.sum((m == k_val).astype(jnp.float32)).astype(jnp.int32)
     out_ref[:, :] = jnp.broadcast_to(count, out_ref.shape)
 
@@ -158,11 +178,19 @@ def match_grid_mxu(a_words: np.ndarray, b_words: np.ndarray, k: int,
                    tile_b: int = None):
     """MXU formulation of :func:`match_grid`: one-hot rows are expanded on
     device and each program contracts a [tile_a, 4k] x [tile_b, 4k] pair on
-    the MXU. Arithmetic is bf16 in, bf16 out: products are 0/1 and row dots
-    are <= k, integers which bf16 represents exactly up to 256 — hence the
-    k <= 256 guard below (k <= 55 in practice, main.rs flag range). A cell
-    matches iff its base-match count equals k. Output matches match_grid's
-    tile counts; asymmetric tiles amortise per-program overhead."""
+    the MXU (bf16 inputs, f32 accumulation — exact, since products are 0/1
+    and row dots are <= k; the k <= 256 guard keeps a wide margin under
+    f32's 2^24 exact-integer range, and k <= 55 in practice per the main.rs
+    flag range). A cell matches iff its base-match count equals k. Output
+    matches match_grid's tile counts.
+
+    Measured on v5e (512k^2, k=32): ~280-380 Gcells/s across valid
+    tile/dtype choices vs ~460 for the VPU word-compare kernel — the D=4k
+    contraction costs 2*4k flops/cell, so the MXU formulation's ceiling
+    (~770 Gcells/s at k=32 on 197 Tflop/s bf16) is close to the VPU
+    kernel's achieved rate and the materialised f32 M tile eats the rest.
+    Kept as the MXU-shaped alternative and exercised by tests; the VPU
+    kernel is the product/benchmark default."""
     import functools as ft
 
     import jax
@@ -170,8 +198,8 @@ def match_grid_mxu(a_words: np.ndarray, b_words: np.ndarray, k: int,
     from jax.experimental import pallas as pl
 
     if k > 256:
-        raise ValueError("match_grid_mxu requires k <= 256 (bf16-exact "
-                         "match counts)")
+        raise ValueError("match_grid_mxu requires k <= 256 (wide margin "
+                         "under f32's exact-integer range for match counts)")
     tile_a = tile if tile_a is None else tile_a
     tile_b = tile if tile_b is None else tile_b
     W, n_a = a_words.shape
@@ -222,10 +250,13 @@ def match_grid_reference(a_words: np.ndarray, b_words: np.ndarray,
 
 
 def benchmark_gcells(n_a: int = 524288, n_b: int = 524288, k: int = 32,
-                     repeats: int = 3, tile: int = 2048,
+                     repeats: int = 3, tile: int = 2048, tile_b: int = None,
                      seed: int = 0, kernel: str = "vpu") -> Tuple[float, float]:
     """Time the match grid; returns (best seconds, Gcells/s).
     kernel="vpu" is the word-compare kernel, "mxu" the one-hot matmul.
+    The VPU kernel's B tile defaults to 2*tile (2048x4096 measured best on
+    v5e — the asymmetry amortises the A-tile load); pass tile_b explicitly
+    to measure other shapes. The MXU kernel uses square `tile` tiles.
 
     Honest-measurement rules for remote-execution backends: every trial uses
     freshly generated inputs (identical requests can be deduplicated
@@ -242,11 +273,13 @@ def benchmark_gcells(n_a: int = 524288, n_b: int = 524288, k: int = 32,
     def fresh_words(n):
         return pack_2bit_words(rng.integers(1, 5, size=n + k - 1).astype(np.uint8), k)
 
+    tb = (2 * tile if tile_b is None else tile_b) if kernel == "vpu" else tile
+
     def run(a_w, b_w):
         if kernel == "mxu":
             grid = match_grid_mxu(a_w, b_w, k, tile=tile)
         else:
-            grid = match_grid(a_w, b_w, tile_a=tile, tile_b=tile)
+            grid = match_grid(a_w, b_w, tile_a=tile, tile_b=tb)
         return np.asarray(jnp.sum(grid))
 
     run(fresh_words(n_a), fresh_words(n_b))  # compile + warm up

@@ -13,9 +13,9 @@ of the algorithmic cost being tracked). The run asserts the biological
 outcome — a fully-resolved consensus with the circular chromosome and
 plasmid — so a fast-but-wrong run cannot score.
 
-The round-1 showcase metric (Pallas k-mer match grid throughput on the real
-chip, 472 Gcells/s = 620x host) remains reproducible via
-`python bench.py dotplot`.
+The showcase metric (Pallas k-mer match grid throughput on the real chip,
+491 Gcells/s VPU / 274 Gcells/s MXU after the round-3 interior-fast-path +
+f32-accumulator fixes) remains reproducible via `python bench.py dotplot`.
 """
 
 import glob
