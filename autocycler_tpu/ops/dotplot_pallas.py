@@ -273,11 +273,14 @@ def benchmark_gcells(n_a: int = 524288, n_b: int = 524288, k: int = 32,
     def fresh_words(n):
         return pack_2bit_words(rng.integers(1, 5, size=n + k - 1).astype(np.uint8), k)
 
-    tb = (2 * tile if tile_b is None else tile_b) if kernel == "vpu" else tile
+    if tile_b is None:
+        tb = 2 * tile if kernel == "vpu" else tile
+    else:
+        tb = tile_b
 
     def run(a_w, b_w):
         if kernel == "mxu":
-            grid = match_grid_mxu(a_w, b_w, k, tile=tile)
+            grid = match_grid_mxu(a_w, b_w, k, tile_a=tile, tile_b=tb)
         else:
             grid = match_grid(a_w, b_w, tile_a=tile, tile_b=tb)
         return np.asarray(jnp.sum(grid))
