@@ -116,8 +116,9 @@ def batch(assemblies_parent, out_parent, k_size: int = 51,
     log.message()
 
     for cdir in cluster_dirs:
-        trim(cdir, dp_screen=screens[cdir], preloaded=graphs.pop(cdir))
-        resolve(cdir)
+        trimmed = trim(cdir, dp_screen=screens[cdir], preloaded=graphs.pop(cdir))
+        resolve(cdir, preloaded=trimmed)
+        del trimmed   # the graph is reference-cyclic; drop it before collecting
         gc.collect()
     for iso in isolates:
         qc_pass = out_parent / iso.name / "clustering" / "qc_pass"

@@ -479,18 +479,21 @@ def save_distance_matrix(distances: Dict[Tuple[int, int], float],
 
 
 def save_cluster_gfa(sequences: List[Sequence], cluster_num: int,
-                     graph: UnitigGraph, out_gfa) -> None:
+                     graph: UnitigGraph, out_gfa
+                     ) -> Tuple[UnitigGraph, List[Sequence]]:
     """Per-cluster graph: subset the in-memory graph to the cluster's
     sequences, recalc depths, drop zero-depth unitigs, merge linear paths
     (reference cluster.rs:794-822, which filters P-lines and re-loads the
     GFA text — the subset produces the identical graph without the text
-    round trip)."""
+    round trip). Returns (cluster_graph, cluster_seqs) so in-process
+    callers can hand them to trim(preloaded=...)."""
     cluster_seqs = [_clone_seq(s) for s in sequences if s.cluster == cluster_num]
     cluster_graph = graph.subset_for_sequences([s.id for s in cluster_seqs])
     cluster_graph.recalculate_depths()
     cluster_graph.remove_zero_depth_unitigs()
     merge_linear_paths(cluster_graph, cluster_seqs)
     cluster_graph.save_gfa(out_gfa, cluster_seqs)
+    return cluster_graph, cluster_seqs
 
 
 def _clone_seq(s: Sequence) -> Sequence:
@@ -499,7 +502,15 @@ def _clone_seq(s: Sequence) -> Sequence:
 
 
 def save_clusters(sequences: List[Sequence], qc_results: Dict[int, ClusterQC],
-                  clustering_dir, graph: UnitigGraph) -> None:
+                  clustering_dir, graph: UnitigGraph,
+                  collect_handoff: bool = False
+                  ) -> Dict[Path, Tuple[UnitigGraph, List[Sequence]]]:
+    """Writes every cluster's 1_untrimmed.gfa/.yaml; with ``collect_handoff``
+    returns {qc-pass cluster dir: (cluster_graph, cluster_seqs)} for
+    in-process handoff to trim (kept off by default so CLI runs keep the
+    one-cluster-at-a-time graph lifetime instead of holding every cluster's
+    positions in memory at once)."""
+    handoff = {}
     for c in range(1, get_max_cluster(sequences) + 1):
         qc = qc_results[c]
         sub = "qc_pass" if qc.passed() else "qc_fail"
@@ -517,10 +528,14 @@ def save_clusters(sequences: List[Sequence], qc_results: Dict[int, ClusterQC],
         else:
             for reason in qc.failure_reasons:
                 log.message(f"  failed QC: {reason}")
-        save_cluster_gfa(sequences, c, graph, cluster_dir / "1_untrimmed.gfa")
+        pair = save_cluster_gfa(sequences, c, graph, cluster_dir / "1_untrimmed.gfa")
+        if collect_handoff and qc.passed():
+            handoff[cluster_dir] = pair
+        del pair
         UntrimmedClusterMetrics.new(lengths, qc.cluster_dist).save_to_yaml(
             cluster_dir / "1_untrimmed.yaml")
         log.message()
+    return handoff
 
 
 def save_data_to_tsv(sequences: List[Sequence], qc_results: Dict[int, ClusterQC],
@@ -542,11 +557,14 @@ def save_data_to_tsv(sequences: List[Sequence], qc_results: Dict[int, ClusterQC]
 
 def cluster(autocycler_dir, cutoff: float = 0.2, min_assemblies: Optional[int] = None,
             max_contigs: int = 25, manual: Optional[str] = None, use_jax=None,
-            precomputed_distances=None) -> None:
+            precomputed_distances=None, collect_handoff: bool = False
+            ) -> Optional[Dict[Path, Tuple[UnitigGraph, List[Sequence]]]]:
     """precomputed_distances: optional {(id_a, id_b): float} replacing the
     in-process distance computation — the `batch` subcommand passes each
     isolate's matrix from the mesh-batched device contraction (bit-identical
-    to what pairwise_contig_distances would compute here)."""
+    to what pairwise_contig_distances would compute here).
+    collect_handoff: return {qc-pass cluster dir: (graph, sequences)} for
+    in-process trim(preloaded=...) chaining (bench/batch); None otherwise."""
     autocycler_dir = Path(autocycler_dir)
     gfa = autocycler_dir / "input_assemblies.gfa"
     clustering_dir = autocycler_dir / "clustering"
@@ -598,7 +616,8 @@ def cluster(autocycler_dir, cutoff: float = 0.2, min_assemblies: Optional[int] =
 
     qc_results = generate_clusters(tree, sequences, asym, cutoff, min_asm,
                                    manual_clusters)
-    save_clusters(sequences, qc_results, clustering_dir, graph)
+    handoff = save_clusters(sequences, qc_results, clustering_dir, graph,
+                            collect_handoff=collect_handoff)
     save_data_to_tsv(sequences, qc_results, clustering_dir / "clustering.tsv")
     clustering_metrics(sequences, qc_results).save_to_yaml(
         clustering_dir / "clustering.yaml")
@@ -609,3 +628,5 @@ def cluster(autocycler_dir, cutoff: float = 0.2, min_assemblies: Optional[int] =
     log.message(f"Clustering tree (Newick):   {clustering_dir / 'clustering.newick'}")
     log.message(f"Clustering tree (metadata): {clustering_dir / 'clustering.tsv'}")
     log.message()
+    # {qc-pass cluster dir: (graph, sequences)} for in-process trim handoff
+    return handoff if collect_handoff else None

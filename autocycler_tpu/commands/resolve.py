@@ -298,7 +298,10 @@ def cull_ambiguity(bridges: List[Bridge], verbose: bool = False) -> int:
     return cull_count
 
 
-def resolve(cluster_dir, verbose: bool = False) -> None:
+def resolve(cluster_dir, verbose: bool = False, preloaded=None) -> None:
+    """preloaded: optional (graph, sequences) as returned by trim() — skips
+    re-parsing 2_trimmed.gfa (the file remains the checkpoint of record and
+    is still read back if ambiguity culling needs the pristine graph)."""
     cluster_dir = Path(cluster_dir)
     trimmed_gfa = cluster_dir / "2_trimmed.gfa"
     if not cluster_dir.is_dir():
@@ -308,8 +311,13 @@ def resolve(cluster_dir, verbose: bool = False) -> None:
 
     log.section_header("Starting autocycler resolve")
     log.explanation("This command resolves repeats in the unitig graph.")
-    gfa_lines = load_file_lines(trimmed_gfa)
-    graph, sequences = UnitigGraph.from_gfa_lines(gfa_lines)
+    if preloaded is not None:
+        graph, sequences = preloaded
+        gfa_lines = None
+        graph.check_links()   # the file path validates at parse; match it
+    else:
+        gfa_lines = load_file_lines(trimmed_gfa)
+        graph, sequences = UnitigGraph.from_gfa_lines(gfa_lines)
     graph.print_basic_graph_info()
 
     log.section_header("Finding anchor unitigs")
@@ -337,6 +345,8 @@ def resolve(cluster_dir, verbose: bool = False) -> None:
 
     cull_count = cull_ambiguity(bridges, verbose)
     if cull_count > 0:
+        if gfa_lines is None:   # preloaded graph was mutated; re-read the file
+            gfa_lines = load_file_lines(trimmed_gfa)
         graph, _ = UnitigGraph.from_gfa_lines(gfa_lines)
         for num in anchors:
             graph.index[num].unitig_type = UnitigType.ANCHOR

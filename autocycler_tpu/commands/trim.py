@@ -28,7 +28,7 @@ TrimResult = Optional[Tuple[List[int], int]]
 
 def trim(cluster_dir, min_identity: float = 0.75, max_unitigs: int = 5000,
          mad: float = 5.0, threads: int = 1, dp_screen=None,
-         preloaded=None) -> None:
+         preloaded=None) -> Tuple[UnitigGraph, List[Sequence]]:
     """dp_screen: optional {(seq_id, kind): bool} where kind is 'start_end',
     'hairpin_start' or 'hairpin_end' — False means a batched exact screen
     (ops.align.overlap_positive_batch) proved that DP returns no alignment,
@@ -84,6 +84,11 @@ def trim(cluster_dir, min_identity: float = 0.75, max_unitigs: int = 5000,
     log.section_header("Finished!")
     log.message(f"Unitig graph of trimmed sequences: {trimmed_gfa}")
     log.message()
+    # in-process callers (bench, batch) can hand this straight to
+    # resolve(preloaded=...) and skip re-parsing 2_trimmed.gfa; the file
+    # just written stays the checkpoint of record (saved GFA round-trips
+    # to the identical graph, asserted by tests)
+    return graph, sequences
 
 
 def trim_start_end_overlap(graph: UnitigGraph, sequences: List[Sequence],

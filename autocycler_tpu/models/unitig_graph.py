@@ -413,17 +413,18 @@ class UnitigGraph:
 
     def link_count(self) -> Tuple[int, int]:
         """(all links incl. reverse-duplicates, single-direction links)
-        (reference unitig_graph.rs:478-507)."""
-        all_links, one_way = set(), set()
+        (reference unitig_graph.rs:478-507). One canonical set instead of
+        two: the closure size is 2·|undirected| − |self-symmetric| (a link
+        equals its own reverse iff dst == −src)."""
+        one_way = set()
         for a in self.unitigs:
             for signed_a, nexts in ((a.number, a.forward_next), (-a.number, a.reverse_next)):
                 for b in nexts:
                     link = (signed_a, b.signed_number())
                     rev_link = (-link[1], -link[0])
-                    all_links.add(link)
-                    all_links.add(rev_link)
-                    one_way.add(max(link, rev_link))
-        return len(all_links), len(one_way)
+                    one_way.add(link if link >= rev_link else rev_link)
+        self_sym = sum(1 for (x, y) in one_way if x == -y)
+        return 2 * len(one_way) - self_sym, len(one_way)
 
     def topology(self) -> str:
         """circular / linear-open-open / linear-hairpin-hairpin /
@@ -559,15 +560,18 @@ class UnitigGraph:
                 prevs.add((b.number, b.strand, a.number, FORWARD))
             for b in a.reverse_prev:
                 prevs.add((b.number, b.strand, a.number, REVERSE))
-        for a_num, a_strand, b_num, b_strand in nexts | prevs:
-            assert (a_num, a_strand, b_num, b_strand) in nexts, "missing next link"
-            assert (a_num, a_strand, b_num, b_strand) in prevs, "missing prev link"
-            assert (b_num, not b_strand, a_num, not a_strand) in nexts, \
-                "missing next link"
-            assert (b_num, not b_strand, a_num, not a_strand) in prevs, \
-                "missing prev link"
-            assert a_num in self.index and b_num in self.index, \
-                "unitig missing from index"
+        # the per-edge form (each edge and its twin in both sets) reduces to
+        # three whole-set relations, all C-speed; the assert messages (only
+        # evaluated on failure) name the offending links
+        assert nexts == prevs, \
+            f"missing next/prev link: {sorted(nexts ^ prevs)[:5]}"
+        twins = {(b_num, not b_strand, a_num, not a_strand)
+                 for (a_num, a_strand, b_num, b_strand) in nexts}
+        assert twins <= nexts, \
+            f"missing strand-twin link: {sorted(twins - nexts)[:5]}"
+        nums = {n for (a_num, _, b_num, _) in nexts for n in (a_num, b_num)}
+        assert nums <= self.index.keys(), \
+            f"unitig missing from index: {sorted(nums - self.index.keys())[:5]}"
 
     def delete_dangling_links(self) -> None:
         """Drop links that point at unitigs no longer in the graph
@@ -737,7 +741,10 @@ class UnitigGraph:
 
     def connected_components(self) -> List[List[int]]:
         """Connected components as sorted lists of unitig numbers, sorted
-        (reference unitig_graph.rs:905-933)."""
+        (reference unitig_graph.rs:905-933). NOTE: a scipy.sparse.csgraph
+        variant was measured 6x SLOWER here (1.7 s vs 0.29 s on the 43k-
+        unitig headline graph) — the per-link Python edge extraction costs
+        more than the BFS's set churn — so the plain BFS stays."""
         visited = set()
         components = []
         for unitig in self.unitigs:

@@ -29,7 +29,19 @@ _TPU_THRESHOLD = 1 << 16     # with a real TPU attached, use it from 64k element
 #                              tiny/test inputs skip the round trip
 
 
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=1)
 def _tpu_attached() -> bool:
+    """Cached TPU probe. When JAX_PLATFORMS pins a non-TPU backend this
+    answers without importing jax; otherwise the one-time probe initialises
+    a backend (a TPU host then reuses it for the matmul, a CPU-only host
+    pays the init once per process)."""
+    import os
+    platforms = os.environ.get("JAX_PLATFORMS", "").strip().lower()
+    if platforms and "tpu" not in platforms:
+        return False
     try:
         import jax
         return jax.default_backend() == "tpu"

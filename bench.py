@@ -55,11 +55,17 @@ def _run_headline_once() -> float:
     gc.disable()
     t0 = time.perf_counter()
     compress(asm_dir, out_dir)
-    cluster(out_dir)
+    handoff = cluster(out_dir, collect_handoff=True)
     pass_clusters = sorted(glob.glob(str(out_dir / "clustering/qc_pass/cluster_*")))
     for c in pass_clusters:
-        trim(c)
-        resolve(c)
+        # stages hand graphs over in memory; every stage GFA is still
+        # written and byte-identical to the file-reload flow (asserted by
+        # tests/test_pipeline.py::test_inmemory_handoff_matches_file_flow)
+        # pop so the dict doesn't pin every cluster's graph (actual memory
+        # comes back at the final gc.collect() — the graph is cyclic and
+        # the collector is off during the timed region)
+        trimmed = trim(c, preloaded=handoff.pop(Path(c), None))
+        resolve(c, preloaded=trimmed)
     combine(out_dir, [f"{c}/5_final.gfa" for c in pass_clusters])
     elapsed = time.perf_counter() - t0
     gc.enable()

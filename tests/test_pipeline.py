@@ -112,3 +112,34 @@ def test_threads_identical_output(tmp_path):
         compress(asm_dir, tmp_path / "bad", threads=0)
     with pytest.raises(AutocyclerError, match="--threads"):
         trim(cdir1, threads=101)
+
+
+def test_inmemory_handoff_matches_file_flow(tmp_path):
+    """cluster->trim->resolve via in-memory handoff must write byte-identical
+    artifacts to the file-reload flow (the GFA files stay the checkpoint of
+    record either way)."""
+    import filecmp
+
+    asm = make_assemblies(tmp_path, n_assemblies=4, chromosome_len=5000,
+                          plasmid_len=800, seed=7)
+    outs = []
+    for mode in ("file", "handoff"):
+        out = tmp_path / f"out_{mode}"
+        compress(asm, out)
+        handoff = cluster(out, collect_handoff=(mode == "handoff"))
+        cdirs = sorted((out / "clustering" / "qc_pass").glob("cluster_*"))
+        assert cdirs and (handoff is None or set(handoff) == set(cdirs))
+        for c in cdirs:
+            if mode == "handoff":
+                trimmed = trim(c, preloaded=handoff[c])
+                resolve(c, preloaded=trimmed)
+            else:
+                trim(c)
+                resolve(c)
+        outs.append(out)
+
+    a, b = outs
+    files = sorted(p.relative_to(a) for p in a.rglob("*") if p.is_file())
+    assert files == sorted(p.relative_to(b) for p in b.rglob("*") if p.is_file())
+    for rel in files:
+        assert filecmp.cmp(a / rel, b / rel, shallow=False), rel
