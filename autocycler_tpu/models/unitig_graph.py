@@ -114,6 +114,11 @@ class UnitigGraph:
                 link_lines.append(parts)
             elif parts[0] == "P":
                 path_lines.append(parts)
+        seen = set()
+        for u in graph.unitigs:
+            if u.number in seen:
+                quit_with_error(f"duplicate segment number in GFA: {u.number}")
+            seen.add(u.number)
         graph.build_index()
         graph._build_links_from_gfa(link_lines)
         sequences = graph._build_paths_from_gfa(path_lines)
@@ -138,7 +143,14 @@ class UnitigGraph:
             if len(parts) < 6 or parts[5] != "0M":
                 quit_with_error("non-zero overlap found on the GFA link line.\n"
                                 "Are you sure this is an Autocycler-generated GFA file?")
-            seg_1, seg_2 = int(parts[1]), int(parts[3])
+            try:
+                seg_1, seg_2 = int(parts[1]), int(parts[3])
+            except ValueError:
+                quit_with_error(f"unable to parse link segment numbers: "
+                                f"{parts[1]!r}, {parts[3]!r}")
+            if parts[2] not in ("+", "-") or parts[4] not in ("+", "-"):
+                quit_with_error(f"invalid strand on GFA link line: "
+                                f"{parts[2]!r}, {parts[4]!r}")
             strand_1, strand_2 = parts[2] == "+", parts[4] == "+"
             u1 = self.index.get(seg_1)
             u2 = self.index.get(seg_2)
@@ -154,11 +166,16 @@ class UnitigGraph:
         entries = []
         paths_cache = {}
         for parts in path_lines:
-            seq_id = int(parts[1])
+            try:
+                seq_id = int(parts[1])
+            except ValueError:
+                quit_with_error(f"unable to parse P-line sequence id: {parts[1]!r}")
             if not 0 <= seq_id <= MAX_SEQ_ID:
                 quit_with_error(f"P-line sequence id {seq_id} outside the "
                                 f"supported range 0..{MAX_SEQ_ID} (15-bit "
                                 "id space, reference position.rs:21)")
+            if seq_id in paths_cache:
+                quit_with_error(f"duplicate P-line sequence id in GFA: {seq_id}")
             length = filename = header = None
             cluster = 0
             for p in parts[2:]:
@@ -173,6 +190,16 @@ class UnitigGraph:
             if length is None or filename is None or header is None:
                 quit_with_error("missing required tag in GFA path line.")
             numbers, strands = parse_unitig_path_arrays(parts[2])
+            # missing path unitigs get their own error in stamp_paths_batch;
+            # only a complete path can be length-validated here
+            if all(int(n) in self.index for n in numbers):
+                path_bp = sum(len(self.index[int(n)].forward_seq)
+                              for n in numbers)
+                if path_bp != length:
+                    quit_with_error(
+                        f"P-line for sequence {seq_id} declares LN:i:{length} "
+                        f"but its path totals {path_bp} bp — the GFA paths "
+                        "do not match its segments")
             entries.append((seq_id, length, numbers, strands))
             sequences.append(Sequence.without_seq(seq_id, filename, header,
                                                   length, cluster))
@@ -228,8 +255,13 @@ class UnitigGraph:
         # every path must sum to its declared length
         ends = cum[path_off[1:] - 1] - np.concatenate(
             [[0], cum[path_off[1:-1] - 1]])
-        assert np.array_equal(ends, np.array([e[1] for e in entries])), \
-            "Position calculation mismatch"
+        declared = np.array([e[1] for e in entries])
+        # internal invariant (reference unitig_graph.rs:386) — malformed GFA
+        # input is caught with a user-facing error in _build_paths_from_gfa
+        # before entries reach this helper
+        assert np.array_equal(ends, declared), \
+            f"path length mismatch for sequence " \
+            f"{entries[int(np.nonzero(ends != declared)[0][0])][0]}"
 
         mirror = L_all - pos - ln
         # first half: FORWARD stamps at pos; second half: REVERSE at mirror.

@@ -6,6 +6,7 @@ from autocycler_tpu.models import UnitigGraph
 from autocycler_tpu.utils import load_fasta
 
 from synthetic import make_assemblies, random_genome
+import pytest
 import random
 
 
@@ -70,3 +71,40 @@ def test_best_match_rows_matches_scalar_oracle():
         rows = alphabet[rng.integers(0, 5, size=(n, width))]
         scalar = _find_best_match([r.tobytes() for r in rows])
         assert _best_match_rows(rows) == scalar
+
+
+_GFA_H = "H\tVN:Z:1.0\tKM:i:9"
+_GFA_S = "S\t1\tACGTACGTACGTA\tDP:f:1"
+_MALFORMED_GFA_CASES = {
+    "bad-P-id": [_GFA_H, _GFA_S, "P\tzz\t1+\t*\tLN:i:13\tFN:Z:f\tHD:Z:h"],
+    "P-id-out-of-range": [_GFA_H, _GFA_S,
+                          "P\t40000\t1+\t*\tLN:i:13\tFN:Z:f\tHD:Z:h"],
+    "P-wrong-LN": [_GFA_H, _GFA_S, "P\t1\t1+\t*\tLN:i:999\tFN:Z:f\tHD:Z:h"],
+    "dup-P-id": [_GFA_H, _GFA_S, "P\t1\t1+\t*\tLN:i:13\tFN:Z:f\tHD:Z:h",
+                 "P\t1\t1+\t*\tLN:i:13\tFN:Z:f\tHD:Z:h"],
+    "bad-L-strand": [_GFA_H, _GFA_S, "L\t1\t?\t1\t+\t0M"],
+    "bad-L-segment": [_GFA_H, _GFA_S, "L\tq\t+\t1\t+\t0M"],
+    "dup-S-number": [_GFA_H, _GFA_S, _GFA_S],
+}
+
+
+@pytest.mark.parametrize("case", sorted(_MALFORMED_GFA_CASES))
+def test_malformed_gfa_rejected_cleanly(case):
+    """Every malformed-GFA case must produce a clean AutocyclerError (not a
+    raw traceback or bare assert) so CLI users see 'Error: ...' (reference
+    quit_with_error semantics, misc.rs:131-142)."""
+    from autocycler_tpu.models import UnitigGraph
+    from autocycler_tpu.utils.misc import AutocyclerError
+    with pytest.raises(AutocyclerError):
+        UnitigGraph.from_gfa_lines(_MALFORMED_GFA_CASES[case])
+
+
+def test_valid_gfa_still_accepted_after_validation():
+    from autocycler_tpu.models import UnitigGraph
+    lines = ["H\tVN:Z:1.0\tKM:i:9",
+             "S\t1\tACGTACGTACGTA\tDP:f:1",
+             "L\t1\t+\t1\t+\t0M",
+             "L\t1\t-\t1\t-\t0M",
+             "P\t1\t1+\t*\tLN:i:13\tFN:Z:f.fasta\tHD:Z:h"]
+    graph, seqs = UnitigGraph.from_gfa_lines(lines)
+    assert len(graph.unitigs) == 1 and len(seqs) == 1
