@@ -36,7 +36,15 @@ def parse_unitig_path(path_str: str) -> List[Tuple[int, bool]]:
             strand = REVERSE
         else:
             quit_with_error(f"Invalid path strand: {token}")
-        path.append((int(token[:-1]), strand))
+        try:
+            number = int(token[:-1])
+        except ValueError:
+            quit_with_error(f"unable to parse path unitig number: {token!r}")
+        if number < 1:
+            # dense-LUT consumers index by number; a negative here would
+            # wrap via Python negative indexing onto the wrong unitig
+            quit_with_error(f"path unitig numbers must be positive: {token!r}")
+        path.append((number, strand))
     return path
 
 
@@ -70,6 +78,8 @@ def parse_unitig_path_arrays(path_str: str) -> Tuple[np.ndarray, np.ndarray]:
     tok = np.searchsorted(starts, di, side="right") - 1
     exp = (sign_idx[tok] - 1 - di).astype(np.float64)
     vals = np.bincount(tok, weights=(b[di] - 48) * 10.0 ** exp, minlength=T)
+    if (vals < 1).any():
+        parse_unitig_path(path_str)   # scalar parser rejects '0...' tokens
     return vals.astype(np.int64), b[sign_idx] == 43
 
 
@@ -116,6 +126,10 @@ class UnitigGraph:
                 path_lines.append(parts)
         seen = set()
         for u in graph.unitigs:
+            if u.number < 1:
+                # dense LUTs index by number; zero/negative would wrap via
+                # Python negative indexing onto the wrong unitig
+                quit_with_error(f"segment numbers must be positive: {u.number}")
             if u.number in seen:
                 quit_with_error(f"duplicate segment number in GFA: {u.number}")
             seen.add(u.number)
@@ -137,6 +151,18 @@ class UnitigGraph:
 
     def build_index(self) -> None:
         self.index = {u.number: u for u in self.unitigs}
+
+    def _dense_luts(self) -> Tuple[int, np.ndarray, np.ndarray]:
+        """(max_num, row_of, lengths): dense number-indexed tables; -1 in
+        row_of marks absent numbers (lengths valid only where row_of >= 0).
+        Valid only until the unitig list next changes."""
+        max_num = self.max_unitig_number()
+        row_of = np.full(max_num + 1, -1, np.int64)
+        lengths = np.zeros(max_num + 1, np.int64)
+        for r, u in enumerate(self.unitigs):
+            row_of[u.number] = r
+            lengths[u.number] = len(u.forward_seq)
+        return max_num, row_of, lengths
 
     def _build_links_from_gfa(self, link_lines: List[List[str]]) -> None:
         for parts in link_lines:
@@ -165,7 +191,12 @@ class UnitigGraph:
         sequences = []
         entries = []
         paths_cache = {}
+        # dense LUTs for the vectorised per-path LN check, shared with
+        # stamp_paths_batch (skipped entirely when there are no P-lines)
+        luts = self._dense_luts() if path_lines else None
         for parts in path_lines:
+            if len(parts) < 3:
+                quit_with_error("GFA path line does not have enough parts.")
             try:
                 seq_id = int(parts[1])
             except ValueError:
@@ -178,23 +209,28 @@ class UnitigGraph:
                 quit_with_error(f"duplicate P-line sequence id in GFA: {seq_id}")
             length = filename = header = None
             cluster = 0
-            for p in parts[2:]:
-                if p.startswith("LN:i:"):
-                    length = int(p[5:])
-                elif p.startswith("FN:Z:"):
-                    filename = p[5:]
-                elif p.startswith("HD:Z:"):
-                    header = p[5:]
-                elif p.startswith("CL:i:"):
-                    cluster = int(p[5:])
+            try:
+                for p in parts[2:]:
+                    if p.startswith("LN:i:"):
+                        length = int(p[5:])
+                    elif p.startswith("FN:Z:"):
+                        filename = p[5:]
+                    elif p.startswith("HD:Z:"):
+                        header = p[5:]
+                    elif p.startswith("CL:i:"):
+                        cluster = int(p[5:])
+            except ValueError:
+                quit_with_error(f"unable to parse integer tag on GFA path "
+                                f"line for sequence {seq_id}")
             if length is None or filename is None or header is None:
                 quit_with_error("missing required tag in GFA path line.")
             numbers, strands = parse_unitig_path_arrays(parts[2])
             # missing path unitigs get their own error in stamp_paths_batch;
             # only a complete path can be length-validated here
-            if all(int(n) in self.index for n in numbers):
-                path_bp = sum(len(self.index[int(n)].forward_seq)
-                              for n in numbers)
+            max_num, row_of, lengths = luts
+            if len(numbers) and numbers.max() <= max_num \
+                    and (row_of[numbers] >= 0).all():
+                path_bp = int(lengths[numbers].sum())
                 if path_bp != length:
                     quit_with_error(
                         f"P-line for sequence {seq_id} declares LN:i:{length} "
@@ -204,13 +240,15 @@ class UnitigGraph:
             sequences.append(Sequence.without_seq(seq_id, filename, header,
                                                   length, cluster))
             paths_cache[seq_id] = list(zip(numbers.tolist(), strands.tolist()))
-        self.stamp_paths_batch(entries)
+        self.stamp_paths_batch(entries, luts=luts)
         self._paths_cache = paths_cache
         return sequences
 
-    def stamp_paths_batch(self, entries) -> None:
+    def stamp_paths_batch(self, entries, luts=None) -> None:
         """Stamp many sequence paths in one vectorised pass. ``entries`` is a
         list of (seq_id, length, numbers int64[], strands bool[]).
+        ``luts`` optionally passes a prebuilt :meth:`_dense_luts` result so
+        a caller that already built the tables doesn't rebuild them.
 
         One pass covers both strands: the reverse-path position of the step
         at forward position p is length - p - len(unitig)
@@ -233,16 +271,15 @@ class UnitigGraph:
         np.cumsum([len(e[2]) for e in entries], out=path_off[1:])
 
         # dense number -> (row, length) lookup
-        max_num = max((u.number for u in self.unitigs), default=0)
-        row_of = np.full(max_num + 1, -1, np.int64)
-        lengths = np.zeros(max_num + 1, np.int64)
-        for r, u in enumerate(self.unitigs):
-            row_of[u.number] = r
-            lengths[u.number] = len(u.forward_seq)
-        if numbers_all.max(initial=0) > max_num or \
+        max_num, row_of, lengths = luts if luts is not None \
+            else self._dense_luts()
+        if numbers_all.min(initial=1) < 1 or \
+                numbers_all.max(initial=0) > max_num or \
                 (row_of[numbers_all] < 0).any():
-            bad = numbers_all[(numbers_all > max_num) |
-                              (row_of[np.minimum(numbers_all, max_num)] < 0)][0]
+            # min check first: a negative number would silently wrap through
+            # the dense LUTs via Python negative indexing
+            bad = numbers_all[(numbers_all < 1) | (numbers_all > max_num) |
+                              (row_of[np.clip(numbers_all, 0, max_num)] < 0)][0]
             quit_with_error(f"unitig {int(bad)} not found in unitig index")
         ln = lengths[numbers_all]
         rows = row_of[numbers_all]

@@ -9,6 +9,8 @@ from synthetic import make_assemblies, random_genome
 import pytest
 import random
 
+from autocycler_tpu.utils.misc import AutocyclerError
+
 
 def test_crlf_fasta_and_gfa(tmp_path):
     rng = random.Random(1)
@@ -93,14 +95,11 @@ def test_malformed_gfa_rejected_cleanly(case):
     """Every malformed-GFA case must produce a clean AutocyclerError (not a
     raw traceback or bare assert) so CLI users see 'Error: ...' (reference
     quit_with_error semantics, misc.rs:131-142)."""
-    from autocycler_tpu.models import UnitigGraph
-    from autocycler_tpu.utils.misc import AutocyclerError
     with pytest.raises(AutocyclerError):
         UnitigGraph.from_gfa_lines(_MALFORMED_GFA_CASES[case])
 
 
 def test_valid_gfa_still_accepted_after_validation():
-    from autocycler_tpu.models import UnitigGraph
     lines = ["H\tVN:Z:1.0\tKM:i:9",
              "S\t1\tACGTACGTACGTA\tDP:f:1",
              "L\t1\t+\t1\t+\t0M",
@@ -108,3 +107,32 @@ def test_valid_gfa_still_accepted_after_validation():
              "P\t1\t1+\t*\tLN:i:13\tFN:Z:f.fasta\tHD:Z:h"]
     graph, seqs = UnitigGraph.from_gfa_lines(lines)
     assert len(graph.unitigs) == 1 and len(seqs) == 1
+
+
+@pytest.mark.parametrize("case,lines", sorted({
+    "neg-path-number": [_GFA_H, _GFA_S,
+                        "P\t1\t-1-\t*\tLN:i:13\tFN:Z:f\tHD:Z:h"],
+    "garbage-path-number": [_GFA_H, _GFA_S,
+                            "P\t1\tx+\t*\tLN:i:13\tFN:Z:f\tHD:Z:h"],
+    "bad-LN-tag": [_GFA_H, _GFA_S, "P\t1\t1+\t*\tLN:i:abc\tFN:Z:f\tHD:Z:h"],
+    "bad-CL-tag": [_GFA_H, _GFA_S,
+                   "P\t1\t1+\t*\tLN:i:13\tFN:Z:f\tHD:Z:h\tCL:i:x"],
+    "short-P-line": [_GFA_H, _GFA_S, "P\t1"],
+}.items()))
+def test_more_malformed_plines_rejected_cleanly(case, lines):
+    with pytest.raises(AutocyclerError):
+        UnitigGraph.from_gfa_lines(lines)
+
+
+@pytest.mark.parametrize("case,lines", sorted({
+    "zero-S-number": [_GFA_H, "S\t0\tACGT\tDP:f:1"],
+    "neg-S-number": [_GFA_H, "S\t-3\tACGT\tDP:f:1"],
+    "zero-path-number": [_GFA_H, _GFA_S,
+                         "P\t1\t0+\t*\tLN:i:13\tFN:Z:f\tHD:Z:h"],
+}.items()))
+def test_nonpositive_numbers_rejected(case, lines):
+    """Zero/negative segment or path numbers must error cleanly — dense
+    LUTs index by number, and Python negative indexing would otherwise
+    silently wrap onto the wrong unitig."""
+    with pytest.raises(AutocyclerError):
+        UnitigGraph.from_gfa_lines(lines)
