@@ -116,3 +116,31 @@ def test_random_structured_fuzz(tmp_path, seed):
             g = revcomp(g)
         asms.append([mutate(rng, g, rng.randint(0, 4))])
     _run_pipeline(tmp_path, _write_assemblies(tmp_path, asms))
+
+
+def _indel_mutate(rng, seq, n_indels, max_len=8):
+    """Random small insertions/deletions (assemblies differ by indels as
+    well as SNPs; the path DPs align through them via gap scores)."""
+    s = seq
+    for _ in range(n_indels):
+        i = rng.randrange(1, len(s) - max_len - 1)
+        if rng.random() < 0.5:
+            s = s[:i] + random_genome(rng, rng.randint(1, max_len)) + s[i:]
+        else:
+            s = s[:i] + s[i + rng.randint(1, max_len):]
+    return s
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_indel_divergence_fuzz(tmp_path, seed):
+    """Assemblies differing by indels (not just substitutions) must still
+    compress losslessly and flow through trim/resolve."""
+    rng = random.Random(200 + seed)
+    genome = random_genome(rng, rng.randint(1500, 3000))
+    asms = []
+    for i in range(3):
+        g = rotate(genome, rng.randrange(len(genome)))
+        g = _indel_mutate(rng, g, rng.randint(1, 4))
+        g = mutate(rng, g, rng.randint(0, 3))
+        asms.append([g])
+    _run_pipeline(tmp_path, _write_assemblies(tmp_path, asms))
