@@ -36,17 +36,52 @@ import functools as _functools
 def _tpu_attached() -> bool:
     """Cached TPU probe. When JAX_PLATFORMS pins a non-TPU backend this
     answers without importing jax; otherwise the one-time probe initialises
-    a backend (a TPU host then reuses it for the matmul, a CPU-only host
-    pays the init once per process)."""
+    a backend AND runs one tiny device op (a TPU host then reuses the
+    backend for the matmul, a CPU-only host pays the init once per
+    process).
+
+    The probe runs in a daemon thread with a deadline
+    (AUTOCYCLER_DEVICE_PROBE_TIMEOUT, default 60 s): a remote/tunnelled
+    device can wedge in a way that blocks the first device call forever,
+    and the product path must degrade to the bit-identical host matmul
+    instead of hanging the pipeline. The tiny op is what catches a wedged
+    transport — backend init alone can succeed while execution stalls."""
     import os
+    import sys
+    import threading
     platforms = os.environ.get("JAX_PLATFORMS", "").strip().lower()
-    if platforms and "tpu" not in platforms:
+    if platforms and "tpu" not in platforms and "axon" not in platforms:
+        # pinned to a non-TPU backend (tests pin cpu): answer without
+        # importing jax. "axon" is the tunnelled-TPU plugin platform and
+        # must fall through to the probe.
         return False
     try:
-        import jax
-        return jax.default_backend() == "tpu"
-    except Exception:  # noqa: BLE001 — no jax / no device: host matmul
+        timeout = float(os.environ.get("AUTOCYCLER_DEVICE_PROBE_TIMEOUT", "60"))
+    except ValueError:
+        print("autocycler: ignoring malformed AUTOCYCLER_DEVICE_PROBE_TIMEOUT",
+              file=sys.stderr)
+        timeout = 60.0
+    result: List[bool] = []
+
+    def probe() -> None:
+        try:
+            import jax
+            import jax.numpy as jnp
+            ok = jax.default_backend() == "tpu"
+            if ok:
+                float(jnp.asarray(1.0) + 1.0)  # end-to-end transport check
+            result.append(ok)
+        except Exception:  # noqa: BLE001 — no jax / no device: host matmul
+            result.append(False)
+
+    t = threading.Thread(target=probe, daemon=True, name="tpu-probe")
+    t.start()
+    t.join(timeout)
+    if not result:
+        print(f"autocycler: device probe did not respond within {timeout:.0f}s; "
+              "falling back to host backends", file=sys.stderr)
         return False
+    return result[0]
 
 
 def exceeds_int32_accumulation(weighted: np.ndarray) -> bool:

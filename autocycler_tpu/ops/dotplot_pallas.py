@@ -131,15 +131,18 @@ def match_grid(a_words: np.ndarray, b_words: np.ndarray,
 TILE_MXU = 1024
 
 
-def expand_onehot_words(words, k: int, n_valid: int = None):
-    """Device-side one-hot expansion: [W, n] packed int32 words ->
-    [n, 4k] int8 where column 4*p + c is 1 iff base p of the k-mer is c.
-    Two k-mers are equal iff their one-hot rows dot to k — the equality
-    test becomes an int8 matmul, which is MXU work instead of VPU compares.
+def expand_pm1_words(words, k: int, n_valid: int = None, dtype="bfloat16"):
+    """Device-side bit-antipodal expansion: [W, n] packed int32 words ->
+    [n, 2k] where column 2*p + b is +1 if bit b of base p is set, else -1.
+    Two length-2k ±1 vectors dot to 2k - 2*hamming(bits), so two k-mers are
+    equal iff their rows dot to exactly 2k — the equality test becomes a
+    D=2k matmul, half the contraction depth of a 4-symbol one-hot (D=4k)
+    for the same exact result.
 
-    Rows at index >= n_valid are zeroed: a zero row dots to 0 < k against
-    anything, so tile padding can NEVER register a match (2-bit packing has
-    no out-of-band sentinel — every int32 is a real all-base word)."""
+    Rows at index >= n_valid are zeroed: a zero row dots to 0 != 2k against
+    anything (k >= 1), so tile padding can NEVER register a match (2-bit
+    packing has no out-of-band sentinel — every int32 is a real all-base
+    word)."""
     import jax.numpy as jnp
 
     W, n = words.shape
@@ -147,87 +150,98 @@ def expand_onehot_words(words, k: int, n_valid: int = None):
     cols = []
     for p in range(k):
         w, t = divmod(p, 16)
-        cols.append((wd[w] >> (2 * (15 - t))) & 3)  # base t at bits 2*(15-t)
-    base = jnp.stack(cols, axis=1)                      # [n, k] values 0..3
-    oh = (base[:, :, None] == jnp.arange(4, dtype=base.dtype)).astype(jnp.int8)
-    oh = oh.reshape(n, 4 * k)
+        base = (wd[w] >> (2 * (15 - t))) & 3        # base t at bits 2*(15-t)
+        cols.append((base >> 1) * 2 - 1)            # high bit -> ±1
+        cols.append((base & 1) * 2 - 1)             # low bit  -> ±1
+    pm = jnp.stack(cols, axis=1).astype(jnp.dtype(dtype))   # [n, 2k]
     if n_valid is not None and n_valid < n:
-        oh = oh * (jnp.arange(n)[:, None] < n_valid).astype(jnp.int8)
-    return oh
+        pm = pm * (jnp.arange(n)[:, None] < n_valid).astype(pm.dtype)
+    return pm
 
 
-def _mxu_kernel(k_val, a_ref, b_ref, out_ref):
+def _mxu_kernel(two_k, acc_dtype, a_ref, b_ref, out_ref):
     import jax
     import jax.numpy as jnp
 
-    # bf16 inputs, f32 accumulation: one-hot products are 0/1 and row dots
-    # are <= k, exact in f32 trivially. Mosaic REQUIRES a 32-bit matmul
-    # accumulator ('Expected matmul acc to be 32-bit' — a bf16
-    # preferred_element_type compiles under interpret mode but fails
-    # verification on the chip), so the M tile is materialised at 4 B/cell.
-    m = jax.lax.dot_general(a_ref[:, :].astype(jnp.bfloat16),
-                            b_ref[:, :].astype(jnp.bfloat16),
+    # ±1 inputs: row dots are integers in [-2k, 2k] — exact in int32
+    # trivially, and exact in f32 for any k (|dot| <= 512 << 2^24). Mosaic
+    # REQUIRES a 32-bit matmul accumulator ('Expected matmul acc to be
+    # 32-bit' — a bf16 preferred_element_type compiles under interpret mode
+    # but fails verification on the chip), so the M tile is materialised at
+    # 4 B/cell either way.
+    m = jax.lax.dot_general(a_ref[:, :], b_ref[:, :],
                             (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-    count = jnp.sum((m == k_val).astype(jnp.float32)).astype(jnp.int32)
+                            preferred_element_type=acc_dtype)
+    count = jnp.sum((m == two_k).astype(jnp.int32))
     out_ref[:, :] = jnp.broadcast_to(count, out_ref.shape)
 
 
 def match_grid_mxu(a_words: np.ndarray, b_words: np.ndarray, k: int,
                    tile: int = TILE_MXU, tile_a: int = None,
-                   tile_b: int = None):
-    """MXU formulation of :func:`match_grid`: one-hot rows are expanded on
-    device and each program contracts a [tile_a, 4k] x [tile_b, 4k] pair on
-    the MXU (bf16 inputs, f32 accumulation — exact, since products are 0/1
-    and row dots are <= k; the k <= 256 guard keeps a wide margin under
-    f32's 2^24 exact-integer range, and k <= 55 in practice per the main.rs
-    flag range). A cell matches iff its base-match count equals k. Output
-    matches match_grid's tile counts.
+                   tile_b: int = None, in_dtype: str = "bfloat16"):
+    """MXU formulation of :func:`match_grid`: ±1 bit rows are expanded on
+    device and each program contracts a [tile_a, 2k] x [tile_b, 2k] pair on
+    the MXU. A cell matches iff its dot equals 2k (all 2k bits equal).
+    Output matches match_grid's tile counts exactly.
 
-    Measured on v5e (512k^2, k=32): ~280-380 Gcells/s across valid
-    tile/dtype choices vs ~460 for the VPU word-compare kernel — the D=4k
-    contraction costs 2*4k flops/cell, so the MXU formulation's ceiling
-    (~770 Gcells/s at k=32 on 197 Tflop/s bf16) is close to the VPU
-    kernel's achieved rate and the materialised f32 M tile eats the rest.
-    Kept as the MXU-shaped alternative and exercised by tests; the VPU
-    kernel is the product/benchmark default."""
-    import functools as ft
-
-    import jax
+    in_dtype picks the MXU input precision: "bfloat16" (f32 accumulation —
+    exact, ±1 inputs and |dot| <= 2k <= 512) or "int8" (int32 accumulation,
+    2x the bf16 MXU rate on v5e when Mosaic lowers it natively). Both are
+    exact; the k <= 256 guard keeps 2k within trivial exact range, and
+    k <= 55 in practice per the main.rs flag range."""
     import jax.numpy as jnp
-    from jax.experimental import pallas as pl
 
     if k > 256:
-        raise ValueError("match_grid_mxu requires k <= 256 (wide margin "
-                         "under f32's exact-integer range for match counts)")
+        raise ValueError("match_grid_mxu requires k <= 256")
     tile_a = tile if tile_a is None else tile_a
     tile_b = tile if tile_b is None else tile_b
     W, n_a = a_words.shape
     _, n_b = b_words.shape
     a_pad = _pad_to(a_words, tile_a, -1)
     b_pad = _pad_to(b_words, tile_b, -2)
+    return _mxu_run(jnp.asarray(a_pad), jnp.asarray(b_pad),
+                    k, n_a, n_b, tile_a, tile_b, in_dtype)
+
+
+@functools.lru_cache(maxsize=None)
+def _mxu_jit():
+    import jax
+
+    return jax.jit(_mxu_run_impl,
+                   static_argnames=("k", "n_a", "n_b", "tile_a", "tile_b",
+                                    "in_dtype"))
+
+
+def _mxu_run(a_pad, b_pad, k, n_a, n_b, tile_a, tile_b, in_dtype):
+    return _mxu_jit()(a_pad, b_pad, k=k, n_a=n_a, n_b=n_b,
+                      tile_a=tile_a, tile_b=tile_b, in_dtype=in_dtype)
+
+
+def _mxu_run_impl(a_pad, b_pad, *, k, n_a, n_b, tile_a, tile_b, in_dtype):
+    import functools as ft
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
     ga = a_pad.shape[1] // tile_a
     gb = b_pad.shape[1] // tile_b
-    D = 4 * k
-
-    @jax.jit
-    def run(a_w, b_w):
-        a_oh = expand_onehot_words(a_w, k, n_valid=n_a)
-        b_oh = expand_onehot_words(b_w, k, n_valid=n_b)
-        tiles = pl.pallas_call(
-            ft.partial(_mxu_kernel, k),
-            grid=(ga, gb),
-            in_specs=[
-                pl.BlockSpec((tile_a, D), lambda i, j: (i, 0)),
-                pl.BlockSpec((tile_b, D), lambda i, j: (j, 0)),
-            ],
-            out_specs=pl.BlockSpec((8, 128), lambda i, j: (i, j)),
-            out_shape=jax.ShapeDtypeStruct((ga * 8, gb * 128), jnp.int32),
-            interpret=jax.default_backend() != "tpu",
-        )(a_oh, b_oh)
-        return tiles[::8, ::128]
-
-    return run(jnp.asarray(a_pad), jnp.asarray(b_pad))
+    D = 2 * k
+    acc = jnp.int32 if in_dtype == "int8" else jnp.float32
+    a_pm = expand_pm1_words(a_pad, k, n_valid=n_a, dtype=in_dtype)
+    b_pm = expand_pm1_words(b_pad, k, n_valid=n_b, dtype=in_dtype)
+    tiles = pl.pallas_call(
+        ft.partial(_mxu_kernel, 2 * k, acc),
+        grid=(ga, gb),
+        in_specs=[
+            pl.BlockSpec((tile_a, D), lambda i, j: (i, 0)),
+            pl.BlockSpec((tile_b, D), lambda i, j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((8, 128), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((ga * 8, gb * 128), jnp.int32),
+        interpret=jax.default_backend() != "tpu",
+    )(a_pm, b_pm)
+    return tiles[::8, ::128]
 
 
 def match_grid_reference(a_words: np.ndarray, b_words: np.ndarray,
@@ -253,7 +267,8 @@ def benchmark_gcells(n_a: int = 524288, n_b: int = 524288, k: int = 32,
                      repeats: int = 3, tile: int = 2048, tile_b: int = None,
                      seed: int = 0, kernel: str = "vpu") -> Tuple[float, float]:
     """Time the match grid; returns (best seconds, Gcells/s).
-    kernel="vpu" is the word-compare kernel, "mxu" the one-hot matmul.
+    kernel="vpu" is the word-compare kernel, "mxu" the ±1 matmul with bf16
+    inputs, "mxu8" the same with int8 inputs / int32 accumulation.
     The VPU kernel's B tile defaults to 2*tile (2048x4096 measured best on
     v5e — the asymmetry amortises the A-tile load); pass tile_b explicitly
     to measure other shapes. The MXU kernel uses square `tile` tiles.
@@ -281,6 +296,9 @@ def benchmark_gcells(n_a: int = 524288, n_b: int = 524288, k: int = 32,
     def run(a_w, b_w):
         if kernel == "mxu":
             grid = match_grid_mxu(a_w, b_w, k, tile_a=tile, tile_b=tb)
+        elif kernel == "mxu8":
+            grid = match_grid_mxu(a_w, b_w, k, tile_a=tile, tile_b=tb,
+                                  in_dtype="int8")
         else:
             grid = match_grid(a_w, b_w, tile_a=tile, tile_b=tb)
         return np.asarray(jnp.sum(grid))
