@@ -53,13 +53,14 @@ class Chains:
 def internal_edges(index: KmerIndex) -> np.ndarray:
     """next_int[g] = unitig-internal successor of k-mer g, or -1."""
     U = index.num_kmers
-    can_extend = (index.out_count == 1) & ~index.first_pos[index.rev_kid]
-    succ = np.where(can_extend, index.succ, -1)
-    ok = succ >= 0
-    tgt = succ[ok]
-    accept = (index.in_count[tgt] == 1) & ~index.first_pos[tgt]
+    succ = index.succ
+    ok = (index.out_count == 1) & (succ >= 0)
+    ok &= ~index.first_pos[index.rev_kid]
+    src = np.flatnonzero(ok)
+    tgt = succ[src]
+    keep = (index.in_count[tgt] == 1) & ~index.first_pos[tgt]
     result = np.full(U, -1, np.int64)
-    result[np.flatnonzero(ok)[accept]] = tgt[accept]
+    result[src[keep]] = tgt[keep]
     return result
 
 

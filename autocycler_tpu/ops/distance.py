@@ -76,7 +76,7 @@ def _tpu_attached() -> bool:
 
     t = threading.Thread(target=probe, daemon=True, name="tpu-probe")
     t.start()
-    t.join(timeout)
+    t.join(timeout if timeout > 0 else None)  # <= 0: no deadline (wait)
     if not result:
         print(f"autocycler: device probe did not respond within {timeout:.0f}s; "
               "falling back to host backends", file=sys.stderr)

@@ -609,6 +609,11 @@ struct Entry {
     uint32_t gid;
     uint32_t rep;   // byte offset of a forward occurrence (UINT32_MAX: none)
 };
+// NOTE: storing the key inline (32 B entries) to save the dependent
+// keys[gid] verify miss was measured MUCH slower on the headline input
+// (phase A 5.9s -> 11.0s): the table doubles to ~1 GB and every random
+// probe then pays a TLB walk on top of the cache miss. Footprint beats
+// access-count on this host, same as the round-1/2 findings.
 
 struct Table {
     std::vector<Entry> slots;
@@ -714,8 +719,10 @@ static int64_t occ_index_build_impl(const uint8_t* codes, int64_t n_codes,
     Table table;
     if (!table.init(1 << 15)) return -1;
     std::vector<u128> keys;                // per provisional gid
+    std::vector<u128> rc_keys;             // rc key per provisional gid
     try {
         keys.reserve(1 << 16);
+        rc_keys.reserve(1 << 16);
     } catch (...) { return -1; }
 
     constexpr int64_t BLOCK = 128;
@@ -747,74 +754,56 @@ static int64_t occ_index_build_impl(const uint8_t* codes, int64_t n_codes,
             }
             // NOTE: a staged variant that defers the key compare (prefetching
             // keys[gid] and verifying per block) was measured SLOWER here
-            // (6.4s vs 5.9s on the 147M-window headline input) — on this
-            // host the simple probe wins, consistent with the round-1
-            // finding that footprint beats access-count tricks.
+            // (6.4s vs 5.9s on the 147M-window headline input), as was
+            // storing keys inline in 32 B entries (11.0s — see the Entry
+            // NOTE): the simple probe over the smallest footprint wins.
             for (int64_t p = p0; p < pe; ++p) {
+                const size_t before = keys.size();
                 gout[p] = static_cast<int32_t>(table.upsert(
                     win_keys[p - p0], win_hash[p - p0],
                     static_cast<uint32_t>(fwd_off[s] + p), keys));
+                if (keys.size() != before) {
+                    // new group: derive its rc key now, while the window
+                    // bytes are hot — once per DISTINCT k-mer, so the k-digit
+                    // loop is off the per-window path (a rolling-rc variant
+                    // carried ~1 s of u128 arithmetic across all 147M
+                    // windows; this pays only at the ~10% insert rate)
+                    const uint8_t* w = base + p;
+                    u128 rk = 0;
+                    for (int32_t j = k - 1; j >= 0; --j) {
+                        const uint32_t c = ENC.t[w[j]];
+                        rk = rk * 5 + (c ? 5 - c : 0);
+                    }
+                    rc_keys.push_back(rk);
+                }
             }
         }
     }
     const int64_t U_f = static_cast<int64_t>(keys.size());
     pt.mark("A fwd hash");
 
-    // ---- phase B: reverse-complement map over GROUPS ----
-    // rc keys are recomputed from each group's representative window bytes
-    // (rep byte offsets were recorded at insert time, recovered here from the
-    // table to avoid a dense side array during phase A)
-    std::vector<int32_t> rc_of;
+    // recover per-group representative byte offsets from the table (recorded
+    // at first insert; avoids a dense side array during phase A), then the
+    // table is done — the rc map below never probes it.
     std::vector<uint32_t> rep_of;
-    try {
-        rc_of.resize(U_f, -1);
-        rep_of.resize(U_f, UINT32_MAX);
-    } catch (...) { return -1; }
+    try { rep_of.resize(U_f, UINT32_MAX); } catch (...) { return -1; }
     for (const Entry& e : table.slots) {
-        if (e.hash != 0 && e.rep != UINT32_MAX) rep_of[e.gid] = e.rep;
+        if (e.hash != 0) rep_of[e.gid] = e.rep;
     }
-    {
-        constexpr int64_t RCB = 128;
-        u128 rks[RCB];
-        uint64_t rhs[RCB];
-        for (int64_t g0 = 0; g0 < U_f; g0 += RCB) {
-            const int64_t ge = std::min(g0 + RCB, U_f);
-            if ((keys.size() + RCB) * 2 > table.cap && !table.grow()) return -1;
-            const uint64_t mask = table.cap - 1;
-            for (int64_t g = g0; g < ge; ++g) {
-                if (g + RCB < U_f)  // rep window bytes of the NEXT block
-                    __builtin_prefetch(codes + rep_of[g + RCB], 0, 1);
-                const uint8_t* w = codes + rep_of[g];
-                u128 rk = 0;
-                for (int32_t j = k - 1; j >= 0; --j) {
-                    const uint32_t c = ENC.t[w[j]];
-                    rk = rk * 5 + (c ? 5 - c : 0);  // complement: .<->., A<->T, C<->G
-                }
-                const uint64_t h = hash_key(rk);
-                rks[g - g0] = rk;
-                rhs[g - g0] = h;
-                __builtin_prefetch(&table.slots[h & mask], 0, 1);
-            }
-            for (int64_t g = g0; g < ge; ++g) {
-                const uint32_t g2 = table.upsert(rks[g - g0], rhs[g - g0],
-                                                 UINT32_MAX, keys);
-                if (static_cast<size_t>(g2) >= rc_of.size()) {
-                    rc_of.resize(g2 + 1, -1);
-                    rc_of[g2] = static_cast<int32_t>(g);
-                }
-                rc_of[g] = static_cast<int32_t>(g2);
-            }
-        }
-    }
-    const int64_t U = static_cast<int64_t>(keys.size());
-    pt.mark("B rc map");
-    state->U = U;
     table.slots.clear();
     table.slots.shrink_to_fit();
 
-    // ---- phase C: lexicographic ranks via top-bit buckets ----
-    std::vector<int32_t> lex_rank;
-    try { lex_rank.resize(U); } catch (...) { return -1; }
+    // ---- phase B+C: union ranks by sort-merge, no hashing ----
+    // The old phase B probed the table once per group to find/insert each
+    // group's reverse complement (random DRAM). rc keys now roll out of
+    // phase A for free, so the final id space — lexicographic ranks over
+    // the UNION of forward and rc keys — comes from two bucket sorts and
+    // one sequential merge. Both inputs are duplicate-free (the table
+    // dedupes forward keys; rc is injective), so each union key sees at
+    // most one entry from each side.
+    std::vector<int32_t> lex_rank, rc_rank;  // per provisional fwd gid
+    std::vector<uint32_t> rep_fwd, rep_rc;   // per final rank: source gids
+    int64_t U = 0;
     {
         u128 max_key = pow5k1 * 5 - 1;     // 5^k - 1
         int bitlen = 128;                  // shifts must stay < 128 (UB)
@@ -822,30 +811,53 @@ static int64_t occ_index_build_impl(const uint8_t* codes, int64_t n_codes,
         const int shift = bitlen > 20 ? bitlen - 20 : 0;
         const int64_t NB = static_cast<int64_t>((max_key >> shift)) + 2;
         struct KG { u128 key; uint32_t gid; };
-        std::vector<int64_t> bstart(NB + 1, 0);
-        std::vector<KG> sorted;
-        try { sorted.resize(U); } catch (...) { return -1; }
-        for (int64_t g = 0; g < U; ++g)
-            ++bstart[static_cast<int64_t>(keys[g] >> shift) + 1];
-        for (int64_t b = 0; b < NB; ++b) bstart[b + 1] += bstart[b];
-        std::vector<int64_t> cur(bstart.begin(), bstart.end() - 1);
-        for (int64_t g = 0; g < U; ++g) {
-            const int64_t b = static_cast<int64_t>(keys[g] >> shift);
-            sorted[cur[b]++] = KG{keys[g], static_cast<uint32_t>(g)};
-        }
-        for (int64_t b = 0; b < NB; ++b) {
-            std::sort(sorted.begin() + bstart[b], sorted.begin() + bstart[b + 1],
-                      [](const KG& a, const KG& c) { return a.key < c.key; });
-        }
-        for (int64_t r = 0; r < U; ++r) lex_rank[sorted[r].gid] = static_cast<int32_t>(r);
-        // reorder keys into rank order for the gram phase
+        std::vector<KG> sf, sr;
+        auto bucket_sort = [&](const std::vector<u128>& ks,
+                               std::vector<KG>& out) -> bool {
+            const int64_t n = static_cast<int64_t>(ks.size());
+            std::vector<int64_t> bstart(NB + 1, 0);
+            try { out.resize(n); } catch (...) { return false; }
+            for (int64_t g = 0; g < n; ++g)
+                ++bstart[static_cast<int64_t>(ks[g] >> shift) + 1];
+            for (int64_t b = 0; b < NB; ++b) bstart[b + 1] += bstart[b];
+            std::vector<int64_t> cur(bstart.begin(), bstart.end() - 1);
+            for (int64_t g = 0; g < n; ++g) {
+                const int64_t b = static_cast<int64_t>(ks[g] >> shift);
+                out[cur[b]++] = KG{ks[g], static_cast<uint32_t>(g)};
+            }
+            for (int64_t b = 0; b < NB; ++b) {
+                std::sort(out.begin() + bstart[b], out.begin() + bstart[b + 1],
+                          [](const KG& a, const KG& c) { return a.key < c.key; });
+            }
+            return true;
+        };
+        if (!bucket_sort(keys, sf) || !bucket_sort(rc_keys, sr)) return -1;
         std::vector<u128> ranked;
-        try { ranked.resize(U); } catch (...) { return -1; }
-        for (int64_t r = 0; r < U; ++r) ranked[r] = sorted[r].key;
-        keys.swap(ranked);
+        try {
+            lex_rank.resize(U_f);
+            rc_rank.resize(U_f);
+            ranked.reserve(2 * U_f);
+            rep_fwd.reserve(2 * U_f);
+            rep_rc.reserve(2 * U_f);
+        } catch (...) { return -1; }
+        size_t i = 0, j = 0;
+        while (i < sf.size() || j < sr.size()) {
+            const bool hf = i < sf.size(), hr = j < sr.size();
+            const u128 key = (hf && (!hr || sf[i].key <= sr[j].key))
+                ? sf[i].key : sr[j].key;
+            const int32_t r = static_cast<int32_t>(ranked.size());
+            ranked.push_back(key);
+            uint32_t gf = UINT32_MAX, gr = UINT32_MAX;
+            if (hf && sf[i].key == key) { lex_rank[sf[i].gid] = r; gf = sf[i].gid; ++i; }
+            if (hr && sr[j].key == key) { rc_rank[sr[j].gid] = r; gr = sr[j].gid; ++j; }
+            rep_fwd.push_back(gf);
+            rep_rc.push_back(gr);
+        }
+        U = static_cast<int64_t>(ranked.size());
+        keys.swap(ranked);                 // rank order for the gram phase
     }
-
-    pt.mark("C ranks");
+    state->U = U;
+    pt.mark("BC sort ranks");
 
     // ---- final per-group outputs: rev_kid, rep_byte + gram ids ----
     try {
@@ -854,24 +866,29 @@ static int64_t occ_index_build_impl(const uint8_t* codes, int64_t n_codes,
         state->prefix_gid.resize(U);
         state->suffix_gid.resize(U);
     } catch (...) { return -1; }
-    for (int64_t g = 0; g < U; ++g)
-        state->rev_kid[lex_rank[g]] = lex_rank[rc_of[g]];
+    // Both directions of the rc pairing; where a rank appears on both
+    // sides the two writes agree (rc is an involution on the union).
+    for (int64_t g = 0; g < U_f; ++g) {
+        state->rev_kid[lex_rank[g]] = rc_rank[g];
+        state->rev_kid[rc_rank[g]] = lex_rank[g];
+    }
 
     // representative byte offset per group: any occurrence's bytes are the
     // k-mer itself, so forward groups use their first-insert window and
     // rc-only groups use the reverse-strand mirror of their partner's window
     // (rev byte start = rev_off[s] + L-1-q for partner forward window q)
-    for (int64_t g = 0; g < U_f; ++g)
-        state->rep_byte[lex_rank[g]] = rep_of[g];
-    for (int64_t g = U_f; g < U; ++g) {
-        const int64_t partner = rc_of[g];
-        const int64_t rep = rep_of[partner];
+    for (int64_t r = 0; r < U; ++r) {
+        if (rep_fwd[r] != UINT32_MAX) {
+            state->rep_byte[r] = rep_of[rep_fwd[r]];
+            continue;
+        }
+        const int64_t rep = rep_of[rep_rc[r]];
         int64_t lo = 0, hi = S - 1;        // find the sequence containing rep
         while (lo < hi) {
             const int64_t mid = (lo + hi + 1) / 2;
             if (fwd_off[mid] <= rep) lo = mid; else hi = mid - 1;
         }
-        state->rep_byte[lex_rank[g]] =
+        state->rep_byte[r] =
             rev_off[lo] + (seq_len[lo] - 1 - (rep - fwd_off[lo]));
     }
 
