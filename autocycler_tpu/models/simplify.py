@@ -239,37 +239,39 @@ def get_exclusive_outputs(unitig: Unitig) -> List[UnitigStrand]:
 
 def _common_start_seq(unitigs: List[UnitigStrand]) -> np.ndarray:
     """Longest common prefix of the unitigs' strand-specific sequences
-    (reference graph_simplification.rs:283-295)."""
-    seqs = [u.get_seq() for u in unitigs]
-    if not seqs:
+    (reference graph_simplification.rs:283-295). Probes only a
+    min-length window of each strand (seq_prefix), never the full
+    reverse-strand sequence."""
+    if not unitigs:
         return np.zeros(0, np.uint8)
-    prefix_len = min(len(s) for s in seqs)
-    first = seqs[0]
-    for s in seqs[1:]:
-        limit = min(prefix_len, len(s))
-        neq = np.nonzero(first[:limit] != s[:limit])[0]
-        prefix_len = int(neq[0]) if len(neq) else limit
+    prefix_len = min(u.length() for u in unitigs)
+    first = unitigs[0].seq_prefix(prefix_len)
+    for u in unitigs[1:]:
         if prefix_len == 0:
             break
+        s = u.seq_prefix(prefix_len)
+        neq = np.nonzero(first[:prefix_len] != s)[0]
+        if len(neq):
+            prefix_len = int(neq[0])
     return first[:prefix_len].copy()
 
 
 def _common_end_seq(unitigs: List[UnitigStrand]) -> np.ndarray:
-    """Longest common suffix (reference graph_simplification.rs:298-312)."""
-    seqs = [u.get_seq() for u in unitigs]
-    if not seqs:
+    """Longest common suffix (reference graph_simplification.rs:298-312),
+    windowed like :func:`_common_start_seq`."""
+    if not unitigs:
         return np.zeros(0, np.uint8)
-    suffix_len = min(len(s) for s in seqs)
-    first = seqs[0]
-    for s in seqs[1:]:
-        limit = min(suffix_len, len(s))
-        a = first[len(first) - limit:]
-        b = s[len(s) - limit:]
-        neq = np.nonzero(a != b)[0]
-        suffix_len = limit - int(neq[-1]) - 1 if len(neq) else limit
+    suffix_len = min(u.length() for u in unitigs)
+    first = unitigs[0].seq_suffix(suffix_len)
+    for u in unitigs[1:]:
         if suffix_len == 0:
             break
-    return first[len(first) - suffix_len:].copy() if suffix_len else np.zeros(0, np.uint8)
+        s = u.seq_suffix(suffix_len)
+        neq = np.nonzero(first[len(first) - suffix_len:] != s)[0]
+        if len(neq):
+            suffix_len = suffix_len - int(neq[-1]) - 1
+    return (first[len(first) - suffix_len:].copy() if suffix_len
+            else np.zeros(0, np.uint8))
 
 
 # ---------------- linear-path merging ----------------

@@ -179,13 +179,17 @@ class Unitig:
         assert amount <= len(self.forward_seq)
         self.forward_positions.shift_pos(amount)
         self.forward_seq = self.forward_seq[amount:]
-        self._reverse_seq = None  # rederived lazily from the trimmed forward
+        if self._reverse_seq is not None:
+            # rc reverses order: trimming the forward START trims the
+            # reverse END, so a live cache survives as a slice
+            self._reverse_seq = self._reverse_seq[:len(self._reverse_seq) - amount]
 
     def remove_seq_from_end(self, amount: int) -> None:
         assert amount <= len(self.forward_seq)
         self.reverse_positions.shift_pos(amount)
         self.forward_seq = self.forward_seq[:len(self.forward_seq) - amount]
-        self._reverse_seq = None  # rederived lazily from the trimmed forward
+        if self._reverse_seq is not None:
+            self._reverse_seq = self._reverse_seq[amount:]
 
     def add_seq_to_start(self, seq: np.ndarray) -> None:
         self.forward_positions.shift_pos(-len(seq))
@@ -260,6 +264,31 @@ class UnitigStrand:
 
     def get_seq(self) -> np.ndarray:
         return self.unitig.get_seq(self.strand)
+
+    def seq_prefix(self, n: int) -> np.ndarray:
+        """First n symbols of the strand sequence. On the reverse strand
+        this reverse-complements only an n-symbol window of the forward
+        sequence instead of materialising the full reverse strand (repeat
+        expansion probes prefixes of multi-Mbp unitigs after every edit)."""
+        u = self.unitig
+        if self.strand:
+            return u.forward_seq[:n]
+        if u._reverse_seq is not None:
+            return u._reverse_seq[:n]
+        f = u.forward_seq
+        return reverse_complement_bytes(f[len(f) - n:]) if n else f[:0]
+
+    def seq_suffix(self, n: int) -> np.ndarray:
+        """Last n symbols of the strand sequence (windowed like
+        :meth:`seq_prefix`)."""
+        u = self.unitig
+        f = u.forward_seq
+        if self.strand:
+            return f[len(f) - n:] if n else f[:0]
+        if u._reverse_seq is not None:
+            r = u._reverse_seq
+            return r[len(r) - n:] if n else r[:0]
+        return reverse_complement_bytes(f[:n])
 
     def is_anchor(self) -> bool:
         return self.unitig.unitig_type is UnitigType.ANCHOR

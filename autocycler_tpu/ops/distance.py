@@ -61,6 +61,8 @@ def _tpu_attached() -> bool:
         print("autocycler: ignoring malformed AUTOCYCLER_DEVICE_PROBE_TIMEOUT",
               file=sys.stderr)
         timeout = 60.0
+    if timeout <= 0:       # explicit kill switch: host backends, no probe
+        return False
     result: List[bool] = []
 
     def probe() -> None:
@@ -76,7 +78,7 @@ def _tpu_attached() -> bool:
 
     t = threading.Thread(target=probe, daemon=True, name="tpu-probe")
     t.start()
-    t.join(timeout if timeout > 0 else None)  # <= 0: no deadline (wait)
+    t.join(timeout)
     if not result:
         print(f"autocycler: device probe did not respond within {timeout:.0f}s; "
               "falling back to host backends", file=sys.stderr)

@@ -43,10 +43,13 @@ def unitig_graph_from_chains(index: KmerIndex, chains: Chains) -> UnitigGraph:
     # byte i of a chain is the head window byte h+i while h+i < k and the
     # last byte of member i-h after that
     slot = np.arange(len(members_all), dtype=np.int64)
-    chain_of_slot = np.repeat(np.arange(C, dtype=np.int64), sizes)
-    pos_ic = slot - chain_off[chain_of_slot]
+    # per-slot chain attributes come from np.repeat (sequential writes) —
+    # measurably cheaper than materialising chain_of_slot and gathering
+    # C-sized arrays through it
+    pos_ic = slot - np.repeat(chain_off[:-1], sizes)
     from_head = pos_ic <= h
-    head_byte_idx = index.rep_byte[heads[chain_of_slot]] + h + np.minimum(pos_ic, h)
+    head_byte_idx = (np.repeat(index.rep_byte[heads] + h, sizes)
+                     + np.minimum(pos_ic, h))
     tail_byte = last_byte[members_all[np.maximum(slot - h, 0)]]
     seq_bytes = np.where(from_head, index.buf[head_byte_idx], tail_byte)
 

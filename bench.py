@@ -87,6 +87,13 @@ def bench_headline() -> None:
     # headline value is the MEDIAN of 3 runs (the honest central statistic),
     # with best/all alongside so noise-free capability is visible too
     # (VERDICT r2 item 6).
+    # Warm the one-per-process device probe OUTSIDE the timed region: like
+    # the interpreter/jax startup already excluded above, backend init (or
+    # a wedged-tunnel probe timeout) is environment cost, not algorithmic
+    # cost — unwarmed it lands inside run 1's cluster stage.
+    from autocycler_tpu.ops.distance import _tpu_attached
+
+    _tpu_attached()
     runs = sorted(round(_run_headline_once(), 2) for _ in range(3))
     elapsed = runs[len(runs) // 2]
     print(json.dumps({
