@@ -1,0 +1,92 @@
+"""The timed device probe gating the default-on device path
+(ops/distance.py:_tpu_attached).
+
+A tunnelled TPU can wedge so that every device call blocks forever
+(observed on the axon link; docs/architecture.md "Measured environment
+quirks"), so the product path must degrade to the bit-identical host
+matmul — loudly — instead of hanging. These tests pin the three
+fallback behaviours without needing a device: the conftest pins
+JAX_PLATFORMS=cpu, which the probe short-circuits on.
+"""
+
+import io
+import sys
+
+import numpy as np
+import pytest
+
+
+def _fresh_probe():
+    from autocycler_tpu.ops import distance
+
+    distance._tpu_attached.cache_clear()
+    return distance._tpu_attached
+
+
+def test_pinned_cpu_short_circuits(monkeypatch):
+    """Tests run with JAX_PLATFORMS=cpu: no probe thread, immediate False."""
+    probe = _fresh_probe()
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert probe() is False
+
+
+def test_kill_switch_skips_probe(monkeypatch, capsys):
+    """Timeout <= 0 is an explicit host-backends switch — no thread, no
+    message, False even if a TPU were attached."""
+    probe = _fresh_probe()
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")  # would reach the probe
+    monkeypatch.setenv("AUTOCYCLER_DEVICE_PROBE_TIMEOUT", "0")
+    assert probe() is False
+
+
+def test_malformed_timeout_warns_and_defaults(monkeypatch, capsys):
+    """A malformed timeout warns and falls back to the default instead of
+    crashing. jax is already initialised on the pinned CPU backend in this
+    process, so the real probe thread answers False immediately."""
+    probe = _fresh_probe()
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")  # reach the env parse
+    monkeypatch.setenv("AUTOCYCLER_DEVICE_PROBE_TIMEOUT", "banana")
+    assert probe() is False
+    assert "malformed" in capsys.readouterr().err
+
+
+def test_unresponsive_probe_falls_back_with_message(monkeypatch, capsys):
+    """A probe that never answers within the deadline must fall back to
+    host with a stderr note — the wedged-tunnel scenario, simulated by a
+    probe thread that blocks."""
+    from autocycler_tpu.ops import distance
+
+    probe = _fresh_probe()
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    monkeypatch.setenv("AUTOCYCLER_DEVICE_PROBE_TIMEOUT", "0.05")
+
+    import threading
+
+    real_thread = threading.Thread
+
+    class HangingThread(real_thread):
+        def __init__(self, *a, **kw):
+            kw["target"] = lambda: threading.Event().wait(5)
+            super().__init__(*a, **kw)
+
+    monkeypatch.setattr(threading, "Thread", HangingThread)
+    assert probe() is False
+    assert "did not respond" in capsys.readouterr().err
+
+
+def test_probe_failure_keeps_host_matmul_exact():
+    """With the probe answering False, pairwise distances use the host
+    matmul and stay exact — the degraded mode is bit-identical, not
+    approximate."""
+    from autocycler_tpu.ops import distance
+
+    rng = np.random.default_rng(0)
+    M = (rng.random((6, 40)) < 0.4).astype(np.uint8)
+    w = rng.integers(1, 50, size=40).astype(np.int64)
+    inter = (M.astype(np.int64) * w[None, :]) @ M.astype(np.int64).T
+    got = distance._intersections_to_matrix(inter.astype(np.float64))
+    expect = np.zeros((6, 6))
+    for a in range(6):
+        for b in range(6):
+            expect[a, b] = 1.0 - inter[a, b] / inter[a, a]
+    assert np.allclose(got, expect)
