@@ -182,19 +182,27 @@ def dispatch(args) -> None:
              args.threads)
 
 
-def main(argv=None) -> int:
-    # The unitig graph is reference-cyclic (next/prev adjacency lists), so
-    # generational cycle collection repeatedly traverses millions of live
-    # graph objects mid-stage for nothing — measured at >20% of pipeline
-    # wall time on the headline config. Each subcommand is one bounded
-    # process; reference counting handles everything acyclic and the OS
-    # reclaims the rest at exit.
-    import gc
-    gc.disable()
+# Subcommands that build the reference-cyclic unitig graph (next/prev
+# adjacency lists): generational cycle collection repeatedly traverses
+# millions of live graph objects mid-stage for nothing — measured at >20% of
+# pipeline wall time on the headline config. Each is one bounded process;
+# reference counting handles everything acyclic and the OS reclaims the rest
+# at exit. `helper` (8-hour assembler subprocess loops) and the other
+# non-graph subcommands keep the collector ON — they are long-lived or
+# allocation-light, so the disable would be all risk and no win.
+GC_DISABLED_COMMANDS = frozenset({
+    "compress", "cluster", "trim", "resolve", "combine", "clean",
+    "decompress", "dotplot", "gfa2fasta", "batch",
+})
 
+
+def main(argv=None) -> int:
     print(BANNER, file=sys.stderr)
     parser = build_parser()
     args = parser.parse_args(argv)
+    if args.command in GC_DISABLED_COMMANDS:
+        import gc
+        gc.disable()
     try:
         dispatch(args)
     except AutocyclerError as e:

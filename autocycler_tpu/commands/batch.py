@@ -100,9 +100,10 @@ def batch(assemblies_parent, out_parent, k_size: int = 51,
     log.explanation("Every isolate's trim overlap DPs (start-end + both hairpin "
                     "passes for every sequence of every QC-pass cluster) are screened "
                     "in ONE batched device DP — the vmapped right-edge recurrence; "
-                    "only sequences the screen proves could align run the full host "
-                    "DP + traceback, so the final graphs are bitwise identical to "
-                    "sequential trim.")
+                    "screened-positive sequences then get their full alignment "
+                    "decoded from the device DP's packed traceback bits, so the "
+                    "host never re-runs the DP and the final graphs are bitwise "
+                    "identical to sequential trim.")
     cluster_dirs = []
     for iso in isolates:
         qc_pass = out_parent / iso.name / "clustering" / "qc_pass"
@@ -110,9 +111,12 @@ def batch(assemblies_parent, out_parent, k_size: int = 51,
             cluster_dirs.extend(sorted(d for d in qc_pass.iterdir()
                                        if d.is_dir()))
     screens, graphs = _batched_trim_screens(cluster_dirs, mesh=mesh)
-    n_dp = sum(v for s in screens.values() for v in s.values())
     n_all = sum(len(s) for s in screens.values())
-    log.message(f"{n_all} trim DPs screened; {n_dp} need the full host DP")
+    n_dev = sum(isinstance(v, list) for s in screens.values()
+                for v in s.values())
+    n_host = sum(v is True for s in screens.values() for v in s.values())
+    log.message(f"{n_all} trim DPs screened; {n_dev} alignments decoded from "
+                f"the device traceback; {n_host} need the full host DP")
     log.message()
 
     for cdir in cluster_dirs:
@@ -133,18 +137,23 @@ def batch(assemblies_parent, out_parent, k_size: int = 51,
     log.message()
 
 
-def _batched_trim_screens(cluster_dirs, max_unitigs: int = 5000, mesh=None):
+def _batched_trim_screens(cluster_dirs, max_unitigs: int = 5000, mesh=None,
+                          min_identity: float = 0.75):
     """One batched screen call covering every (sequence, trim kind) of every
-    cluster; returns {cluster_dir: {(seq_id, kind): bool}}. With a mesh the
-    jobs shard over every device (parallel.batch.sharded_overlap_screen).
-    Job construction mirrors trim_path_start_end / trim_path_hairpin_*
-    (trim.rs:288-326): start_end aligns path vs itself off-diagonal,
-    hairpin_start aligns path vs its signed reverse, hairpin_end the
-    mirror."""
+    cluster, then ONE device traceback pass for the screened-positive jobs;
+    returns {cluster_dir: {(seq_id, kind): False | alignment pieces}}. With
+    a mesh the screen shards over every device
+    (parallel.batch.sharded_overlap_screen). Job construction mirrors
+    trim_path_start_end / trim_path_hairpin_* (trim.rs:288-326): start_end
+    aligns path vs itself off-diagonal, hairpin_start aligns path vs its
+    signed reverse, hairpin_end the mirror. Screened-positive jobs get their
+    full alignment decoded from the device DP's packed direction bits
+    (ops.align.overlap_tracebacks_batch) — the host never re-runs the DP;
+    jobs outside the int32 traceback domain stay True (host DP in trim)."""
     import numpy as np
 
     from ..models import UnitigGraph
-    from ..ops.align import overlap_positive_batch
+    from ..ops.align import overlap_positive_batch, overlap_tracebacks_batch
     from ..parallel.batch import sharded_overlap_screen
     from ..utils import reverse_signed_path
 
@@ -170,7 +179,14 @@ def _batched_trim_screens(cluster_dirs, max_unitigs: int = 5000, mesh=None):
             keys.append((cdir, seq.id, "hairpin_end"))
     verdicts = sharded_overlap_screen(mesh, jobs, max_unitigs) \
         if mesh is not None else overlap_positive_batch(jobs, max_unitigs)
+    pos_idx = [i for i, v in enumerate(verdicts) if v]
+    decoded = overlap_tracebacks_batch([jobs[i] for i in pos_idx],
+                                       max_unitigs, min_identity)
     screens = {cdir: {} for cdir in cluster_dirs}
     for (cdir, seq_id, kind), v in zip(keys, verdicts):
         screens[cdir][(seq_id, kind)] = bool(v)
+    for i, pieces in zip(pos_idx, decoded):
+        cdir, seq_id, kind = keys[i]
+        if pieces is not None:
+            screens[cdir][(seq_id, kind)] = pieces
     return screens, graphs

@@ -16,6 +16,8 @@ import shutil
 from pathlib import Path
 from typing import Dict, List, Optional, Tuple
 
+import numpy as np
+
 from ..metrics import ClusteringMetrics, UntrimmedClusterMetrics
 from ..models import Sequence, UnitigGraph
 from ..models.simplify import merge_linear_paths
@@ -140,56 +142,104 @@ class TreeNode:
 def upgma(distances: Dict[Tuple[int, int], float], sequences: List[Sequence]) -> TreeNode:
     """UPGMA over the symmetric distance map; merged clusters keep the id
     min(a, b); internal node ids count up from the largest sequence id; ties
-    broken by the first pair in sorted-id order (reference cluster.rs:395-458)."""
-    clusters: Dict[int, set] = {s.id: {s.id} for s in sequences}
-    cluster_distances = dict(distances)
-    nodes: Dict[int, TreeNode] = {s.id: TreeNode(s.id) for s in sequences}
-    internal_node_num = max(s.id for s in sequences)
+    broken by the first pair in sorted-id order (reference cluster.rs:395-458).
 
-    while len(clusters) > 1:
-        a, b, a_b_distance = _get_closest_pair(cluster_distances)
-        cluster_a = clusters.pop(a)
-        cluster_b = clusters.pop(b)
-        new_id = min(a, b)
-        new_cluster = cluster_a | cluster_b
-        clusters[new_id] = new_cluster
+    The reference (and the previous implementation here) re-scans a dict of
+    pair distances per merge — O(n³) with heavy constants. This wraps the
+    O(n²) matrix implementation below; the closest-pair tie-break (smallest
+    id pair in sorted order) is preserved. Inter-cluster averages are the
+    same sums of ORIGINAL pair distances divided once, accumulated in merge
+    order rather than flat order — mathematically identical, so only exact
+    float ties between candidate pairs could resolve differently (the
+    previous dict implementation summed in unordered set-iteration order,
+    so it made no stronger guarantee).
+    """
+    ids = sorted(s.id for s in sequences)
+    n = len(ids)
+    pos = {a: i for i, a in enumerate(ids)}
+    D = np.zeros((n, n))
+    if distances:
+        # one vectorised pass over the dict (the wrapper must not
+        # reintroduce an O(n²) Python-loop constant at the 32k-sequence cap)
+        keys = np.array([(pos[a], pos[b]) for a, b in distances], np.int64)
+        vals = np.fromiter(distances.values(), np.float64, len(distances))
+        D[keys[:, 0], keys[:, 1]] = vals
+        D = np.maximum(D, D.T)   # fills any one-directional entries
+    return upgma_matrix(D, ids)
+
+
+def upgma_matrix(D: np.ndarray, ids: List[int]) -> TreeNode:
+    """O(n²) UPGMA over a dense symmetric distance matrix (row/col order =
+    ascending cluster ids). Cluster-to-cluster distance is the mean of the
+    ORIGINAL member-pair distances, maintained as exact pair-sums merged
+    additively; the closest pair is the row-major-first minimum (identical
+    tie-break to scanning pairs in sorted-id order). Per merge only the
+    merged row/column and invalidated row-minima are recomputed."""
+    n = len(ids)
+    if n == 1:
+        return TreeNode(ids[0])
+    S = np.asarray(D, dtype=np.float64).copy()  # pair-distance sums
+    size = np.ones(n, dtype=np.int64)
+    active = np.ones(n, dtype=bool)
+    nodes: Dict[int, TreeNode] = {i: TreeNode(ids[i]) for i in range(n)}
+    internal_node_num = max(ids)
+    INF = np.inf
+
+    # rowmin[i] = min over active j>i of avg(i, j); rowarg[i] = smallest such j
+    def avg_row(i: int) -> np.ndarray:
+        return S[i] / (size[i] * size)
+
+    rowmin = np.full(n, INF)
+    rowarg = np.full(n, -1, dtype=np.int64)
+
+    def recompute_row(i: int) -> None:
+        vals = avg_row(i)
+        vals = np.where(active, vals, INF)
+        vals[:i + 1] = INF
+        j = int(np.argmin(vals))
+        rowmin[i], rowarg[i] = vals[j], j
+
+    for i in range(n):
+        recompute_row(i)
+
+    for _ in range(n - 1):
+        a = int(np.argmin(rowmin))       # first occurrence = smallest id pair
+        b = int(rowarg[a])
+        pair_distance = float(rowmin[a])  # plain float: numpy scalars would
+        #                                   leak np.float64 reprs into YAML/TSV
 
         internal_node_num += 1
-        nodes[new_id] = TreeNode(internal_node_num, nodes.pop(a), nodes.pop(b),
-                                 a_b_distance / 2.0)
+        nodes[a] = TreeNode(internal_node_num, nodes.pop(a), nodes.pop(b),
+                            pair_distance / 2.0)
 
-        new_distances = {}
-        for (x, y), dist in cluster_distances.items():
-            if x in clusters and y in clusters:
-                new_distances[(x, y)] = dist
-        for other_id, other_members in clusters.items():
-            if other_id == new_id:
-                continue
-            total, count = 0.0, 0
-            for id1 in new_cluster:
-                for id2 in other_members:
-                    d = distances.get((id1, id2), distances.get((id2, id1)))
-                    total += d
-                    count += 1
-            avg = total / count
-            new_distances[(new_id, other_id)] = avg
-            new_distances[(other_id, new_id)] = avg
-        cluster_distances = new_distances
+        # merge b into a: sums add exactly; sizes add
+        S[a] += S[b]
+        S[:, a] = S[a]
+        size[a] += size[b]
+        active[b] = False
+        rowmin[b] = INF
+
+        if len(nodes) == 1:
+            break
+
+        # rows i<a: column a changed, column b vanished
+        lo = np.flatnonzero(active[:a])
+        if len(lo):
+            newvals = S[lo, a] / (size[lo] * size[a])
+            improve = (newvals < rowmin[lo]) | \
+                ((newvals == rowmin[lo]) & (a < rowarg[lo]))
+            rowmin[lo[improve]] = newvals[improve]
+            rowarg[lo[improve]] = a
+            stale = lo[~improve]
+            for i in stale[np.isin(rowarg[stale], (a, b))]:
+                recompute_row(int(i))
+        # rows a<i<b that pointed at b
+        mid = np.flatnonzero(active[a + 1:b]) + a + 1
+        for i in mid[rowarg[mid] == b]:
+            recompute_row(int(i))
+        recompute_row(a)
 
     return next(iter(nodes.values()))
-
-
-def _get_closest_pair(distances: Dict[Tuple[int, int], float]) -> Tuple[int, int, float]:
-    unique_keys = sorted({k for pair in distances for k in pair})
-    min_distance = float("inf")
-    closest = (0, 0)
-    for i, a in enumerate(unique_keys):
-        for b in unique_keys[i + 1:]:
-            d = distances.get((a, b), distances.get((b, a)))
-            if d is not None and d < min_distance:
-                min_distance = d
-                closest = (a, b)
-    return closest[0], closest[1], min_distance
 
 
 def normalise_tree(root: TreeNode) -> None:

@@ -161,8 +161,10 @@ def _device_match_pair(a_words: np.ndarray, b_words: np.ndarray, tile: int = 204
     an exact numpy equality block (matches are sparse — diagonals — so the
     refinement touches a vanishing fraction of the grid)."""
     from ..ops.dotplot_pallas import match_grid
+    from ..utils.timing import device_dispatch
 
-    tiles = np.asarray(match_grid(a_words, b_words, tile_a=tile, tile_b=tile))
+    with device_dispatch("dotplot match grid"):
+        tiles = np.asarray(match_grid(a_words, b_words, tile_a=tile, tile_b=tile))
     iis: List[np.ndarray] = []
     jjs: List[np.ndarray] = []
     W = a_words.shape[0]

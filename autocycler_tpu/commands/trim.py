@@ -26,15 +26,30 @@ from ..utils import (check_threads, log, mad as mad_fn, map_threaded, median,
 TrimResult = Optional[Tuple[List[int], int]]
 
 
+def screen_decision(dp_screen, seq_id: int, kind: str
+                    ) -> Tuple[bool, Optional[list]]:
+    """Decode one dp_screen entry into (skip, precomputed_alignment).
+    Protocol: False or [] → the DP provably/already returned no alignment
+    (skip); a non-empty list → alignment pieces decoded from the device DP's
+    packed traceback (use directly); True or absent → run the host DP."""
+    value = True if dp_screen is None else dp_screen.get((seq_id, kind), True)
+    if value is False or value == []:
+        return True, None
+    return False, value if isinstance(value, list) else None
+
+
 def trim(cluster_dir, min_identity: float = 0.75, max_unitigs: int = 5000,
          mad: float = 5.0, threads: int = 1, dp_screen=None,
          preloaded=None) -> Tuple[UnitigGraph, List[Sequence]]:
-    """dp_screen: optional {(seq_id, kind): bool} where kind is 'start_end',
-    'hairpin_start' or 'hairpin_end' — False means a batched exact screen
-    (ops.align.overlap_positive_batch) proved that DP returns no alignment,
-    so it is skipped. `autocycler batch` screens every isolate's DPs in one
-    device dispatch and passes the verdicts here; results are bitwise
-    identical to an unscreened run.
+    """dp_screen: optional {(seq_id, kind): value} where kind is 'start_end',
+    'hairpin_start' or 'hairpin_end'. value False means a batched exact
+    screen (ops.align.overlap_positive_batch) proved that DP returns no
+    alignment, so it is skipped; a list means the DEVICE already ran the DP
+    and the decoded alignment pieces are used directly (an empty list = the
+    device DP found no qualifying alignment); True/absent runs the host DP.
+    `autocycler batch` screens every isolate's DPs in one device dispatch
+    and decodes positives from the device's packed traceback bits; results
+    are bitwise identical to an unscreened run.
     preloaded: optional (graph, sequences) already parsed from
     1_untrimmed.gfa (batch parses it for screen-job construction and hands
     it over instead of re-reading the file)."""
@@ -103,10 +118,12 @@ def trim_start_end_overlap(graph: UnitigGraph, sequences: List[Sequence],
         all_paths = graph.get_unitig_paths_for_sequences([s.id for s in sequences])
 
     def one(seq: Sequence) -> TrimResult:
-        if dp_screen is not None and not dp_screen.get((seq.id, "start_end"), True):
+        skip, pre = screen_decision(dp_screen, seq.id, "start_end")
+        if skip:
             return None
         path = [n if s else -n for n, s in all_paths[seq.id]]
-        trimmed = trim_path_start_end(path, weights, min_identity, max_unitigs)
+        trimmed = trim_path_start_end(path, weights, min_identity,
+                                      max_unitigs, precomputed=pre)
         if trimmed is None:
             return None
         return trimmed, sum(weights[abs(u)] for u in trimmed)
@@ -133,24 +150,26 @@ def trim_hairpin_overlap(graph: UnitigGraph, sequences: List[Sequence],
     if all_paths is None:
         all_paths = graph.get_unitig_paths_for_sequences([s.id for s in sequences])
 
-    def screened_out(seq_id: int, kind: str) -> bool:
-        return dp_screen is not None and not dp_screen.get((seq_id, kind), True)
-
     def one(seq: Sequence):
         path = [n if s else -n for n, s in all_paths[seq.id]]
         trimmed_start = trimmed_end = False
-        p2 = None if screened_out(seq.id, "hairpin_start") else \
-            trim_path_hairpin_start(path, weights, min_identity, max_unitigs)
+        skip_s, pre_s = screen_decision(dp_screen, seq.id, "hairpin_start")
+        p2 = None if skip_s else \
+            trim_path_hairpin_start(path, weights, min_identity, max_unitigs,
+                                    precomputed=pre_s)
         if p2 is not None:
             trimmed_start = True
         else:
             p2 = list(path)
-        # the hairpin_end screen was computed on the ORIGINAL path; it only
-        # applies when hairpin_start left the path unchanged
-        if not trimmed_start and screened_out(seq.id, "hairpin_end"):
+        # the hairpin_end screen/traceback was computed on the ORIGINAL
+        # path; it only applies when hairpin_start left the path unchanged
+        skip_e, pre_e = screen_decision(dp_screen, seq.id, "hairpin_end")
+        if not trimmed_start and skip_e:
             p3 = None
         else:
-            p3 = trim_path_hairpin_end(p2, weights, min_identity, max_unitigs)
+            p3 = trim_path_hairpin_end(
+                p2, weights, min_identity, max_unitigs,
+                precomputed=pre_e if not trimmed_start else None)
         if p3 is not None:
             trimmed_end = True
         else:
@@ -242,10 +261,15 @@ def clean_up_graph(graph: UnitigGraph, sequences: List[Sequence]) -> None:
 # ---------------- path-level trimming ----------------
 
 def trim_path_start_end(path: List[int], weights: Weights, min_identity: float,
-                        max_unitigs: int) -> Optional[List[int]]:
+                        max_unitigs: int,
+                        precomputed: Optional[list] = None
+                        ) -> Optional[List[int]]:
     """Detect a start-end overlap by aligning the path against itself (off-
-    diagonal) and cut at the weighted midpoint (reference trim.rs:288-296)."""
-    alignment = overlap_alignment(path, path, weights, min_identity, max_unitigs, True)
+    diagonal) and cut at the weighted midpoint (reference trim.rs:288-296).
+    ``precomputed``: alignment pieces already decoded from the device DP's
+    packed traceback (ops.align.overlap_tracebacks_batch)."""
+    alignment = precomputed if precomputed is not None else \
+        overlap_alignment(path, path, weights, min_identity, max_unitigs, True)
     if not alignment:
         return None
     midpoint = find_midpoint(alignment, weights)
@@ -255,13 +279,17 @@ def trim_path_start_end(path: List[int], weights: Weights, min_identity: float,
 
 
 def trim_path_hairpin_end(path: List[int], weights: Weights,
-                          min_identity: float, max_unitigs: int
+                          min_identity: float, max_unitigs: int,
+                          precomputed: Optional[list] = None
                           ) -> Optional[List[int]]:
     """Detect a hairpin overlap at the path end by aligning the reverse path
-    against the path (reference trim.rs:299-317)."""
+    against the path (reference trim.rs:299-317). ``precomputed``: device-
+    decoded pieces for the (reverse path, path) alignment; the walk below
+    pops pieces, so a copy is taken."""
     rev_path = reverse_signed_path(path)
-    alignment = overlap_alignment(rev_path, path, weights, min_identity, max_unitigs,
-                                  False)
+    alignment = list(precomputed) if precomputed is not None else \
+        overlap_alignment(rev_path, path, weights, min_identity, max_unitigs,
+                          False)
     if not alignment:
         return None
     end = 0
@@ -283,12 +311,16 @@ def trim_path_hairpin_end(path: List[int], weights: Weights,
 
 
 def trim_path_hairpin_start(path: List[int], weights: Weights,
-                            min_identity: float, max_unitigs: int
+                            min_identity: float, max_unitigs: int,
+                            precomputed: Optional[list] = None
                             ) -> Optional[List[int]]:
     """Hairpin trim at the path start = hairpin-end trim of the reverse path
-    (reference trim.rs:320-326)."""
+    (reference trim.rs:320-326). ``precomputed`` is the device-decoded
+    (path, reverse path) alignment — exactly what the inner hairpin-end call
+    computes for the reverse path."""
     rev_path = reverse_signed_path(path)
-    trimmed = trim_path_hairpin_end(rev_path, weights, min_identity, max_unitigs)
+    trimmed = trim_path_hairpin_end(rev_path, weights, min_identity,
+                                    max_unitigs, precomputed=precomputed)
     if trimmed is None:
         return None
     return reverse_signed_path(trimmed)

@@ -269,8 +269,13 @@ class UnitigStrand:
         """First n symbols of the strand sequence. On the reverse strand
         this reverse-complements only an n-symbol window of the forward
         sequence instead of materialising the full reverse strand (repeat
-        expansion probes prefixes of multi-Mbp unitigs after every edit)."""
+        expansion probes prefixes of multi-Mbp unitigs after every edit).
+
+        Contract: n <= length(). The windowed reverse-strand slice would
+        silently wrap on a larger n, so it is asserted rather than clamped.
+        """
         u = self.unitig
+        assert n <= u.length(), (n, u.length())
         if self.strand:
             return u.forward_seq[:n]
         if u._reverse_seq is not None:
@@ -280,8 +285,9 @@ class UnitigStrand:
 
     def seq_suffix(self, n: int) -> np.ndarray:
         """Last n symbols of the strand sequence (windowed like
-        :meth:`seq_prefix`)."""
+        :meth:`seq_prefix`; same n <= length() contract)."""
         u = self.unitig
+        assert n <= u.length(), (n, u.length())
         f = u.forward_seq
         if self.strand:
             return f[len(f) - n:] if n else f[:0]

@@ -166,8 +166,16 @@ def overlap_alignment(path_a: Sequence[int], path_b: Sequence[int],
     max_score = right_edge[max_i]
     if not max_score > 0.0:
         return []
+    return _traceback_and_identity(pa, pb, n, k, max_i, up_ge, weights,
+                                   min_identity)
 
-    # traceback (reference trim.rs:426-461)
+
+def _traceback_and_identity(pa, pb, n: int, k: int, max_i: int, up_ge,
+                            weights: Weights, min_identity: float
+                            ) -> List[AlignmentPiece]:
+    """Traceback from (max_i, k) to the top edge plus the identity gate
+    (reference trim.rs:426-475) — shared by the host DPs and the device
+    packed-bits decode."""
     pieces: List[AlignmentPiece] = []
     i, j = max_i, k
     while i > 0 and j > 0:
@@ -256,13 +264,14 @@ def pack_overlap_jobs(jobs, max_unitigs: int, pad_to: int = 1):
     }, P
 
 
-def overlap_screen_scores(arrs):
-    """Pure-jnp kernel: packed job arrays -> doubled best right-edge score
-    per job ([P] int32). The vmapped form of the single overlap DP — the
-    same recurrence, one lax.scan over rows, scores doubled so everything is
-    integer and exact in int32; values clamp at a sentinel far below any
-    reachable score, which cannot change any comparison against 0. Jittable
-    and shard_map-able along axis 0 (jobs are independent)."""
+def _overlap_screen_scan(arrs, emit_traceback: bool):
+    """Shared lax.scan body for the batched overlap DP. With
+    ``emit_traceback`` False returns the doubled best right-edge score per
+    job ([P] int32); with True additionally stacks, per DP row i=1..K, the
+    right-edge score ([K, P] int32) and the packed up_ge direction bits
+    ([K, P, W] uint32, bit j-1 of row i = S[i-1][j] >= S[i][j-1]) — enough
+    for the host to run the traceback without re-running the DP
+    (reference trim.rs:426-461)."""
     import jax
     import jax.numpy as jnp
 
@@ -270,6 +279,7 @@ def overlap_screen_scores(arrs):
     WAd, WCd, Wc2 = arrs["WA"], arrs["WC"], arrs["Wc2"]
     k_j, jd_off, skip_j, vcol = arrs["k"], arrs["jd_off"], arrs["skip"], arrs["vcol"]
     P, K = A32.shape
+    W = (K + 31) // 32          # packed words per row (bits j = 1..K)
 
     def seg_cummax(X, boundary):
         """Segmented running max along axis 1: positions where boundary is
@@ -282,6 +292,7 @@ def overlap_screen_scores(arrs):
         return out
 
     idx = jnp.arange(K + 1)[None, :]             # X index = column number
+    shift = jnp.arange(32, dtype=jnp.uint32)[None, None, :]
 
     def step(carry, i):
         prev, best = carry
@@ -307,7 +318,17 @@ def overlap_screen_scores(arrs):
         edge = jnp.take_along_axis(row, k_j[:, None].astype(jnp.int32),
                                    axis=1)[:, 0]
         best = jnp.maximum(best, jnp.where(active, edge, _NEG_BIG))
-        return (row, best), None
+        if not emit_traceback:
+            return (row, best), None
+        # up_ge bit for column j (1..K): prev row's cell j vs this row's
+        # cell j-1; clamping keeps "effectively -inf" cells equal on both
+        # sides, so the comparison matches the f64 DP whenever true scores
+        # stay above the sentinel (guarded by traceback_in_domain)
+        ge = prev[:, 1:] >= row[:, :-1]                       # [P, K]
+        ge = jnp.pad(ge, ((0, 0), (0, W * 32 - K)))
+        packed = (ge.reshape(P, W, 32).astype(jnp.uint32) << shift).sum(
+            axis=-1, dtype=jnp.uint32)
+        return (row, best), (edge, packed)
 
     # initial carry derived from the inputs (k_j * 0) so that under
     # shard_map it carries the same varying-manual-axes type as the body's
@@ -315,9 +336,28 @@ def overlap_screen_scores(arrs):
     zero_row = (k_j * 0)[:, None]
     prev0 = jnp.zeros((P, K + 1), jnp.int32) + zero_row   # row 0: all zeros
     best0 = jnp.full(P, _NEG_BIG, jnp.int32) + zero_row[:, 0]
-    (_, best), _ = jax.lax.scan(step, (prev0, best0),
-                                jnp.arange(1, K + 1, dtype=jnp.int32))
-    return best
+    (_, best), ys = jax.lax.scan(step, (prev0, best0),
+                                 jnp.arange(1, K + 1, dtype=jnp.int32))
+    if not emit_traceback:
+        return best
+    edges, bits = ys
+    return best, edges, bits
+
+
+def overlap_screen_scores(arrs):
+    """Pure-jnp kernel: packed job arrays -> doubled best right-edge score
+    per job ([P] int32). The vmapped form of the single overlap DP — the
+    same recurrence, one lax.scan over rows, scores doubled so everything is
+    integer and exact in int32; values clamp at a sentinel far below any
+    reachable score, which cannot change any comparison against 0. Jittable
+    and shard_map-able along axis 0 (jobs are independent)."""
+    return _overlap_screen_scan(arrs, emit_traceback=False)
+
+
+def overlap_screen_traceback(arrs):
+    """(best [P], edges [K, P], bits [K, P, W]) — the screen plus packed
+    traceback direction bits (see _overlap_screen_scan)."""
+    return _overlap_screen_scan(arrs, emit_traceback=True)
 
 
 def overlap_positive_batch(jobs, max_unitigs: int) -> np.ndarray:
@@ -335,8 +375,116 @@ def overlap_positive_batch(jobs, max_unitigs: int) -> np.ndarray:
     if packed is None:
         return np.zeros(len(jobs), bool)
     arrs, P = packed
-    best = np.asarray(jax.jit(overlap_screen_scores)(arrs))
+    from ..utils.timing import device_dispatch
+    with device_dispatch("trim overlap screen"):
+        best = np.asarray(jax.jit(overlap_screen_scores)(arrs))
     return best[:P] > 0
+
+
+def traceback_in_domain(job, max_unitigs: int) -> bool:
+    """Whether the int32 device DP's sentinel clamp is provably inert for
+    this job's TRACEBACK (not just the sign of the best score): every true
+    doubled score is bounded below by -2·(weight(A window) + weight(B
+    window)), so as long as that bound stays above the sentinel, clamped
+    cells are exactly the -inf cells of the f64 DP and every up_ge
+    comparison matches. Jobs beyond the bound (≈ 67 Mbp of combined window
+    weight) fall back to the host DP."""
+    path_a, path_b, weights, _ = job
+    n, k, _, _, wa, wcol = _overlap_windows(path_a, path_b, weights, max_unitigs)
+    return 2 * int(wa.sum() + wcol.sum()) < -_NEG_BIG
+
+
+def decode_overlap_alignment(path_a, path_b, weights: Weights,
+                             min_identity: float, max_unitigs: int,
+                             edges_col: np.ndarray, bits_col: np.ndarray
+                             ) -> List[AlignmentPiece]:
+    """Host-side decode of the device DP's packed traceback for ONE job:
+    pick the best right-edge row (smallest row wins ties, like
+    overlap_alignment), walk the packed up_ge bits, apply the top-edge and
+    identity gates. Returns the same pieces overlap_alignment would.
+
+    edges_col: [>=k] doubled right-edge scores for rows 1..k;
+    bits_col: [>=k, W] packed up_ge words for rows 1..k (bit j-1 = up_ge at
+    column j)."""
+    n = len(path_a)
+    k = min(max_unitigs, n)
+    if k == 0:
+        return []
+    pa = np.asarray(path_a, dtype=np.int64)
+    pb = np.asarray(path_b, dtype=np.int64)
+    max_i = int(np.argmax(edges_col[:k])) + 1
+    if not int(edges_col[max_i - 1]) > 0:
+        return []
+
+    def up_ge(i: int, j: int) -> bool:
+        return bool((int(bits_col[i - 1, (j - 1) >> 5]) >> ((j - 1) & 31)) & 1)
+
+    return _traceback_and_identity(pa, pb, n, k, max_i, up_ge, weights,
+                                   min_identity)
+
+
+# cap on one traceback dispatch's packed-bits footprint (K * P * ceil(K/32)
+# uint32 words ≈ P·K²/8 bytes): K=5000 jobs carry ~3.1 MB of bits each, so
+# dispatches are chunked — and grouped by similar K so short jobs never pay
+# a long job's padded K
+_TRACEBACK_BITS_BUDGET = 256 << 20
+
+
+def overlap_tracebacks_batch(jobs, max_unitigs: int, min_identity: float):
+    """Device DP + packed traceback for many jobs: returns a list whose
+    entry per job is the decoded alignment pieces (possibly []), or None
+    when the job is outside the int32 traceback domain (caller runs the
+    host DP). Used by `autocycler batch` so screened-positive trim DPs
+    never re-run on the host (VERDICT r3 item 3; reference trim.rs:366-479
+    scope). Jobs are grouped by size class and chunked so one dispatch's
+    bits tensor stays under ~256 MB."""
+    import jax
+
+    if not jobs:
+        return []
+    in_domain = [traceback_in_domain(job, max_unitigs) for job in jobs]
+    results: List[Optional[List[AlignmentPiece]]] = [None] * len(jobs)
+    run_idx = [i for i, ok in enumerate(in_domain) if ok]
+    if not run_idx:
+        return results
+    # group by power-of-two K class (padded K within a chunk ≤ 2× any
+    # member's k), then split each class by the bits budget
+    k_of = {i: min(max_unitigs, len(jobs[i][0])) for i in run_idx}
+    run_idx.sort(key=lambda i: k_of[i])
+    chunks: List[List[int]] = []
+    cur: List[int] = []
+    cur_class = -1
+    for i in run_idx:
+        k = max(k_of[i], 1)
+        cls = (k - 1).bit_length()
+        kmax = 1 << cls
+        per_job = kmax * ((kmax + 31) // 32) * 4
+        if not cur or cls != cur_class or \
+                (len(cur) + 1) * per_job > _TRACEBACK_BITS_BUDGET:
+            cur = [i]
+            chunks.append(cur)
+            cur_class = cls
+        else:
+            cur.append(i)
+
+    from ..utils.timing import device_dispatch
+    for chunk in chunks:
+        packed = pack_overlap_jobs([jobs[i] for i in chunk], max_unitigs)
+        if packed is None:
+            for i in chunk:
+                results[i] = []
+            continue
+        arrs, _ = packed
+        with device_dispatch("trim traceback DP"):
+            _, edges, bits = jax.jit(overlap_screen_traceback)(arrs)
+            edges = np.asarray(edges)
+            bits = np.asarray(bits)
+        for p, i in enumerate(chunk):
+            path_a, path_b, weights, _ = jobs[i]
+            results[i] = decode_overlap_alignment(
+                path_a, path_b, weights, min_identity, max_unitigs,
+                edges[:, p], bits[:, p, :])
+    return results
 
 
 def find_midpoint(alignment: List[AlignmentPiece], weights: Weights) -> int:

@@ -158,9 +158,11 @@ def pairwise_distance_matrix(M: np.ndarray, w: np.ndarray,
             Mw_p[:S, :U] = Mw
             Mt_p = np.zeros((Up, Sp), np.int32)
             Mt_p[:U, :S] = M.T
-            inter = np.asarray(
-                jnp.matmul(jnp.asarray(Mw_p), jnp.asarray(Mt_p)),
-            )[:S, :S].astype(np.int64)
+            from ..utils.timing import device_dispatch
+            with device_dispatch("cluster distance matmul"):
+                inter = np.asarray(
+                    jnp.matmul(jnp.asarray(Mw_p), jnp.asarray(Mt_p)),
+                )[:S, :S].astype(np.int64)
         except Exception as e:  # noqa: BLE001 — keep the host fallback
             # guarantee for ANY device failure, but surface it
             import sys

@@ -121,9 +121,11 @@ def _rank_windows_traced(codes_d, starts_d, k: int, real=None):
 def _pack_and_rank_jax(codes: np.ndarray, starts: np.ndarray, k: int):
     import jax.numpy as jnp
 
-    order, gid_sorted = _rank_windows_traced(
-        jnp.asarray(codes), jnp.asarray(starts.astype(np.int32)), k)
-    return np.asarray(order), np.asarray(gid_sorted)
+    from ..utils.timing import device_dispatch
+    with device_dispatch("k-mer grouping sort"):
+        order, gid_sorted = _rank_windows_traced(
+            jnp.asarray(codes), jnp.asarray(starts.astype(np.int32)), k)
+        return np.asarray(order), np.asarray(gid_sorted)
 
 
 def _bucket_size(n: int, floor: int = 1 << 16) -> int:
@@ -166,10 +168,12 @@ def _pack_and_rank_jax_bucketed(codes: np.ndarray, starts: np.ndarray, k: int):
     pad_starts[:n] = starts
     pad_codes = np.zeros(cb, codes.dtype)
     pad_codes[:len(codes)] = codes
-    order, gid_sorted = _bucketed_rank_fn(b, cb, k)(
-        jnp.asarray(pad_codes), jnp.asarray(pad_starts.astype(np.int32)),
-        jnp.int32(n))
-    return np.asarray(order)[:n], np.asarray(gid_sorted)[:n]
+    from ..utils.timing import device_dispatch
+    with device_dispatch("k-mer grouping sort (bucketed)"):
+        order, gid_sorted = _bucketed_rank_fn(b, cb, k)(
+            jnp.asarray(pad_codes), jnp.asarray(pad_starts.astype(np.int32)),
+            jnp.int32(n))
+        return np.asarray(order)[:n], np.asarray(gid_sorted)[:n]
 
 
 def group_windows_full(codes: np.ndarray, starts: np.ndarray, k: int,

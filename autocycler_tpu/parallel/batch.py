@@ -211,7 +211,9 @@ def batched_membership_intersections(mesh, M_list: List[np.ndarray],
                      mesh=mesh,
                      in_specs=(P("data", None, "seq"), P("data", None, "seq")),
                      out_specs=P("data", None, None))
-    inter = np.asarray(jax.jit(step)(Mw, M)).astype(np.int64)
+    from ..utils.timing import device_dispatch
+    with device_dispatch("batched membership contraction"):
+        inter = np.asarray(jax.jit(step)(Mw, M)).astype(np.int64)
     out = [inter[i, :m.shape[0], :m.shape[0]] for i, m in enumerate(M_list)]
     for i in host_only:
         m, w = M_list[i], w_list[i]
@@ -245,5 +247,7 @@ def sharded_overlap_screen(mesh, jobs, max_unitigs: int) -> np.ndarray:
             for k, v in arrs.items()}
     step = shard_map(overlap_screen_scores, mesh=mesh,
                      in_specs=(spec,), out_specs=P(("data", "seq")))
-    best = np.asarray(jax.jit(step)(arrs))
+    from ..utils.timing import device_dispatch
+    with device_dispatch("sharded trim screen"):
+        best = np.asarray(jax.jit(step)(arrs))
     return best[:n_real] > 0

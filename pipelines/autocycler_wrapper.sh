@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# One-command reads->consensus wrapper: subsample, assemble with whatever
+# assemblers are on PATH, then compress/cluster/trim/resolve/combine.
+# Counterpart of the reference's pipelines/autocycler_wrapper_by_iskold —
+# a deliberately small single-file driver next to the full-featured
+# autocycler_full.sh.
+#
+# Usage: autocycler_wrapper.sh <reads.fastq[.gz]> <out_dir> [subsets] [threads]
+set -euo pipefail
+
+reads=${1:?usage: autocycler_wrapper.sh <reads> <out_dir> [subsets] [threads]}
+out=${2:?usage: autocycler_wrapper.sh <reads> <out_dir> [subsets] [threads]}
+subsets=${3:-4}
+threads=${4:-8}
+autocycler=${AUTOCYCLER:-autocycler}   # set AUTOCYCLER="python -m autocycler_tpu" to run from a checkout
+
+mkdir -p "$out"
+
+echo "Estimating genome size..." >&2
+genome_size=$($autocycler helper genome_size --reads "$reads" --threads "$threads")
+echo "  $genome_size bp" >&2
+
+$autocycler subsample --reads "$reads" --out_dir "$out/subsampled_reads" \
+    --genome_size "$genome_size" --count "$subsets"
+
+# every assembler the helper knows; missing tools are skipped, and a failed
+# assembly is tolerated (the consensus design only needs most to succeed)
+assemblers=(canu flye metamdbg miniasm myloasm necat nextdenovo raven redbean)
+mkdir -p "$out/assemblies"
+for i in $(seq -f '%02g' 1 "$subsets"); do
+    for a in "${assemblers[@]}"; do
+        $autocycler helper "$a" \
+            --reads "$out/subsampled_reads/sample_$i.fastq" \
+            --out_prefix "$out/assemblies/${a}_$i" \
+            --genome_size "$genome_size" --threads "$threads" || true
+    done
+done
+rm -rf "$out/subsampled_reads"
+
+$autocycler compress -i "$out/assemblies" -a "$out" --threads "$threads"
+$autocycler cluster -a "$out"
+for c in "$out"/clustering/qc_pass/cluster_*; do
+    $autocycler trim -c "$c" --threads "$threads"
+    $autocycler resolve -c "$c"
+done
+$autocycler combine -a "$out" -i "$out"/clustering/qc_pass/cluster_*/5_final.gfa
+
+echo "Consensus: $out/consensus_assembly.fasta" >&2

@@ -9,9 +9,6 @@ fallback behaviours without needing a device: the conftest pins
 JAX_PLATFORMS=cpu, which the probe short-circuits on.
 """
 
-import io
-import sys
-
 import numpy as np
 import pytest
 
@@ -41,8 +38,12 @@ def test_kill_switch_skips_probe(monkeypatch, capsys):
 
 def test_malformed_timeout_warns_and_defaults(monkeypatch, capsys):
     """A malformed timeout warns and falls back to the default instead of
-    crashing. jax is already initialised on the pinned CPU backend in this
-    process, so the real probe thread answers False immediately."""
+    crashing. Initialise jax on the pinned CPU backend FIRST (test order
+    must not matter), so the real probe thread answers False immediately
+    rather than attempting a first-time axon backend init."""
+    import jax.numpy as jnp
+
+    jnp.zeros(1).block_until_ready()  # backend init under JAX_PLATFORMS=cpu
     probe = _fresh_probe()
     monkeypatch.setenv("JAX_PLATFORMS", "axon")  # reach the env parse
     monkeypatch.setenv("AUTOCYCLER_DEVICE_PROBE_TIMEOUT", "banana")
