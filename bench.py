@@ -244,9 +244,70 @@ def bench_configs() -> None:
                            "vs_baseline": 0}))
 
 
+def bench_batch() -> None:
+    """Batched multi-isolate throughput (BASELINE.md "batched multi-isolate"
+    row, scaled to one chip): `autocycler batch` on 96 isolates x 12
+    assemblies each — full compress -> one mesh-batched distance step ->
+    cluster -> batched trim screen + device traceback -> resolve -> combine
+    per isolate. Metric is isolates/s end-to-end; the v5e-8 projection is
+    the mesh math validated by dryrun_multichip."""
+    import contextlib
+    import gc
+    import os
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent / "tests"))
+    from synthetic import make_isolate_dirs
+
+    from autocycler_tpu.commands.batch import batch as run_batch
+
+    n_isolates = 96
+    tmp = Path(tempfile.mkdtemp(prefix="autocycler_bench_batch_"))
+    parent = make_isolate_dirs(tmp / "isolates", n_isolates, fast=True,
+                               seed0=500, n_assemblies=12,
+                               chromosome_len=50_000, plasmid_len=5_000,
+                               n_snps=20)
+
+    gc.disable()
+    devnull = open(os.devnull, "w")
+    t0 = time.perf_counter()
+    with contextlib.redirect_stderr(devnull):
+        run_batch(parent, tmp / "out", k_size=51)
+    elapsed = time.perf_counter() - t0
+    gc.enable()
+
+    # correctness gate: every isolate produced a fully-resolved consensus
+    # with both replicons circular
+    for i in range(n_isolates):
+        consensus = (tmp / "out" / f"iso_{i:03d}" /
+                     "consensus_assembly.fasta").read_text()
+        headers = [l for l in consensus.splitlines() if l.startswith(">")]
+        assert len(headers) == 2, (i, headers)
+        assert all("circular=true" in h for h in headers), (i, headers)
+
+    print(json.dumps({
+        "metric": "batch_96x12_isolates_per_s",
+        "value": round(n_isolates / elapsed, 3),
+        "unit": "isolates/s",
+        "vs_baseline": 0,
+        "elapsed_s": round(elapsed, 2),
+        "isolates": n_isolates,
+        "assemblies_per_isolate": 12,
+    }))
+
+
 def main() -> None:
+    import os
+
+    import jax
+
+    # the installed axon TPU plugin overrides JAX_PLATFORMS from the
+    # environment, so an explicit platform pin (e.g. CPU smoke runs of this
+    # bench) must also go through jax.config — and must not be skipped by a
+    # failure of the best-effort cache config below
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        jax.config.update("jax_platforms", plat)
     try:
-        import jax
         jax.config.update("jax_compilation_cache_dir",
                           "/root/.cache/autocycler_tpu_jax")
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
@@ -257,6 +318,8 @@ def main() -> None:
         bench_dotplot()
     elif len(sys.argv) > 1 and sys.argv[1] == "configs":
         bench_configs()
+    elif len(sys.argv) > 1 and sys.argv[1] == "batch":
+        bench_batch()
     else:
         bench_headline()
 

@@ -91,3 +91,21 @@ def make_assemblies(tmp_path, n_assemblies=4, chromosome_len=6000, plasmid_len=8
         (asm_dir / f"assembly_{i + 1}.fasta").write_text(
             f">chromosome_{i + 1}\n{chrom}\n>plasmid_{i + 1}\n{plas}\n")
     return asm_dir
+
+
+def make_isolate_dirs(parent, n_isolates, fast=False, seed0=0, **kwargs):
+    """Lay out n isolate subdirectories in the flat shape `autocycler batch`
+    expects (FASTA files directly inside each isolate dir). kwargs go to
+    make_assemblies / make_assemblies_fast; seeds are seed0 + i."""
+    from pathlib import Path
+
+    parent = Path(parent)
+    make = make_assemblies_fast if fast else make_assemblies
+    for i in range(n_isolates):
+        iso = parent / f"iso_{i:03d}"
+        iso.mkdir(parents=True, exist_ok=True)
+        asm = make(iso, seed=seed0 + i, **kwargs)
+        for f in Path(asm).iterdir():
+            f.rename(iso / f.name)
+        Path(asm).rmdir()
+    return parent

@@ -97,15 +97,10 @@ def test_batch_command_bitwise_matches_cluster(tmp_path):
     from autocycler_tpu.models import UnitigGraph
     from autocycler_tpu.ops.distance import pairwise_contig_distances
 
-    parent = tmp_path / "isolates"
-    for i in range(96):
-        iso = parent / f"iso_{i:03d}"
-        iso.mkdir(parents=True)
-        make_assemblies(iso, n_assemblies=12, chromosome_len=160, plasmid_len=70,
-                        seed=100 + i)
-        for f in (iso / "assemblies").iterdir():
-            f.rename(iso / f.name)
-        (iso / "assemblies").rmdir()
+    from synthetic import make_isolate_dirs
+    parent = make_isolate_dirs(tmp_path / "isolates", 96, seed0=100,
+                               n_assemblies=12, chromosome_len=160,
+                               plasmid_len=70)
 
     out = tmp_path / "out"
     batch(parent, out, k_size=21)
