@@ -8,6 +8,7 @@ defaults and validation ranges, dispatching to commands/*.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 from . import __version__
@@ -197,6 +198,19 @@ GC_DISABLED_COMMANDS = frozenset({
 
 
 def main(argv=None) -> int:
+    # Honour an explicit JAX_PLATFORMS pin through jax.config: an installed
+    # PJRT plugin (the axon TPU tunnel) can override the environment
+    # variable, which would send a user's pinned-CPU run to a remote device
+    # anyway — or hang it when the tunnel is wedged. Only touches jax when
+    # the user set the variable.
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        try:
+            import jax
+            jax.config.update("jax_platforms", plat)
+        except Exception:
+            pass
+
     print(BANNER, file=sys.stderr)
     parser = build_parser()
     args = parser.parse_args(argv)

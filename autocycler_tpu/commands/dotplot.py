@@ -156,30 +156,37 @@ MAX_DEVICE_CELLS = 5e11
 
 def _device_match_pair(a_words: np.ndarray, b_words: np.ndarray, tile: int = 2048
                        ) -> Tuple[np.ndarray, np.ndarray]:
-    """Exact (i, j) match positions via the Pallas coarse count grid: run the
-    device kernel for tile-level counts, then refine only NONZERO tiles with
-    an exact numpy equality block (matches are sparse — diagonals — so the
-    refinement touches a vanishing fraction of the grid)."""
-    from ..ops.dotplot_pallas import match_grid
+    """Exact (i, j) match positions via the Pallas coarse count grid: run
+    the device kernel for tile-level counts, then refine only NONZERO tiles
+    — also on device (ops.dotplot_pallas.match_tile_bits returns packed
+    equality bitmasks; matches are sparse diagonals, so few tiles refine).
+    The host only unpacks set bits and drops tile-padding cells (an all-T
+    word equals the A-pad sentinel, so edge-tile pad bits can be spurious —
+    the count kernel masks them by global index; here the bound filter does
+    the same)."""
+    from ..ops.dotplot_pallas import match_grid, match_tile_bits, unpack_tile_bits
     from ..utils.timing import device_dispatch
 
+    n_a = a_words.shape[1]
+    n_b = b_words.shape[1]
     with device_dispatch("dotplot match grid"):
         tiles = np.asarray(match_grid(a_words, b_words, tile_a=tile, tile_b=tile))
-    iis: List[np.ndarray] = []
-    jjs: List[np.ndarray] = []
-    W = a_words.shape[0]
-    for ti, tj in np.argwhere(tiles > 0):
-        a = a_words[:, ti * tile:(ti + 1) * tile]
-        b = b_words[:, tj * tile:(tj + 1) * tile]
-        eq = np.ones((a.shape[1], b.shape[1]), dtype=bool)
-        for w in range(W):
-            eq &= a[w][:, None] == b[w][None, :]
-        ii, jj = np.nonzero(eq)
-        iis.append(ii.astype(np.int64) + ti * tile)
-        jjs.append(jj.astype(np.int64) + tj * tile)
-    if not iis:
+    pairs = np.argwhere(tiles > 0)
+    if not len(pairs):
         z = np.zeros(0, np.int64)
         return z, z
+    with device_dispatch("dotplot tile refinement"):
+        packed = match_tile_bits(a_words, b_words, [tuple(p) for p in pairs],
+                                 tile_a=tile, tile_b=tile)
+    iis: List[np.ndarray] = []
+    jjs: List[np.ndarray] = []
+    for (ti, tj), bits in zip(pairs, packed):
+        ii, jj = np.nonzero(unpack_tile_bits(bits))
+        ii = ii.astype(np.int64) + ti * tile
+        jj = jj.astype(np.int64) + tj * tile
+        keep = (ii < n_a) & (jj < n_b)
+        iis.append(ii[keep])
+        jjs.append(jj[keep])
     return np.concatenate(iis), np.concatenate(jjs)
 
 

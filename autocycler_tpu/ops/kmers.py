@@ -31,10 +31,14 @@ import numpy as np
 
 from .encode import encode_bytes
 
-SYMS_PER_WORD = 10  # 3 bits per symbol in an int32
+SYMS_PER_WORD = 10  # 3 bits per symbol in an int32 (numpy fallback packing)
+# device packing is base-5: 13 symbols/word (5^13 < 2^31), so k=51 needs 4
+# words instead of 6 — fewer sort operands, same lexicographic order
+SYMS_PER_WORD_DEV = 13
 
 # use_jax accepts True (direct device sort), "bucketed" (fixed-shape,
-# persistently-cacheable device sort), False, or None (resolve via env)
+# persistently-cacheable device sort), "lsd" (multi-pass 2-operand stable
+# sorts), False, or None (resolve via env)
 UseJax = Union[bool, str, None]
 
 
@@ -51,6 +55,8 @@ def _resolve_use_jax(use_jax: UseJax) -> UseJax:
     value = os.environ.get("AUTOCYCLER_DEVICE_GROUPING", "").strip().lower()
     if value in ("1", "true", "yes", "on", "bucketed"):
         return "bucketed"
+    if value == "lsd":
+        return "lsd"
     if value == "direct":
         return True
     if value not in ("", "0", "false", "no", "off", "disabled"):
@@ -90,31 +96,70 @@ def _pack_and_rank_numpy(codes: np.ndarray, starts: np.ndarray, k: int):
     return order, gid_sorted
 
 
-def _rank_windows_traced(codes_d, starts_d, k: int, real=None):
-    """Traced pack + lexsort + group-id body shared by the direct and
-    bucketed jax paths. ``real`` (optional bool mask) forces pad windows'
-    words to int32 max so they sort after every real window (3-bit packing
-    never sets the top bit, so the value is out of band)."""
+def _pack_words_traced(codes_d, starts_d, k: int, real=None):
+    """Traced base-5 window packing: 13 symbols per int32 word (5^13 < 2^31),
+    most significant first, zero-filled tail — word-tuple comparison equals
+    byte-lexicographic k-mer comparison, with ceil(k/13) words (k=51 → 4
+    words vs 6 for the 3-bit packing). ``real`` (optional bool mask) forces
+    pad windows' words to int32 max so they sort after every real window
+    (base-5 words stay below 5^13 - 1 < 2^31 - 1, so the value is out of
+    band)."""
     import jax.numpy as jnp
 
-    n = starts_d.shape[0]
     words = []
-    for j in range(_num_words(k)):
-        w = jnp.zeros(n, dtype=jnp.int32)
-        for t in range(SYMS_PER_WORD):
-            idx = j * SYMS_PER_WORD + t
-            w = w << 3
+    n_words = (k + SYMS_PER_WORD_DEV - 1) // SYMS_PER_WORD_DEV
+    for j in range(n_words):
+        w = jnp.zeros(starts_d.shape[0], dtype=jnp.int32)
+        for t in range(SYMS_PER_WORD_DEV):
+            idx = j * SYMS_PER_WORD_DEV + t
+            w = w * 5
             if idx < k:
-                w = w | codes_d[starts_d + idx].astype(jnp.int32)
+                w = w + codes_d[starts_d + idx].astype(jnp.int32)
         if real is not None:
             w = jnp.where(real, w, jnp.int32(2**31 - 1))
         words.append(w)
-    order = jnp.lexsort(tuple(reversed(words)))
-    sorted_words = [w[order] for w in words]
+    return words
+
+
+def _gids_from_sorted_words(sorted_words):
+    """Adjacent-difference group ids over lexicographically sorted word
+    tuples."""
+    import jax.numpy as jnp
+
+    n = sorted_words[0].shape[0]
     new_group = jnp.zeros(n, dtype=bool).at[0].set(True)
     for w in sorted_words:
         new_group = new_group.at[1:].set(new_group[1:] | (w[1:] != w[:-1]))
-    gid_sorted = jnp.cumsum(new_group) - 1
+    return jnp.cumsum(new_group) - 1
+
+
+def _rank_windows_traced(codes_d, starts_d, k: int, real=None):
+    """Traced pack + lexsort + group-id body shared by the direct and
+    bucketed jax paths (one variadic sort over all words + the index)."""
+    import jax.numpy as jnp
+
+    words = _pack_words_traced(codes_d, starts_d, k, real=real)
+    order = jnp.lexsort(tuple(reversed(words)))
+    gid_sorted = _gids_from_sorted_words([w[order] for w in words])
+    return order, gid_sorted
+
+
+def _rank_windows_traced_lsd(codes_d, starts_d, k: int):
+    """LSD multi-pass ranking: one stable 2-operand sort_key_val per word,
+    least-significant word first — after the last (most-significant) pass
+    the carried index permutation is the stable lexicographic order. Avoids
+    the variadic sort entirely: each pass sorts ONE int32 key with the
+    permutation as its value, the cheapest sort XLA can run, at the price of
+    one gather per pass to re-key the permuted windows."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    words = _pack_words_traced(codes_d, starts_d, k)
+    n = starts_d.shape[0]
+    order = jnp.arange(n, dtype=jnp.int32)
+    for w in reversed(words):
+        _, order = lax.sort((w[order], order), num_keys=1, is_stable=True)
+    gid_sorted = _gids_from_sorted_words([w[order] for w in words])
     return order, gid_sorted
 
 
@@ -125,6 +170,23 @@ def _pack_and_rank_jax(codes: np.ndarray, starts: np.ndarray, k: int):
     with device_dispatch("k-mer grouping sort"):
         order, gid_sorted = _rank_windows_traced(
             jnp.asarray(codes), jnp.asarray(starts.astype(np.int32)), k)
+        return np.asarray(order), np.asarray(gid_sorted)
+
+
+@functools.lru_cache(maxsize=None)
+def _lsd_rank_fn(kk: int):
+    import jax
+
+    return jax.jit(functools.partial(_rank_windows_traced_lsd, k=kk))
+
+
+def _pack_and_rank_jax_lsd(codes: np.ndarray, starts: np.ndarray, k: int):
+    import jax.numpy as jnp
+
+    from ..utils.timing import device_dispatch
+    with device_dispatch("k-mer grouping sort (lsd)"):
+        order, gid_sorted = _lsd_rank_fn(k)(
+            jnp.asarray(codes), jnp.asarray(starts.astype(np.int32)))
         return np.asarray(order), np.asarray(gid_sorted)
 
 
@@ -201,6 +263,8 @@ def group_windows_full(codes: np.ndarray, starts: np.ndarray, k: int,
         try:
             if use_jax == "bucketed":
                 order, gid_sorted = _pack_and_rank_jax_bucketed(codes, starts, k)
+            elif use_jax == "lsd":
+                order, gid_sorted = _pack_and_rank_jax_lsd(codes, starts, k)
             else:
                 order, gid_sorted = _pack_and_rank_jax(codes, starts, k)
             gid = np.empty(n, np.int64)

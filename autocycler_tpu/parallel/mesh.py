@@ -32,11 +32,53 @@ def mesh_axis_sizes(n_devices: int, seq_parallel: Optional[int] = None) -> Tuple
     return n_devices // seq_parallel, seq_parallel
 
 
+def _devices_with_deadline():
+    """jax.devices() behind the same timed-probe pattern as
+    ops.distance._tpu_attached: a wedged tunnelled TPU can block backend
+    init FOREVER (observed on the axon link), and `autocycler batch` must
+    fail with a clear error instead of hanging the pipeline indefinitely.
+    AUTOCYCLER_MESH_INIT_TIMEOUT (default 600 s — first TPU init through a
+    healthy tunnel can take minutes) bounds the wait; <= 0 skips the
+    guard."""
+    import os
+    import sys
+    import threading
+
+    try:
+        timeout = float(os.environ.get("AUTOCYCLER_MESH_INIT_TIMEOUT", "600"))
+    except ValueError:
+        print("autocycler: ignoring malformed AUTOCYCLER_MESH_INIT_TIMEOUT",
+              file=sys.stderr)
+        timeout = 600.0
+    import jax
+    if timeout <= 0:
+        return jax.devices()
+    result = []
+
+    def probe() -> None:
+        try:
+            result.append(jax.devices())
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            result.append(e)
+
+    t = threading.Thread(target=probe, daemon=True, name="mesh-init")
+    t.start()
+    t.join(timeout)
+    if not result:
+        raise RuntimeError(
+            f"device backend did not initialise within {timeout:.0f}s "
+            "(wedged tunnel?); set AUTOCYCLER_MESH_INIT_TIMEOUT to wait "
+            "longer, or JAX_PLATFORMS=cpu to run on host devices")
+    if isinstance(result[0], Exception):
+        raise result[0]
+    return result[0]
+
+
 def make_mesh(n_devices: Optional[int] = None, seq_parallel: Optional[int] = None):
     """Build a 2-D ('data', 'seq') jax.sharding.Mesh."""
     import jax
 
-    devices = jax.devices()
+    devices = _devices_with_deadline()
     if n_devices is not None:
         if len(devices) < n_devices:
             raise ValueError(

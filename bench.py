@@ -244,6 +244,66 @@ def bench_configs() -> None:
                            "vs_baseline": 0}))
 
 
+def bench_grouping(n_mbp: float = 147.0) -> None:
+    """K-mer grouping backend shootout at headline scale (VERDICT r3 item
+    1): the native fused hash kernel vs the device sort paths (bucketed
+    variadic lexsort and the LSD 2-operand multi-pass), on the same ~n_mbp
+    Mbp of both-strand windows, k=51. Each backend's (gid, order) is
+    verified identical to the native result before its time counts. One
+    JSON line with per-backend seconds; vs_baseline = native_s / best_s
+    (>= 1 means a device path won)."""
+    import numpy as np
+
+    from autocycler_tpu.ops.kmers import group_windows_full
+
+    k = 51
+    n = int(n_mbp * 1e6)
+    rng = np.random.default_rng(2)
+    # headline-realistic distribution: rotated copies of ONE genome (24
+    # assemblies of the same isolate), not i.i.d. random codes — the unique
+    # fraction drives every backend's ranking phase
+    genome = rng.integers(1, 5, size=max(n // 24, k + 1)).astype(np.uint8)
+    copies = []
+    for i in range(24):
+        rot = int(rng.integers(0, len(genome)))
+        copies.append(np.roll(genome, rot))
+    codes = np.concatenate(copies)[:n]
+    starts = np.arange(0, len(codes) - k, dtype=np.int64)
+    results = {}
+
+    def timed(tag, use_jax):
+        t0 = time.perf_counter()
+        gid, order = group_windows_full(codes, starts, k, use_jax=use_jax)
+        dt = time.perf_counter() - t0
+        return (gid, order), dt
+
+    (gid_n, order_n), native_s = timed("native", False)
+    results["native_s"] = round(native_s, 2)
+    for tag, mode in (("device_lsd", "lsd"), ("device_bucketed", "bucketed")):
+        try:
+            # warm the compile outside the timed run (tiny same-k input)
+            group_windows_full(codes[:1 << 16], starts[:1 << 15], k,
+                               use_jax=mode)
+            (gid, order), dt = timed(tag, mode)
+            ok = bool((gid == gid_n).all() and (order == order_n).all())
+            results[f"{tag}_s"] = round(dt, 2)
+            results[f"{tag}_exact"] = ok
+        except Exception as exc:
+            print(f"{tag} failed: {type(exc).__name__}: {exc}",
+                  file=sys.stderr)
+            results[f"{tag}_s"] = None
+    device_times = [v for b, v in results.items()
+                    if b.startswith("device") and b.endswith("_s") and v]
+    best_device = min(device_times) if device_times else None
+    print(json.dumps({
+        "metric": f"kmer_grouping_{int(n_mbp)}M_windows",
+        "value": best_device if best_device is not None else native_s,
+        "unit": "s",
+        "vs_baseline": round(native_s / best_device, 3) if best_device else 0,
+        **results,
+    }))
+
+
 def bench_batch() -> None:
     """Batched multi-isolate throughput (BASELINE.md "batched multi-isolate"
     row, scaled to one chip): `autocycler batch` on 96 isolates x 12
@@ -320,6 +380,8 @@ def main() -> None:
         bench_configs()
     elif len(sys.argv) > 1 and sys.argv[1] == "batch":
         bench_batch()
+    elif len(sys.argv) > 1 and sys.argv[1] == "grouping":
+        bench_grouping(float(sys.argv[2]) if len(sys.argv) > 2 else 147.0)
     else:
         bench_headline()
 

@@ -672,6 +672,188 @@ struct State {
 
 static thread_local std::unique_ptr<State> g_state;
 
+// rc key of the window at `w` (read from bytes; once per DISTINCT k-mer)
+static inline u128 rc_key_of(const uint8_t* w, int32_t k) {
+    u128 rk = 0;
+    for (int32_t j = k - 1; j >= 0; --j) {
+        const uint32_t c = ENC.t[w[j]];
+        rk = rk * 5 + (c ? 5 - c : 0);
+    }
+    return rk;
+}
+
+// Phase A, streaming variant: one rolling pass over every forward window
+// with a single global open-addressing table. At headline scale the table
+// (~240 MB of entries + keys) lives in DRAM, so the match path pays about
+// one dependent cache miss per window.
+static int phase_a_stream(const uint8_t* codes, const int64_t* fwd_off,
+                          const int64_t* seq_len, int64_t S, int32_t k,
+                          u128 pow5k1, const std::vector<int64_t>& occ_off,
+                          int32_t* out_fwd_gid, std::vector<u128>& keys,
+                          std::vector<u128>& rc_keys,
+                          std::vector<uint32_t>& rep_of) {
+    // NOTE: presizing the table from n_f (e.g. n_f/8) to skip the doubling
+    // rehashes was measured SLOWER (6.5-7.2s vs 6.1-6.2s phase A on the
+    // headline input) — the smaller grown table's footprint wins, same
+    // pattern as the round-1 entry-size finding.
+    Table table;
+    if (!table.init(1 << 15)) return -1;
+
+    constexpr int64_t BLOCK = 128;
+    u128 win_keys[BLOCK];
+    uint64_t win_hash[BLOCK];
+    for (int64_t s = 0; s < S; ++s) {
+        const uint8_t* base = codes + fwd_off[s];
+        const int64_t L = seq_len[s];
+        int32_t* gout = out_fwd_gid +
+            (occ_off[s] / 2);              // forward windows are the first half
+        u128 cur = 0;
+        for (int64_t p0 = 0; p0 < L; p0 += BLOCK) {
+            const int64_t pe = std::min(p0 + BLOCK, L);
+            if ((keys.size() + BLOCK) * 2 > table.cap && !table.grow()) return -1;
+            const uint64_t mask = table.cap - 1;
+            for (int64_t p = p0; p < pe; ++p) {
+                if (p == 0) {
+                    cur = 0;
+                    for (int32_t j = 0; j < k; ++j)
+                        cur = cur * 5 + ENC.t[base[j]];
+                } else {
+                    cur = (cur - ENC.t[base[p - 1]] * pow5k1) * 5 +
+                          ENC.t[base[p + k - 1]];
+                }
+                const uint64_t h = hash_key(cur);
+                win_keys[p - p0] = cur;
+                win_hash[p - p0] = h;
+                __builtin_prefetch(&table.slots[h & mask], 0, 1);
+            }
+            // NOTE: a staged variant that defers the key compare (prefetching
+            // keys[gid] and verifying per block) was measured SLOWER here
+            // (6.4s vs 5.9s on the 147M-window headline input), as was
+            // storing keys inline in 32 B entries (11.0s — see the Entry
+            // NOTE): the simple probe over the smallest footprint wins.
+            // keys/rc_keys growth can throw bad_alloc (hundreds of MB at
+            // large U_f); convert to the function's -1 convention instead of
+            // letting it escape the extern "C" boundary
+            try {
+            for (int64_t p = p0; p < pe; ++p) {
+                const size_t before = keys.size();
+                gout[p] = static_cast<int32_t>(table.upsert(
+                    win_keys[p - p0], win_hash[p - p0],
+                    static_cast<uint32_t>(fwd_off[s] + p), keys));
+                if (keys.size() != before) {
+                    // new group: derive its rc key now, while the window
+                    // bytes are hot — once per DISTINCT k-mer, so the k-digit
+                    // loop is off the per-window path (a rolling-rc variant
+                    // carried ~1 s of u128 arithmetic across all 147M
+                    // windows; this pays only at the ~10% insert rate)
+                    rc_keys.push_back(rc_key_of(base + p, k));
+                }
+            }
+            } catch (...) { return -1; }
+        }
+    }
+    // recover per-group representative byte offsets from the table (recorded
+    // at first insert; avoids a dense side array during this phase), then
+    // the table is done — the rc map never probes it.
+    try { rep_of.resize(keys.size(), UINT32_MAX); } catch (...) { return -1; }
+    for (const Entry& e : table.slots) {
+        if (e.hash != 0) rep_of[e.gid] = e.rep;
+    }
+    return 0;
+}
+
+// Phase A, cache-partitioned variant (round 4): bin (key, rep byte, output
+// index) by hash prefix with sequential writes, then drain each partition
+// against its own table. Equal keys share a hash, hence a partition, so
+// both the partition table (~1 MB, grown on demand) and the partition's
+// slice of `keys` stay cache-resident during its drain — the per-window
+// dependent DRAM miss of the streaming variant becomes sequential bin
+// bandwidth plus an L2 probe. Same outputs, different discovery order for
+// provisional gids (final ids are lexicographic ranks either way).
+//
+// NOTE: measured SLOWER than the stream variant on the current host at
+// headline scale (147M windows, U=12.2M): 22.2s vs 6.8s at P=512, 21.6s at
+// P=64, 26.4s at P=16 (AUTOCYCLER_SK_PBITS sweeps the partition count).
+// The ~7 GB of bin write+read traffic costs this bandwidth-throttled
+// single-core VM far more than the ~132M latency-bound probes it saves, so
+// the default stays stream (AUTOCYCLER_SK_PARTITION=1 opts in for hosts
+// with healthier bandwidth:latency ratios). Kept compiled and
+// parity-tested — the classic hash-join partitioning trade is
+// host-dependent, not wrong.
+static int phase_a_partitioned(const uint8_t* codes, const int64_t* fwd_off,
+                               const int64_t* seq_len, int64_t S, int32_t k,
+                               u128 pow5k1,
+                               const std::vector<int64_t>& occ_off,
+                               int32_t* out_fwd_gid, std::vector<u128>& keys,
+                               std::vector<u128>& rc_keys,
+                               std::vector<uint32_t>& rep_of) {
+    const char* pb_env = getenv("AUTOCYCLER_SK_PBITS");
+    const int PBITS = pb_env ? std::max(1, std::min(12, atoi(pb_env))) : 9;
+    const int P = 1 << PBITS;
+    int64_t n_f = 0;
+    for (int64_t s = 0; s < S; ++s) n_f += seq_len[s];
+
+    std::vector<std::vector<u128>> bkey(P);
+    std::vector<std::vector<uint32_t>> brep(P), bidx(P);
+    const size_t est = static_cast<size_t>(n_f / P + n_f / (4 * P) + 64);
+    try {
+        for (int part = 0; part < P; ++part) {
+            bkey[part].reserve(est);
+            brep[part].reserve(est);
+            bidx[part].reserve(est);
+        }
+    } catch (...) { return -1; }
+
+    try {
+        for (int64_t s = 0; s < S; ++s) {
+            const uint8_t* base = codes + fwd_off[s];
+            const int64_t L = seq_len[s];
+            const int64_t g0 = occ_off[s] / 2;
+            u128 cur = 0;
+            for (int64_t p = 0; p < L; ++p) {
+                if (p == 0) {
+                    cur = 0;
+                    for (int32_t j = 0; j < k; ++j)
+                        cur = cur * 5 + ENC.t[base[j]];
+                } else {
+                    cur = (cur - ENC.t[base[p - 1]] * pow5k1) * 5 +
+                          ENC.t[base[p + k - 1]];
+                }
+                const int part = static_cast<int>(hash_key(cur) >> (64 - PBITS));
+                bkey[part].push_back(cur);
+                brep[part].push_back(static_cast<uint32_t>(fwd_off[s] + p));
+                bidx[part].push_back(static_cast<uint32_t>(g0 + p));
+            }
+        }
+    } catch (...) { return -1; }
+
+    for (int part = 0; part < P; ++part) {
+        const size_t n = bkey[part].size();
+        if (n == 0) continue;
+        Table t;
+        if (!t.init(1 << 15)) return -1;
+        const size_t part_start = keys.size();   // gids stay globally dense
+        try {
+            for (size_t i = 0; i < n; ++i) {
+                if ((keys.size() - part_start + 1) * 2 > t.cap && !t.grow())
+                    return -1;
+                const u128 key = bkey[part][i];
+                const size_t before = keys.size();
+                out_fwd_gid[bidx[part][i]] = static_cast<int32_t>(
+                    t.upsert(key, hash_key(key), brep[part][i], keys));
+                if (keys.size() != before) {
+                    rc_keys.push_back(rc_key_of(codes + brep[part][i], k));
+                    rep_of.push_back(brep[part][i]);
+                }
+            }
+        } catch (...) { return -1; }
+        std::vector<u128>().swap(bkey[part]);
+        std::vector<uint32_t>().swap(brep[part]);
+        std::vector<uint32_t>().swap(bidx[part]);
+    }
+    return 0;
+}
+
 }  // namespace occidx
 
 extern "C" {
@@ -712,91 +894,29 @@ static int64_t occ_index_build_impl(const uint8_t* codes, int64_t n_codes,
     for (int32_t i = 1; i < k; ++i) pow5k1 *= 5;
 
     // ---- phase A: hash forward windows (rolling base-5 keys) ----
-    // NOTE: presizing the table from n_f (e.g. n_f/8) to skip the doubling
-    // rehashes was measured SLOWER (6.5-7.2s vs 6.1-6.2s phase A on the
-    // headline input) — the smaller grown table's footprint wins, same
-    // pattern as the round-1 entry-size finding.
-    Table table;
-    if (!table.init(1 << 15)) return -1;
+    // Two variants fill (keys, rc_keys, rep_of, out_fwd_gid): the streaming
+    // global-table pass (default — measured fastest on this host at every
+    // scale) and the cache-partitioned bin+drain pass (opt-in via
+    // AUTOCYCLER_SK_PARTITION=1; see its NOTE for the measurements).
     std::vector<u128> keys;                // per provisional gid
     std::vector<u128> rc_keys;             // rc key per provisional gid
+    std::vector<uint32_t> rep_of;          // representative byte offset
     try {
         keys.reserve(1 << 16);
         rc_keys.reserve(1 << 16);
     } catch (...) { return -1; }
-
-    constexpr int64_t BLOCK = 128;
-    u128 win_keys[BLOCK];
-    uint64_t win_hash[BLOCK];
-    for (int64_t s = 0; s < S; ++s) {
-        const uint8_t* base = codes + fwd_off[s];
-        const int64_t L = seq_len[s];
-        int32_t* gout = out_fwd_gid +
-            (state->occ_off[s] / 2);       // forward windows are the first half
-        u128 cur = 0;
-        for (int64_t p0 = 0; p0 < L; p0 += BLOCK) {
-            const int64_t pe = std::min(p0 + BLOCK, L);
-            if ((keys.size() + BLOCK) * 2 > table.cap && !table.grow()) return -1;
-            const uint64_t mask = table.cap - 1;
-            for (int64_t p = p0; p < pe; ++p) {
-                if (p == 0) {
-                    cur = 0;
-                    for (int32_t j = 0; j < k; ++j)
-                        cur = cur * 5 + ENC.t[base[j]];
-                } else {
-                    cur = (cur - ENC.t[base[p - 1]] * pow5k1) * 5 +
-                          ENC.t[base[p + k - 1]];
-                }
-                const uint64_t h = hash_key(cur);
-                win_keys[p - p0] = cur;
-                win_hash[p - p0] = h;
-                __builtin_prefetch(&table.slots[h & mask], 0, 1);
-            }
-            // NOTE: a staged variant that defers the key compare (prefetching
-            // keys[gid] and verifying per block) was measured SLOWER here
-            // (6.4s vs 5.9s on the 147M-window headline input), as was
-            // storing keys inline in 32 B entries (11.0s — see the Entry
-            // NOTE): the simple probe over the smallest footprint wins.
-            // keys/rc_keys growth can throw bad_alloc (hundreds of MB at
-            // large U_f); convert to the function's -1 convention instead of
-            // letting it escape the extern "C" boundary
-            try {
-            for (int64_t p = p0; p < pe; ++p) {
-                const size_t before = keys.size();
-                gout[p] = static_cast<int32_t>(table.upsert(
-                    win_keys[p - p0], win_hash[p - p0],
-                    static_cast<uint32_t>(fwd_off[s] + p), keys));
-                if (keys.size() != before) {
-                    // new group: derive its rc key now, while the window
-                    // bytes are hot — once per DISTINCT k-mer, so the k-digit
-                    // loop is off the per-window path (a rolling-rc variant
-                    // carried ~1 s of u128 arithmetic across all 147M
-                    // windows; this pays only at the ~10% insert rate)
-                    const uint8_t* w = base + p;
-                    u128 rk = 0;
-                    for (int32_t j = k - 1; j >= 0; --j) {
-                        const uint32_t c = ENC.t[w[j]];
-                        rk = rk * 5 + (c ? 5 - c : 0);
-                    }
-                    rc_keys.push_back(rk);
-                }
-            }
-            } catch (...) { return -1; }
-        }
-    }
+    const char* part_env = getenv("AUTOCYCLER_SK_PARTITION");
+    const bool use_partitioned = part_env && part_env[0] == '1';
+    if ((use_partitioned
+             ? phase_a_partitioned(codes, fwd_off, seq_len, S, k, pow5k1,
+                                   state->occ_off, out_fwd_gid, keys,
+                                   rc_keys, rep_of)
+             : phase_a_stream(codes, fwd_off, seq_len, S, k, pow5k1,
+                              state->occ_off, out_fwd_gid, keys, rc_keys,
+                              rep_of)) != 0)
+        return -1;
     const int64_t U_f = static_cast<int64_t>(keys.size());
-    pt.mark("A fwd hash");
-
-    // recover per-group representative byte offsets from the table (recorded
-    // at first insert; avoids a dense side array during phase A), then the
-    // table is done — the rc map below never probes it.
-    std::vector<uint32_t> rep_of;
-    try { rep_of.resize(U_f, UINT32_MAX); } catch (...) { return -1; }
-    for (const Entry& e : table.slots) {
-        if (e.hash != 0) rep_of[e.gid] = e.rep;
-    }
-    table.slots.clear();
-    table.slots.shrink_to_fit();
+    pt.mark(use_partitioned ? "A fwd hash (part)" : "A fwd hash");
 
     // ---- phase B+C: union ranks by sort-merge, no hashing ----
     // The old phase B probed the table once per group to find/insert each

@@ -110,3 +110,31 @@ int32_t sk_occ_index_finish(int64_t*, int64_t*, int32_t*, int32_t*, int32_t*) { 
     finally:
         monkeypatch.delenv("AUTOCYCLER_NATIVE_LIB")
         importlib.reload(native_mod)
+
+
+def test_occ_index_partitioned_phase_a_parity(monkeypatch):
+    """The opt-in cache-partitioned phase A (AUTOCYCLER_SK_PARTITION=1) must
+    produce exactly the streaming variant's index — every semantic field —
+    despite a different provisional-gid discovery order."""
+    import numpy as np
+
+    from autocycler_tpu.models import Sequence
+    from autocycler_tpu.ops.kmers import build_kmer_index
+
+    rng = np.random.default_rng(9)
+    base = "".join(rng.choice(list("ACGT"), size=5000))
+    seq_strs = [base[i * 37 % 5000:] + base[:i * 37 % 5000] for i in range(6)]
+
+    def build():
+        seqs = [Sequence.with_seq(i + 1, s, "f.fasta", f"c{i}", 1)
+                for i, s in enumerate(seq_strs)]
+        return build_kmer_index(seqs, 21)
+
+    monkeypatch.setenv("AUTOCYCLER_SK_PARTITION", "1")
+    part = build()
+    monkeypatch.setenv("AUTOCYCLER_SK_PARTITION", "0")
+    stream = build()
+    for f in ("depth", "rep_byte", "rev_kid", "prefix_gid", "suffix_gid",
+              "out_count", "in_count", "succ", "first_pos", "fwd_gid"):
+        a, b = getattr(part, f), getattr(stream, f)
+        assert a is not None and np.array_equal(a, b), f

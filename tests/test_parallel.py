@@ -175,3 +175,37 @@ def test_batched_membership_seq_axis_4():
     for m, w, inter in zip(M_list, w_list, inters):
         expect = (m.astype(np.int64) * w[None, :]) @ m.astype(np.int64).T
         assert np.array_equal(inter, expect)
+
+
+def test_mesh_init_deadline(monkeypatch, capsys):
+    """A backend whose init never returns must surface a clear error within
+    the deadline instead of hanging `autocycler batch` forever (the
+    wedged-tunnel scenario)."""
+    import threading
+
+    import pytest
+
+    from autocycler_tpu.parallel import mesh as mesh_mod
+
+    monkeypatch.setenv("AUTOCYCLER_MESH_INIT_TIMEOUT", "0.1")
+
+    real_thread = threading.Thread
+
+    class HangingThread(real_thread):
+        def __init__(self, *a, **kw):
+            kw["target"] = lambda: threading.Event().wait(5)
+            super().__init__(*a, **kw)
+
+    monkeypatch.setattr(threading, "Thread", HangingThread)
+    with pytest.raises(RuntimeError, match="did not initialise"):
+        mesh_mod._devices_with_deadline()
+
+
+def test_mesh_init_passthrough():
+    """With a healthy backend the deadline guard returns jax.devices()
+    unchanged."""
+    import jax
+
+    from autocycler_tpu.parallel import mesh as mesh_mod
+
+    assert mesh_mod._devices_with_deadline() == jax.devices()
