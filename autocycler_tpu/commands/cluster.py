@@ -139,6 +139,22 @@ class TreeNode:
         return self.right.find_node(node_num)
 
 
+def _distances_to_matrix(distances: Dict[Tuple[int, int], float],
+                         pos: Dict[int, int], n: int) -> np.ndarray:
+    """One vectorised pass over a {(id_a, id_b): d} dict into a dense
+    [n, n] float64 matrix, +inf where absent; pairs whose ids are missing
+    from ``pos`` are ignored. Shared by upgma() and containment_counts()
+    so the scatter pattern can't drift between them."""
+    D = np.full((n, n), np.inf)
+    if distances:
+        idx = np.array([(pos.get(a, -1), pos.get(b, -1))
+                        for a, b in distances], np.int64).reshape(-1, 2)
+        vals = np.fromiter(distances.values(), np.float64, len(distances))
+        m = (idx[:, 0] >= 0) & (idx[:, 1] >= 0)
+        D[idx[m, 0], idx[m, 1]] = vals[m]
+    return D
+
+
 def upgma(distances: Dict[Tuple[int, int], float], sequences: List[Sequence]) -> TreeNode:
     """UPGMA over the symmetric distance map; merged clusters keep the id
     min(a, b); internal node ids count up from the largest sequence id; ties
@@ -149,22 +165,32 @@ def upgma(distances: Dict[Tuple[int, int], float], sequences: List[Sequence]) ->
     O(n²) matrix implementation below; the closest-pair tie-break (smallest
     id pair in sorted order) is preserved. Inter-cluster averages are the
     same sums of ORIGINAL pair distances divided once, accumulated in merge
-    order rather than flat order — mathematically identical, so only exact
-    float ties between candidate pairs could resolve differently (the
-    previous dict implementation summed in unordered set-iteration order,
-    so it made no stronger guarantee).
+    order rather than flat order — mathematically identical, but float
+    addition is not associative, so candidate-pair averages can differ from
+    the reference's flat re-summation by ulps: EXACT ties and ulp-level
+    near-ties between closest-pair candidates may resolve differently on
+    pathological inputs (the previous dict implementation summed in
+    unordered set-iteration order, so it made no stronger guarantee).
+
+    A pair missing from ``distances`` in BOTH directions is an error: the
+    matrix would otherwise treat it as distance 0 and merge it first, where
+    the dict implementation failed loudly during averaging.
     """
     ids = sorted(s.id for s in sequences)
     n = len(ids)
     pos = {a: i for i, a in enumerate(ids)}
-    D = np.zeros((n, n))
-    if distances:
-        # one vectorised pass over the dict (the wrapper must not
-        # reintroduce an O(n²) Python-loop constant at the 32k-sequence cap)
-        keys = np.array([(pos[a], pos[b]) for a, b in distances], np.int64)
-        vals = np.fromiter(distances.values(), np.float64, len(distances))
-        D[keys[:, 0], keys[:, 1]] = vals
-        D = np.maximum(D, D.T)   # fills any one-directional entries
+    D = _distances_to_matrix(distances, pos, n)
+    diag = np.diag(D).copy()
+    diag[np.isinf(diag)] = 0.0       # absent self-pairs are distance 0
+    np.fill_diagonal(D, diag)
+    D = np.minimum(D, D.T)           # fills any one-directional entries
+    if n > 1 and not np.isfinite(D).all():   # diagonal is finite, so any
+        #                                      inf is a missing off-diag pair
+        a, b = np.argwhere(~np.isfinite(D))[0]
+        raise ValueError(
+            f"distance map is missing pair ({ids[a]}, {ids[b]}): UPGMA "
+            "requires every sequence pair (an absent pair would otherwise "
+            "merge first as distance 0)")
     return upgma_matrix(D, ids)
 
 
@@ -351,9 +377,14 @@ def qc_clusters(tree: TreeNode, sequences: List[Sequence],
             count = cluster_assembly_count(sequences, c)
             if count < min_assemblies and not cluster_is_trusted(sequences, c):
                 qc_results[c].failure_reasons.append("present in too few assemblies")
+        # the pair-count matrices are cluster-assignment-dependent but not
+        # qc-status-dependent, so they are computed once; the sequential
+        # loop below still sees earlier containment failures through
+        # qc_results, exactly like the reference's per-cluster re-check
+        counts = containment_counts(sequences, distances, cutoff)
         for c in range(1, max_cluster + 1):
             container = cluster_is_contained_in_another(c, sequences, distances, cutoff,
-                                                        qc_results)
+                                                        qc_results, counts=counts)
             if container > 0 and not cluster_is_trusted(sequences, c):
                 qc_results[c].failure_reasons.append(
                     f"contained within cluster {container}")
@@ -387,30 +418,60 @@ def cluster_is_trusted(sequences: List[Sequence], c: int) -> bool:
     return any(s.cluster == c and s.is_trusted() for s in sequences)
 
 
+def containment_counts(sequences: List[Sequence],
+                       distances: Dict[Tuple[int, int], float],
+                       cutoff: float) -> Tuple[np.ndarray, np.ndarray]:
+    """Vectorised cross-cluster containment accounting (the reference counts
+    these pairs with nested per-cluster-pair loops, cluster.rs:692-723 —
+    O(S²) Python at the 32,767-sequence cap the position model supports).
+
+    One pass over the distance dict rebuilds the [S, S] matrix (the same
+    pattern as upgma()); the per-cluster-pair pair counts are then two
+    integer matmuls with the cluster-membership matrix. Returns
+    ``(contain, total)``, both [C+1, C+1] int64 where C is the max cluster
+    number: ``contain[c, o]`` = number of (a in c, b in o) pairs with
+    d(a,b) < d(b,a) and d(a,b) < cutoff; ``total[c, o]`` = |c| * |o|.
+    Pairs absent from the dict never count as contained (their distance is
+    +inf); the product flow always passes a complete matrix-derived dict."""
+    clustered = [s for s in sequences if s.cluster >= 1]
+    max_cluster = max((s.cluster for s in clustered), default=0)
+    if not clustered:
+        z = np.zeros((1, 1), np.int64)
+        return z, z
+    pos = {s.id: i for i, s in enumerate(clustered)}
+    S = len(clustered)
+    D = _distances_to_matrix(distances, pos, S)
+    contain_ab = (D < D.T) & (D < cutoff)
+    P = np.zeros((max_cluster + 1, S), np.int64)
+    P[np.array([s.cluster for s in clustered]), np.arange(S)] = 1
+    # uint8 cast: the matmul promotes with int64 P, so the result is the
+    # same exact integer count at 1/8 the temporary size (S² at the 32k
+    # sequence cap is the design point)
+    contain = P @ contain_ab.astype(np.uint8) @ P.T
+    sizes = P.sum(axis=1)
+    total = sizes[:, None] * sizes[None, :]
+    return contain, total
+
+
 def cluster_is_contained_in_another(cluster_num: int, sequences: List[Sequence],
                                     distances: Dict[Tuple[int, int], float],
-                                    cutoff: float, qc_results: Dict[int, ClusterQC]
+                                    cutoff: float, qc_results: Dict[int, ClusterQC],
+                                    counts: Optional[Tuple[np.ndarray, np.ndarray]] = None
                                     ) -> int:
     """A cluster is contained in a passing cluster when the majority of
     cross-pair distances are asymmetric and below the cutoff
-    (reference cluster.rs:692-723)."""
-    passed = [c for c, qc in qc_results.items() if qc.passed()]
-    for other in passed:
-        if other == cluster_num:
+    (reference cluster.rs:692-723). The pair counting is vectorised in
+    :func:`containment_counts`; callers checking many clusters (qc_clusters)
+    compute the matrices once and pass them as ``counts``. The first passing
+    cluster in qc_results iteration order wins, as in the reference."""
+    contain, total = counts if counts is not None else \
+        containment_counts(sequences, distances, cutoff)
+    C = contain.shape[0]
+    for other in (c for c, qc in qc_results.items() if qc.passed()):
+        if other == cluster_num or other >= C or cluster_num >= C:
             continue
-        contain, total = 0, 0
-        for a in sequences:
-            if a.cluster != cluster_num:
-                continue
-            for b in sequences:
-                if b.cluster != other:
-                    continue
-                total += 1
-                d_ab = distances[(a.id, b.id)]
-                d_ba = distances[(b.id, a.id)]
-                if d_ab < d_ba and d_ab < cutoff:
-                    contain += 1
-        if total and contain / total > 0.5:
+        t = total[cluster_num, other]
+        if t and contain[cluster_num, other] / t > 0.5:
             return other
     return 0
 

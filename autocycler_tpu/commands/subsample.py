@@ -4,9 +4,12 @@ subsets.
 Parity target: reference subsample.rs — FASTQ stats (count/bases/N50),
 subset depth formula ``min_depth * log2(4 * total_depth / min_depth) / 2``,
 seeded shuffle, and ``count`` overlapping windows over the shuffled order.
-The shuffle is seeded and deterministic, but uses Python's Fisher-Yates
-rather than Rust StdRng, so the exact read partition differs from the
-reference for the same seed (the windowing scheme is identical).
+The shuffle is REPRODUCTION-EXACT against the reference for the same seed:
+utils/rust_rand.py reimplements rand 0.9's StdRng (ChaCha12) seeding +
+SliceRandom::shuffle bit-for-bit, gated by a runtime self-test of the
+cipher core; if that gate ever fails, the seeded Python Fisher-Yates is
+used instead and the divergence is stamped into subsample.yaml's
+``shuffle`` field.
 """
 
 from __future__ import annotations
@@ -108,9 +111,15 @@ def subsample(fastq_file, out_dir, genome_size: str, count: int = 4,
     reads_per_subset = calculate_subsets(details.count, details.bases, genome_size_int,
                                          min_read_depth)
 
-    rng = random.Random(seed)
-    read_order = list(range(details.count))
-    rng.shuffle(read_order)
+    from ..utils.rust_rand import std_rng_shuffled_order
+    read_order = std_rng_shuffled_order(details.count, seed)
+    if read_order is not None:
+        metrics.shuffle = "rust-stdrng-0.9"
+    else:  # cipher self-test failed: legacy shuffle, recorded divergence
+        metrics.shuffle = "python-fisher-yates"
+        rng = random.Random(seed)
+        read_order = list(range(details.count))
+        rng.shuffle(read_order)
     subset_index_sets = [subsample_indices(count, reads_per_subset, read_order, i)
                          for i in range(count)]
     files = []

@@ -90,6 +90,11 @@ class SubsampleMetrics(MetricsBase):
     input_read_count: int = 0
     input_read_bases: int = 0
     input_read_n50: int = 0
+    # which shuffle produced the read partition: "rust-stdrng-0.9" =
+    # reproduction-exact vs the reference for the same seed
+    # (utils/rust_rand.py); "python-fisher-yates" = the documented-divergent
+    # fallback. Stamped so users can detect partition compatibility.
+    shuffle: str = ""
     output_reads: List[ReadSetDetails] = field(default_factory=list)
 
 

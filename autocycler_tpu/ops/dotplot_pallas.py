@@ -55,13 +55,22 @@ def _pad_to(words: np.ndarray, tile: int, fill: int) -> np.ndarray:
     return out
 
 
-def _grid_kernel(n_a, n_b, tile_a, tile_b, a_ref, b_ref, out_ref):
+def _grid_kernel(n_a, n_b, tile_a, tile_b, ga, gb, a_ref, b_ref, out_ref):
+    """VPU word-compare grid with sub-grid output accumulation: grid step
+    (I, J, a, b) computes the scalar count of tile (I*8 + a, J*128 + b) and
+    deposits it into element (a, b) of the (8, 128) output block owned by
+    (I, J). The block stays VMEM-resident across the 1024 inner steps (the
+    out index_map ignores a, b) and is written to HBM ONCE — round 4's
+    version broadcast each scalar over its own (8, 128) tile, a 1024x
+    output-bandwidth waste flagged by the round-4 verdict."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
-    i = pl.program_id(0)
-    j = pl.program_id(1)
+    ti = pl.program_id(0) * 8 + pl.program_id(2)     # global tile row
+    tj = pl.program_id(1) * 128 + pl.program_id(3)   # global tile col
+    a = pl.program_id(2)
+    b = pl.program_id(3)
 
     def count(masked):
         eq = a_ref[0, :].reshape(-1, 1) == b_ref[0, :].reshape(1, -1)
@@ -72,29 +81,41 @@ def _grid_kernel(n_a, n_b, tile_a, tile_b, a_ref, b_ref, out_ref):
             # out-of-band fill value (an all-T k-mer word is -1, colliding
             # with any constant)
             row = (jax.lax.broadcasted_iota(jnp.int32, (tile_a, 1), 0)
-                   + i * tile_a)
+                   + ti * tile_a)
             col = (jax.lax.broadcasted_iota(jnp.int32, (1, tile_b), 1)
-                   + j * tile_b)
+                   + tj * tile_b)
             eq &= (row < n_a) & (col < n_b)
-        # Each program owns one (8, 128) output tile with the count
-        # broadcast across it, strided back out afterwards. Mosaic rejects
-        # smaller output blocks — (1, 1), including in SMEM space, fails its
-        # divisible-by-(8, 128) store constraint — so the 1024x output
-        # padding is the price of scalar-per-program results.
-        return jnp.broadcast_to(eq.sum(dtype=jnp.int32), out_ref.shape)
+        return eq.sum(dtype=jnp.int32)
+
+    # deposit into the resident block via one-hot (scalar dynamic stores
+    # are not a Mosaic strength; a (8, 128) VMEM select is free next to the
+    # tile_a x tile_b compare)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 1)
+    onehot = (rows == a) & (cols == b)
 
     # Only the last tile row/column can contain padding; interior programs
     # skip the two iota compares + and per cell (measured 315 -> 459
-    # Gcells/s at 512k^2 on v5e with 2048x4096 tiles).
-    interior = ((i + 1) * tile_a <= n_a) & ((j + 1) * tile_b <= n_b)
+    # Gcells/s at 512k^2 on v5e with 2048x4096 tiles). Tiles past the grid
+    # edge (the sub-grid rounds ga/gb up to 8/128) skip the compare
+    # entirely and deposit 0.
+    in_grid = (ti < ga) & (tj < gb)
+    interior = in_grid & ((ti + 1) * tile_a <= n_a) & ((tj + 1) * tile_b <= n_b)
+    edge = in_grid & ~interior
+
+    first = (a == 0) & (b == 0)
+
+    @pl.when(first)
+    def _():
+        out_ref[:, :] = jnp.zeros_like(out_ref)
 
     @pl.when(interior)
     def _():
-        out_ref[:, :] = count(False)
+        out_ref[:, :] = out_ref[:, :] + jnp.where(onehot, count(False), 0)
 
-    @pl.when(~interior)
+    @pl.when(edge)
     def _():
-        out_ref[:, :] = count(True)
+        out_ref[:, :] = out_ref[:, :] + jnp.where(onehot, count(True), 0)
 
 
 def match_grid(a_words: np.ndarray, b_words: np.ndarray,
@@ -112,20 +133,29 @@ def match_grid(a_words: np.ndarray, b_words: np.ndarray,
     b_pad = _pad_to(b_words, tile_b, -2)
     ga = a_pad.shape[1] // tile_a
     gb = b_pad.shape[1] // tile_b
+    GA = -(-ga // 8)        # output blocks: 8 tile rows x 128 tile cols
+    GB = -(-gb // 128)
+
+    def a_map(I, J, a, b):  # noqa: E741 — grid index names
+        # clamp: sub-grid tiles past the edge load a valid (ignored) block
+        return (0, jnp.minimum(I * 8 + a, ga - 1))
+
+    def b_map(I, J, a, b):
+        return (0, jnp.minimum(J * 128 + b, gb - 1))
 
     interpret = jax.default_backend() != "tpu"
     tiles = pl.pallas_call(
-        functools.partial(_grid_kernel, n_a, n_b, tile_a, tile_b),
-        grid=(ga, gb),
+        functools.partial(_grid_kernel, n_a, n_b, tile_a, tile_b, ga, gb),
+        grid=(GA, GB, 8, 128),
         in_specs=[
-            pl.BlockSpec((W, tile_a), lambda i, j: (0, i)),
-            pl.BlockSpec((W, tile_b), lambda i, j: (0, j)),
+            pl.BlockSpec((W, tile_a), a_map),
+            pl.BlockSpec((W, tile_b), b_map),
         ],
-        out_specs=pl.BlockSpec((8, 128), lambda i, j: (i, j)),
-        out_shape=jax.ShapeDtypeStruct((ga * 8, gb * 128), jnp.int32),
+        out_specs=pl.BlockSpec((8, 128), lambda I, J, a, b: (I, J)),
+        out_shape=jax.ShapeDtypeStruct((GA * 8, GB * 128), jnp.int32),
         interpret=interpret,
     )(jnp.asarray(a_pad), jnp.asarray(b_pad))
-    return tiles[::8, ::128]
+    return tiles[:ga, :gb]
 
 
 TILE_MXU = 1024
@@ -159,21 +189,40 @@ def expand_pm1_words(words, k: int, n_valid: int = None, dtype="bfloat16"):
     return pm
 
 
-def _mxu_kernel(two_k, acc_dtype, a_ref, b_ref, out_ref):
+def _mxu_kernel(two_k, acc_dtype, ga, gb, a_ref, b_ref, out_ref):
+    """±1-matmul grid with the same sub-grid output accumulation as
+    _grid_kernel: inner step (a, b) deposits its scalar into element (a, b)
+    of the (8, 128) block resident for (I, J)."""
     import jax
     import jax.numpy as jnp
+    from jax.experimental import pallas as pl
 
-    # ±1 inputs: row dots are integers in [-2k, 2k] — exact in int32
-    # trivially, and exact in f32 for any k (|dot| <= 512 << 2^24). Mosaic
-    # REQUIRES a 32-bit matmul accumulator ('Expected matmul acc to be
-    # 32-bit' — a bf16 preferred_element_type compiles under interpret mode
-    # but fails verification on the chip), so the M tile is materialised at
-    # 4 B/cell either way.
-    m = jax.lax.dot_general(a_ref[:, :], b_ref[:, :],
-                            (((1,), (1,)), ((), ())),
-                            preferred_element_type=acc_dtype)
-    count = jnp.sum((m == two_k).astype(jnp.int32))
-    out_ref[:, :] = jnp.broadcast_to(count, out_ref.shape)
+    ti = pl.program_id(0) * 8 + pl.program_id(2)
+    tj = pl.program_id(1) * 128 + pl.program_id(3)
+    a = pl.program_id(2)
+    b = pl.program_id(3)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 1)
+    onehot = (rows == a) & (cols == b)
+    in_grid = (ti < ga) & (tj < gb)
+
+    @pl.when((a == 0) & (b == 0))
+    def _():
+        out_ref[:, :] = jnp.zeros_like(out_ref)
+
+    @pl.when(in_grid)
+    def _():
+        # ±1 inputs: row dots are integers in [-2k, 2k] — exact in int32
+        # trivially, and exact in f32 for any k (|dot| <= 512 << 2^24).
+        # Mosaic REQUIRES a 32-bit matmul accumulator ('Expected matmul acc
+        # to be 32-bit' — a bf16 preferred_element_type compiles under
+        # interpret mode but fails verification on the chip). Tile padding
+        # rows are zeroed by expand_pm1_words and dot to 0 != 2k.
+        m = jax.lax.dot_general(a_ref[:, :], b_ref[:, :],
+                                (((1,), (1,)), ((), ())),
+                                preferred_element_type=acc_dtype)
+        count = jnp.sum((m == two_k).astype(jnp.int32))
+        out_ref[:, :] = out_ref[:, :] + jnp.where(onehot, count, 0)
 
 
 def match_grid_mxu(a_words: np.ndarray, b_words: np.ndarray, k: int,
@@ -226,22 +275,26 @@ def _mxu_run_impl(a_pad, b_pad, *, k, n_a, n_b, tile_a, tile_b, in_dtype):
 
     ga = a_pad.shape[1] // tile_a
     gb = b_pad.shape[1] // tile_b
+    GA = -(-ga // 8)
+    GB = -(-gb // 128)
     D = 2 * k
     acc = jnp.int32 if in_dtype == "int8" else jnp.float32
     a_pm = expand_pm1_words(a_pad, k, n_valid=n_a, dtype=in_dtype)
     b_pm = expand_pm1_words(b_pad, k, n_valid=n_b, dtype=in_dtype)
     tiles = pl.pallas_call(
-        ft.partial(_mxu_kernel, 2 * k, acc),
-        grid=(ga, gb),
+        ft.partial(_mxu_kernel, 2 * k, acc, ga, gb),
+        grid=(GA, GB, 8, 128),
         in_specs=[
-            pl.BlockSpec((tile_a, D), lambda i, j: (i, 0)),
-            pl.BlockSpec((tile_b, D), lambda i, j: (j, 0)),
+            pl.BlockSpec((tile_a, D),
+                         lambda I, J, a, b: (jnp.minimum(I * 8 + a, ga - 1), 0)),
+            pl.BlockSpec((tile_b, D),
+                         lambda I, J, a, b: (jnp.minimum(J * 128 + b, gb - 1), 0)),
         ],
-        out_specs=pl.BlockSpec((8, 128), lambda i, j: (i, j)),
-        out_shape=jax.ShapeDtypeStruct((ga * 8, gb * 128), jnp.int32),
+        out_specs=pl.BlockSpec((8, 128), lambda I, J, a, b: (I, J)),
+        out_shape=jax.ShapeDtypeStruct((GA * 8, GB * 128), jnp.int32),
         interpret=jax.default_backend() != "tpu",
     )(a_pm, b_pm)
-    return tiles[::8, ::128]
+    return tiles[:ga, :gb]
 
 
 @functools.lru_cache(maxsize=None)
@@ -273,6 +326,13 @@ def _tile_bits_fn(W: int, tile_a: int, tile_b: int):
     return jax.jit(run)
 
 
+# device+host bytes ceiling for one packed-bits dispatch (the same budget
+# discipline as the trim traceback's _TRACEBACK_BITS_BUDGET): repeat-rich
+# sequences can light up thousands of nonzero tiles, and an unchunked
+# dispatch would materialise [T, tile_a, tile_b/32] for ALL of them at once
+_TILE_BITS_BUDGET = 256 * 1024 * 1024
+
+
 def match_tile_bits(a_words: np.ndarray, b_words: np.ndarray, tile_pairs,
                     tile_a: int = TILE_A, tile_b: int = TILE_B) -> np.ndarray:
     """Device-side refinement of selected tiles (VERDICT r3 item 4): for
@@ -281,7 +341,13 @@ def match_tile_bits(a_words: np.ndarray, b_words: np.ndarray, tile_pairs,
     bitmasks ([T, tile_a, tile_b//32], bit j of word j//32 = cell (i, j)
     matches). The host only unpacks set bits (commands.dotplot), instead of
     re-running the W-word compare per nonzero tile. Tile padding cells
-    compare against sentinel-filled pads (-1/-2), which never match."""
+    compare against sentinel-filled pads (-1/-2), which never match.
+
+    Dispatches are chunked under _TILE_BITS_BUDGET bytes and each chunk's
+    pair count is padded to the next power of two (repeating the last
+    pair), so memory stays bounded and the jitted refinement compiles for
+    O(log T) shape classes instead of every distinct tile count (advisor
+    r4 finding)."""
     import jax.numpy as jnp
 
     W = a_words.shape[0]
@@ -289,10 +355,26 @@ def match_tile_bits(a_words: np.ndarray, b_words: np.ndarray, tile_pairs,
     b_pad = _pad_to(b_words, tile_b, -2)
     tis = np.asarray([p[0] for p in tile_pairs], np.int32)
     tjs = np.asarray([p[1] for p in tile_pairs], np.int32)
-    out = _tile_bits_fn(W, tile_a, tile_b)(
-        jnp.asarray(a_pad), jnp.asarray(b_pad), jnp.asarray(tis),
-        jnp.asarray(tjs))
-    return np.asarray(out)
+    T = len(tis)
+    if T == 0:
+        return np.zeros((0, tile_a, tile_b // 32), np.uint32)
+    per_tile = tile_a * (tile_b // 32) * 4
+    max_chunk = max(_TILE_BITS_BUDGET // per_tile, 1)
+    fn = _tile_bits_fn(W, tile_a, tile_b)
+    a_d, b_d = jnp.asarray(a_pad), jnp.asarray(b_pad)
+    chunks = []
+    for lo in range(0, T, max_chunk):
+        ci, cj = tis[lo:lo + max_chunk], tjs[lo:lo + max_chunk]
+        n = len(ci)
+        padded = 1
+        while padded < n:
+            padded <<= 1
+        if padded != n:   # repeat the last pair; sliced off below
+            ci = np.concatenate([ci, np.full(padded - n, ci[-1], np.int32)])
+            cj = np.concatenate([cj, np.full(padded - n, cj[-1], np.int32)])
+        out = fn(a_d, b_d, jnp.asarray(ci), jnp.asarray(cj))
+        chunks.append(np.asarray(out)[:n])
+    return np.concatenate(chunks, axis=0)
 
 
 def unpack_tile_bits(packed: np.ndarray) -> np.ndarray:

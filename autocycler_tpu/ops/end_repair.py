@@ -165,8 +165,12 @@ def sequence_end_repair(sequences: List[Sequence], k_size: int,
         except Exception as e:  # noqa: BLE001 — visible fallback, same
             # contract as the k-mer grouping dispatch
             import sys
-            print(f"autocycler: device end-repair grouping failed "
-                  f"({type(e).__name__}: {e}); falling back to host backend",
+
+            from ..utils.timing import record_device_failure
+            what = (f"device end-repair grouping failed "
+                    f"({type(e).__name__}: {e})")
+            record_device_failure(what)
+            print(f"autocycler: {what}; falling back to host backend",
                   file=sys.stderr)
     if by_query is None:
         by_query = _matches_by_query_native(buf, text_off, text_len, h,

@@ -1,0 +1,321 @@
+"""Multi-word bitonic sort network — the Pallas k-mer grouping kernel.
+
+This is the device grouping engine mandated since round 3: a sorting
+NETWORK, because that is the only sort shape a TPU runs well. TPUs have no
+fast random scatter, so hash grouping (the native kernel's approach,
+reference kmer_graph.rs:86-134) and GPU-style radix partitioning are off
+the table; what the VPU does superbly is regular compare-exchange over
+(8, 128) vectors. A bitonic network is nothing but compare-exchanges at
+power-of-two distances with static control flow — every exchange is two
+`roll`s and a `select` over VMEM-resident tiles, and every pass streams
+HBM sequentially.
+
+Why not XLA's own sort?  Three reasons, all measured in earlier rounds:
+- `jnp.lexsort` over W+1 operands builds one variadic sort whose compile
+  takes MINUTES per shape on this platform (docs/architecture.md);
+- the LSD fallback (ops/kmers.py `_rank_windows_traced_lsd`) avoids the
+  compile wall but pays W sequential 2-operand `sort_key_val`s plus a
+  per-pass re-key gather — every pass re-reads and re-writes every word;
+- neither fuses: this kernel sorts the full record (W key words + index)
+  in ONE network. All substages with distance < the VMEM block run fused
+  inside one kernel invocation, so HBM is touched once per stage plus once
+  per global substage — ~(m - L) * (m - L + 3) / 2 + m sweeps for
+  N = 2**m and blocks of 2**L elements, independent of W.
+
+Layout: each of the W+1 int32 arrays (key words most significant first,
+then the original index as tiebreaker — which also makes the comparator a
+total order, required because a bitonic exchange of EQUAL keys is not
+consistent between the two sides of the pair) is a [R, 128] matrix,
+element i at (row i // 128, lane i % 128). A compare-exchange at distance
+d is elementwise:
+
+    partner = where((i & d) == 0, roll(x, -d), roll(x, +d))
+    swap    = where((i & d == 0) == ascending(i), self > partner,
+                    partner > self)
+
+with the roll on the lane axis for d < 128 and on the row axis otherwise;
+``ascending(i) = (i & 2**s) == 0`` for stage s.
+
+The network is a Pallas/XLA hybrid, split where each engine is strongest:
+- `_local_stages_kernel` (Pallas) — all substages with d < block elements,
+  fused over a VMEM-resident block: used once for the initial per-block
+  sort (stages 1..L — the majority of all compare-exchanges in one HBM
+  sweep) and once per later stage for its local tail. This fusion is the
+  part XLA cannot do: its own ops materialise every substage to HBM.
+- `_global_exchange_jnp` (XLA) — one substage with d >= block elements as
+  a reshape + elementwise compare/select: block A of each pair is the
+  (i & d) == 0 side, lane-for-lane against block B. One read + one write
+  per array — the same HBM traffic a hand-written pair kernel would pay,
+  without per-pair DMA choreography or a kernel compile per distance;
+  wide fusable elementwise work is exactly what XLA is already good at.
+
+`sortnet_reference` runs the identical network in numpy as the tests'
+oracle (the networks must match EXACTLY, not just both be valid sorts,
+because the device kernel is validated block-by-block against it).
+
+Padding: callers pad n to a power of two with INT32_MAX key words — real
+key words are base-5 packed (< 5**13, ops/kmers.py) so MAX is out of band
+and pads sort strictly last.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+INT32_MAX = np.int32(2**31 - 1)
+
+# default rows per VMEM block: 1024 rows x 128 lanes = 2**17 elements;
+# 5 arrays x 0.5 MB in + out + partner temporaries stays well inside the
+# ~16 MB VMEM budget
+DEFAULT_BLOCK_ROWS = 1024
+
+
+def _ceil_pow2(n: int) -> int:
+    m = 1
+    while m < n:
+        m <<= 1
+    return m
+
+
+# ---------------------------------------------------------------------------
+# numpy reference network (oracle for the Pallas kernels)
+# ---------------------------------------------------------------------------
+
+
+def _lex_gt_np(a: List[np.ndarray], b: List[np.ndarray]) -> np.ndarray:
+    gt = np.zeros(a[0].shape, dtype=bool)
+    eq = np.ones(a[0].shape, dtype=bool)
+    for x, y in zip(a, b):
+        gt |= eq & (x > y)
+        eq &= x == y
+    return gt
+
+
+def sortnet_reference(arrays: Sequence[np.ndarray]) -> List[np.ndarray]:
+    """Run the exact bitonic network on host. ``arrays`` = key words most
+    significant first; the element tuples MUST be pairwise distinct (append
+    an index array as the last word — ties make bitonic exchanges
+    inconsistent). Returns the sorted arrays. O(n log² n) — tests only."""
+    arrs = [np.asarray(a, np.int32).copy() for a in arrays]
+    n = len(arrs[0])
+    N = _ceil_pow2(max(n, 2))
+    if N != n:
+        arrs = [np.concatenate([a, np.full(N - n, INT32_MAX, np.int32)])
+                for a in arrs]
+        # keep tuples distinct among pads: the last array is the tiebreak
+        arrs[-1][n:] = n + np.arange(N - n)
+    i = np.arange(N)
+    m = N.bit_length() - 1
+    for s in range(1, m + 1):
+        asc = (i & (1 << s)) == 0
+        for t in range(s, 0, -1):
+            d = 1 << (t - 1)
+            partner = [a[i ^ d] for a in arrs]
+            self_gt = _lex_gt_np(arrs, partner)
+            lower = (i & d) == 0
+            want_swap = np.where(lower == asc, self_gt, ~self_gt)
+            arrs = [np.where(want_swap, p, a) for a, p in zip(arrs, partner)]
+    return [a[:n] for a in arrs]
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernels
+# ---------------------------------------------------------------------------
+
+
+def _lex_gt(a, b):
+    import jax.numpy as jnp
+
+    gt = jnp.zeros(a[0].shape, dtype=bool)
+    eq = jnp.ones(a[0].shape, dtype=bool)
+    for x, y in zip(a, b):
+        gt = gt | (eq & (x > y))
+        eq = eq & (x == y)
+    return gt
+
+
+def _block_exchange(arrs, d: int, asc):
+    """One in-VMEM compare-exchange at distance d (< block elements) over
+    [Rb, 128] tiles. ``asc`` is the ascending mask (same shape)."""
+    import jax.numpy as jnp
+
+    if d < 128:
+        lane = jnp.arange(128, dtype=jnp.int32)[None, :]
+        lower = (lane & d) == 0
+        partner = [jnp.where(lower, jnp.roll(a, -d, axis=1),
+                             jnp.roll(a, d, axis=1)) for a in arrs]
+    else:
+        D = d // 128
+        row = jnp.arange(arrs[0].shape[0], dtype=jnp.int32)[:, None]
+        lower = (row & D) == 0
+        partner = [jnp.where(lower, jnp.roll(a, -D, axis=0),
+                             jnp.roll(a, D, axis=0)) for a in arrs]
+    self_gt = _lex_gt(arrs, partner)
+    swap = jnp.where(lower == asc, self_gt, ~self_gt)
+    return [jnp.where(swap, p, a) for a, p in zip(arrs, partner)]
+
+
+def _local_stages_kernel(stages, block_rows: int, *refs):
+    """Fused local substages over one VMEM block. ``stages`` is a static
+    list of (stage_bit s, [distances d...]) with every d < block elements.
+    refs = in_refs + out_refs (aliased in-place)."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    n_arr = len(refs) // 2
+    in_refs, out_refs = refs[:n_arr], refs[n_arr:]
+    b = pl.program_id(0)
+    base = b * block_rows * 128
+    row = jnp.arange(block_rows, dtype=jnp.int32)[:, None]
+    lane = jnp.arange(128, dtype=jnp.int32)[None, :]
+    elem = base + row * 128 + lane
+    arrs = [r[:, :] for r in in_refs]
+    for s, dists in stages:
+        asc = ((elem >> s) & 1) == 0
+        for d in dists:
+            arrs = _block_exchange(arrs, d, asc)
+    for r, a in zip(out_refs, arrs):
+        r[:, :] = a
+
+
+def _global_exchange_jnp(arrs, s: int, d: int):
+    """One substage at distance d >= block elements, as plain XLA ops on
+    the flat [N] arrays: viewed as [N / (2d), 2, d], axis-1 slice 0 is the
+    (i & d) == 0 side, so the exchange is an elementwise compare + select
+    between the two slices — one read + one write of each array, the same
+    HBM traffic a hand-rolled pair kernel would pay, without per-pair DMA
+    choreography or per-distance kernel compiles. The fused VMEM work —
+    the vast majority of the network's compare-exchanges — stays in the
+    Pallas local kernel; these wide, fusable elementwise substages are
+    exactly what XLA is already good at."""
+    import jax.numpy as jnp
+
+    n_groups = arrs[0].shape[0] // (2 * d)
+    split = [a.reshape(n_groups, 2, d) for a in arrs]
+    a_side = [x[:, 0, :] for x in split]
+    b_side = [x[:, 1, :] for x in split]
+    gt = _lex_gt(a_side, b_side)
+    # ascending(i): bit s of the element index, constant per group because
+    # each group spans 2d <= 2**s elements aligned to a 2d boundary
+    g = jnp.arange(n_groups, dtype=jnp.int32)[:, None]
+    asc = (((g * 2 * d) >> s) & 1) == 0
+    swap = jnp.logical_xor(gt, jnp.logical_not(asc))
+    out = []
+    for a, b in zip(a_side, b_side):
+        new_a = jnp.where(swap, b, a)
+        new_b = jnp.where(swap, a, b)
+        out.append(jnp.stack([new_a, new_b], axis=1).reshape(-1))
+    return out
+
+
+def run_network(arrays, block_rows: int = DEFAULT_BLOCK_ROWS,
+                interpret: bool = False):
+    """The traced network body: sorts the parallel [N] int32 device arrays
+    lexicographically. Composable inside a larger jit (the grouping path
+    fuses packing + network + group-id extraction into ONE dispatch);
+    :func:`sortnet` wraps it in its own jit with donated buffers."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    n_arrays = len(arrays)
+    N = int(arrays[0].shape[0])
+    block_elems = block_rows * 128
+    L = block_elems.bit_length() - 1      # stages fully inside a block
+    m = N.bit_length() - 1
+    n_blocks = max(N // block_elems, 1)
+    R = N // 128
+
+    def local_call(arrs, stages):
+        spec = pl.BlockSpec((block_rows, 128), lambda b: (b, 0))
+        return list(pl.pallas_call(
+            functools.partial(_local_stages_kernel, tuple(stages),
+                              block_rows),
+            grid=(n_blocks,),
+            in_specs=[spec] * n_arrays,
+            out_specs=[spec] * n_arrays,
+            out_shape=[jax.ShapeDtypeStruct((R, 128), jnp.int32)] * n_arrays,
+            input_output_aliases={j: j for j in range(n_arrays)},
+            interpret=interpret,
+        )(*arrs))
+
+    arrs = [a.reshape(R, 128) for a in arrays]
+    if m <= L:
+        arrs = local_call(
+            arrs, [(s, [1 << (t - 1) for t in range(s, 0, -1)])
+                   for s in range(1, m + 1)])
+        return [a.reshape(-1) for a in arrs]
+    arrs = local_call(
+        arrs, [(s, [1 << (t - 1) for t in range(s, 0, -1)])
+               for s in range(1, L + 1)])
+    for s in range(L + 1, m + 1):
+        flat = [a.reshape(-1) for a in arrs]
+        for t in range(s, L, -1):
+            flat = _global_exchange_jnp(flat, s, 1 << (t - 1))
+        arrs = [a.reshape(R, 128) for a in flat]
+        arrs = local_call(
+            arrs, [(s, [1 << (t - 1) for t in range(L, 0, -1)])])
+    return [a.reshape(-1) for a in arrs]
+
+
+def network_sweeps(N: int, block_rows: int = DEFAULT_BLOCK_ROWS) -> int:
+    """Number of full HBM read+write sweeps the network makes over the
+    arrays — the bandwidth anchor for MFU accounting (ops/mfu.py)."""
+    block_elems = block_rows * 128
+    L = block_elems.bit_length() - 1
+    m = max(N.bit_length() - 1, 1)
+    if m <= L:
+        return 1
+    sweeps = 1                             # initial local sort
+    for s in range(L + 1, m + 1):
+        sweeps += (s - L) + 1              # global substages + local tail
+    return sweeps
+
+
+@functools.lru_cache(maxsize=None)
+def _sortnet_fn(n_arrays: int, N: int, block_rows: int, interpret: bool):
+    """One jitted function running the whole network for (n_arrays, N)."""
+    import jax
+
+    def run(*arrays):
+        return run_network(list(arrays), block_rows=block_rows,
+                           interpret=interpret)
+
+    return jax.jit(run, donate_argnums=tuple(range(n_arrays)))
+
+
+def sortnet(arrays: Sequence, block_rows: int = DEFAULT_BLOCK_ROWS,
+            interpret: bool = False) -> List:
+    """Sort parallel int32 device arrays lexicographically (first array
+    most significant, last array MUST make the tuples pairwise distinct —
+    pass an index array). Length must be a power of two >= 128 *
+    block_rows; use :func:`sortnet_padded` for arbitrary n."""
+    n_arrays = len(arrays)
+    N = int(arrays[0].shape[0])
+    if N & (N - 1):
+        raise ValueError(f"sortnet length {N} is not a power of two")
+    if N < block_rows * 128:
+        raise ValueError(f"sortnet length {N} < one block "
+                         f"({block_rows * 128}); pad or shrink block_rows")
+    fn = _sortnet_fn(n_arrays, N, block_rows, interpret)
+    return list(fn(*arrays))
+
+
+def sortnet_padded(words: Sequence, n: int,
+                   block_rows: int = DEFAULT_BLOCK_ROWS,
+                   interpret: bool = False) -> Tuple[List, object]:
+    """Pad (words..., iota index) to the network size with INT32_MAX
+    sentinels, sort on device, and return (sorted word arrays, sorted
+    original indices) trimmed back to n."""
+    import jax.numpy as jnp
+
+    N = max(_ceil_pow2(max(n, 1)), block_rows * 128)
+    pad = N - n
+    arrs = [jnp.pad(jnp.asarray(w, jnp.int32), (0, pad),
+                    constant_values=int(INT32_MAX)) for w in words]
+    idx = jnp.arange(N, dtype=jnp.int32)
+    out = sortnet(arrs + [idx], block_rows=block_rows, interpret=interpret)
+    return [o[:n] for o in out[:-1]], out[-1][:n]

@@ -39,7 +39,14 @@ def _devices_with_deadline():
     fail with a clear error instead of hanging the pipeline indefinitely.
     AUTOCYCLER_MESH_INIT_TIMEOUT (default 600 s — first TPU init through a
     healthy tunnel can take minutes) bounds the wait; <= 0 skips the
-    guard."""
+    guard.
+
+    The timeout error is TERMINAL for this process: the abandoned daemon
+    thread may still be blocked inside jax backend init, so catching the
+    RuntimeError and re-touching jax (e.g. a host fallback that imports
+    jax.numpy) can block on the same init lock or race a half-initialised
+    backend. Callers that want to survive a wedged device must run host
+    fallbacks in a fresh process, or pin JAX_PLATFORMS=cpu up front."""
     import os
     import sys
     import threading
@@ -88,4 +95,54 @@ def make_mesh(n_devices: Optional[int] = None, seq_parallel: Optional[int] = Non
         devices = devices[:n_devices]
     data, seq = mesh_axis_sizes(len(devices), seq_parallel)
     device_array = np.array(devices).reshape(data, seq)
+    return jax.sharding.Mesh(device_array, ("data", "seq"))
+
+
+def make_multihost_mesh(n_devices: Optional[int] = None,
+                        n_hosts: int = 2,
+                        seq_parallel: Optional[int] = None):
+    """A ('data', 'seq') mesh laid out for a multi-host topology
+    (BASELINE.json's "DCN only if needed" projection, VERDICT r4 item 8).
+
+    The layout rule is the scaling-book recipe applied to this workload:
+    the ONLY collectives are over 'seq' (the halo ppermute + the psum in
+    parallel/batch.py — ICI-class traffic), so 'seq' groups must never
+    straddle a host boundary; 'data' carries no collectives at all (the
+    isolates are independent), so it is the one axis allowed to span DCN.
+    Devices are taken host-major (each host's devices contiguous), every
+    'seq' row lives inside one host, and the 'data' axis runs across
+    hosts. With real multi-host devices the host-locality of every 'seq'
+    group is asserted via device.process_index; on a single-process
+    virtual mesh the assertion is vacuous and the projection is the
+    shape/layout math — which is exactly what the driver's CPU dry run
+    validates for bit-identity."""
+    import jax
+
+    devices = _devices_with_deadline()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"requested a {n_devices}-device mesh but only {len(devices)} "
+                "device(s) are available")
+        devices = devices[:n_devices]
+    n = len(devices)
+    if n % n_hosts != 0:
+        raise ValueError(f"{n} devices not divisible by {n_hosts} hosts")
+    per_host = n // n_hosts
+    data, seq = mesh_axis_sizes(n, seq_parallel)
+    if per_host % seq != 0:
+        raise ValueError(
+            f"seq={seq} does not fit within one host's {per_host} devices; "
+            "the seq axis (ICI collectives) must not straddle hosts")
+    # jax.devices() orders devices process-major already, so the flat
+    # host-major [host, local_data, seq] layout IS a straight reshape; the
+    # function's layout guarantees are carried by the divisibility checks
+    # above and the process-locality assertion below, not by reordering
+    device_array = np.array(devices).reshape(data, seq)
+    for row in device_array:
+        hosts = {getattr(d, "process_index", 0) for d in row}
+        if len(hosts) > 1:
+            raise ValueError(
+                f"seq group {list(row)} spans processes {sorted(hosts)}; "
+                "ICI collectives would ride DCN")
     return jax.sharding.Mesh(device_array, ("data", "seq"))

@@ -26,6 +26,8 @@ from .misc import format_duration
 _device_lock = threading.Lock()
 _device_seconds = 0.0
 _device_calls = 0
+_device_failures = 0
+_device_failure_last = ""
 
 
 @contextlib.contextmanager
@@ -54,6 +56,23 @@ def device_seconds() -> float:
 def device_calls() -> int:
     with _device_lock:
         return _device_calls
+
+
+def record_device_failure(what: str) -> None:
+    """Counts a device-path failure that fell back to host. The fallback
+    sites print to stderr, which benchmark artifacts truncate; this counter
+    makes 'did anything silently degrade?' answerable from the artifact
+    itself (VERDICT r4 item 1)."""
+    global _device_failures, _device_failure_last
+    with _device_lock:
+        _device_failures += 1
+        _device_failure_last = what
+
+
+def device_failures():
+    """(count, last failure description)."""
+    with _device_lock:
+        return _device_failures, _device_failure_last
 
 
 @contextlib.contextmanager

@@ -36,6 +36,89 @@ def test_cluster_is_contained_in_another():
     assert cluster_is_contained_in_another(2, seqs, d2, 0.2, qc) == 0
 
 
+def test_containment_counts_matches_pair_loop_semantics():
+    """The vectorised pair counting equals a direct nested-loop count on a
+    randomized many-cluster instance (the loop is the reference semantics,
+    cluster.rs:692-723)."""
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    n, n_clusters = 60, 5
+    seqs = [mkseq(i + 1, f"f{i % 4}.fasta", f"c{i}", 100,
+                  int(rng.integers(1, n_clusters + 1))) for i in range(n)]
+    d = {(a.id, b.id): float(rng.random()) for a in seqs for b in seqs}
+    cutoff = 0.4
+    from autocycler_tpu.commands.cluster import containment_counts
+
+    contain, total = containment_counts(seqs, d, cutoff)
+    for c in range(1, n_clusters + 1):
+        for o in range(1, n_clusters + 1):
+            expect_contain, expect_total = 0, 0
+            for a in seqs:
+                if a.cluster != c:
+                    continue
+                for b in seqs:
+                    if b.cluster != o:
+                        continue
+                    expect_total += 1
+                    if d[(a.id, b.id)] < d[(b.id, a.id)] and \
+                            d[(a.id, b.id)] < cutoff:
+                        expect_contain += 1
+            assert contain[c, o] == expect_contain, (c, o)
+            assert total[c, o] == expect_total, (c, o)
+
+
+def test_containment_counts_scales_to_thousands():
+    """No O(S²) Python pair loop on the containment path: a 2000-sequence
+    instance (4M pairs) must complete in seconds, not minutes (VERDICT r4
+    item 6 prescribes testing at a few thousand sequences)."""
+    import time
+
+    import numpy as np
+
+    from autocycler_tpu.commands.cluster import containment_counts
+
+    rng = np.random.default_rng(11)
+    S = 2000
+    seqs = [mkseq(i + 1, f"f{i % 8}.fasta", f"c{i}", 100,
+                  int(rng.integers(1, 9))) for i in range(S)]
+    ids = np.arange(1, S + 1)
+    vals = rng.random((S, S))
+    d = {(int(ids[a]), int(ids[b])): float(vals[a, b])
+         for a in range(S) for b in range(S)}
+    t0 = time.perf_counter()
+    contain, total = containment_counts(seqs, d, 0.3)
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 30.0, elapsed
+    # spot-check one cluster pair against the definition
+    c, o = 1, 2
+    members_c = [s for s in seqs if s.cluster == c]
+    members_o = [s for s in seqs if s.cluster == o]
+    expect = sum(1 for a in members_c for b in members_o
+                 if d[(a.id, b.id)] < d[(b.id, a.id)] and d[(a.id, b.id)] < 0.3)
+    assert contain[c, o] == expect
+    assert total[c, o] == len(members_c) * len(members_o)
+
+
+def test_upgma_missing_pair_fails_loudly():
+    """A pair absent from the distance map in both directions must raise,
+    not silently merge first as distance 0 (advisor r4 finding)."""
+    import pytest
+
+    from autocycler_tpu.commands.cluster import upgma
+
+    seqs = [mkseq(1, "a.fasta", "c1", 100, 0), mkseq(2, "b.fasta", "c2", 100, 0),
+            mkseq(3, "c.fasta", "c3", 100, 0)]
+    d = {(1, 2): 0.1, (2, 1): 0.1,
+         (1, 1): 0.0, (2, 2): 0.0, (3, 3): 0.0}  # (x, 3) pairs missing
+    with pytest.raises(ValueError, match="missing pair"):
+        upgma(d, seqs)
+    # one-directional entries are still accepted (filled symmetrically)
+    d.update({(1, 3): 0.5, (2, 3): 0.6})
+    root = upgma(d, seqs)
+    assert root is not None
+
+
 def test_trusted_contig_overrides_qc():
     tree = TreeNode(5, TreeNode(1), TreeNode(2), 0.05)
     # two tips from the same assembly; min_assemblies=2 would normally fail

@@ -161,6 +161,92 @@ def test_group_windows_lsd_matches_all_backends():
         assert (got[0] == exp[0]).all() and (got[1] == exp[1]).all(), k
 
 
+def test_resolve_generic_enable_needs_tpu_for_pallas(monkeypatch):
+    """AUTOCYCLER_DEVICE_GROUPING=1 selects the Pallas network only when a
+    TPU answers the probe; on host backends it falls back to the bucketed
+    XLA sort (interpret-mode Pallas at product scale is an effective hang,
+    not a fallback — advisor r5 finding)."""
+    from autocycler_tpu.ops import distance
+    from autocycler_tpu.ops.kmers import _resolve_use_jax
+
+    distance._tpu_attached.cache_clear()
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")   # probe short-circuits False
+    monkeypatch.setenv("AUTOCYCLER_DEVICE_GROUPING", "1")
+    assert _resolve_use_jax(None) == "bucketed"
+    # kmers imports the symbol at call time from the module, so patching
+    # the module attribute takes effect: TPU attached -> pallas
+    monkeypatch.setattr(distance, "_tpu_attached", lambda: True)
+    assert _resolve_use_jax(None) == "pallas"
+    monkeypatch.setenv("AUTOCYCLER_DEVICE_GROUPING", "pallas")
+    assert _resolve_use_jax(None) == "pallas"
+    monkeypatch.setenv("AUTOCYCLER_DEVICE_GROUPING", "lsd")
+    assert _resolve_use_jax(None) == "lsd"
+
+
+def test_pallas_interpret_scale_guard(monkeypatch, capsys):
+    """A product-scale pallas request on a host backend must fall back
+    visibly instead of grinding through the interpret simulator."""
+    import numpy as np
+
+    rng = np.random.default_rng(3)
+    codes = rng.integers(0, 5, size=(1 << 19) + 60).astype(np.uint8)
+    starts = np.arange(0, 1 << 19, dtype=np.int64)
+    gid, order = group_windows(codes, starts, 51, use_jax="pallas")
+    err = capsys.readouterr().err
+    assert "interpret mode is only viable" in err and "falling back" in err
+    assert len(gid) == len(starts)
+
+
+def test_group_windows_pallas_network_matches_all_backends(monkeypatch):
+    """The Pallas bitonic sort-network grouping (ops/sortnet.py, interpret
+    mode on the pinned-CPU backend) must produce the identical (order, gid)
+    as the host backends for every k word-count class. The network block is
+    shrunk so the interpret-mode simulation stays small (the real-chip path
+    uses 2**17-element blocks)."""
+    import numpy as np
+
+    from autocycler_tpu.ops import kmers
+
+    monkeypatch.setattr(kmers, "_PALLAS_BLOCK_ROWS", 8)
+    rng = np.random.default_rng(13)
+    for k in (1, 13, 27, 51):
+        codes = rng.integers(0, 5, size=700).astype(np.uint8)
+        starts = np.arange(0, 700 - k, dtype=np.int64)
+        exp = group_windows(codes, starts, k, use_jax=False)
+        got = group_windows(codes, starts, k, use_jax="pallas")
+        assert (got[0] == exp[0]).all() and (got[1] == exp[1]).all(), k
+
+
+def test_pallas_network_grouping_build_kmer_index(monkeypatch, capsys):
+    """A full build_kmer_index through the Pallas network grouping equals
+    the fused-native/numpy build — and must actually run on the device path
+    (no fallback note on stderr)."""
+    import numpy as np
+
+    from autocycler_tpu.ops import kmers
+    from autocycler_tpu.ops.kmers import build_kmer_index
+
+    monkeypatch.setattr(kmers, "_PALLAS_BLOCK_ROWS", 8)
+    rng = np.random.default_rng(17)
+    k = 11
+    seqs = []
+    base = "".join(rng.choice(list("ACGT"), size=150))
+    for i in range(3):
+        rot = int(rng.integers(0, 150))
+        # padding MUST be half_k = k // 2: an earlier revision passed 1 and
+        # the final windows read past the buffer — per-process heap garbage
+        # that made this test flake under load
+        seqs.append(Sequence.with_seq(i + 1, base[rot:] + base[:rot],
+                                      "f.fasta", f"c{i}", k // 2))
+    a = build_kmer_index(seqs, k, use_jax=False, use_fused=False)
+    b = build_kmer_index(seqs, k, use_jax="pallas", use_fused=False)
+    assert "falling back" not in capsys.readouterr().err
+    for f in ("depth", "rev_kid", "prefix_gid", "suffix_gid", "out_count",
+              "in_count", "first_pos", "occ_kid"):
+        assert np.array_equal(np.asarray(getattr(a, f)),
+                              np.asarray(getattr(b, f))), f
+
+
 def test_end_repair_identical_across_backends(monkeypatch):
     """sequence_end_repair must repair identical bytes via the device
     grouping (AUTOCYCLER_DEVICE_GROUPING=lsd), the native rolling-hash scan,

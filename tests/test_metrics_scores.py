@@ -41,7 +41,8 @@ def test_calculate_tightness_weights_by_cluster_size():
 
 def test_get_field_names():
     assert SubsampleMetrics.get_field_names() == \
-        ["input_read_bases", "input_read_count", "input_read_n50", "output_reads"]
+        ["input_read_bases", "input_read_count", "input_read_n50",
+         "output_reads", "shuffle"]
     assert InputAssemblyMetrics.get_field_names() == \
         ["compressed_unitig_count", "compressed_unitig_total_length",
          "input_assemblies_count", "input_assemblies_total_contigs",

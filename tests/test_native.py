@@ -126,7 +126,10 @@ def test_occ_index_partitioned_phase_a_parity(monkeypatch):
     seq_strs = [base[i * 37 % 5000:] + base[:i * 37 % 5000] for i in range(6)]
 
     def build():
-        seqs = [Sequence.with_seq(i + 1, s, "f.fasta", f"c{i}", 1)
+        # half_k must match k // 2 = 10: an earlier revision passed 1,
+        # making the final windows of each padded sequence read past its
+        # buffer (caught by build_kmer_index's padding guard, round 5)
+        seqs = [Sequence.with_seq(i + 1, s, "f.fasta", f"c{i}", 10)
                 for i, s in enumerate(seq_strs)]
         return build_kmer_index(seqs, 21)
 

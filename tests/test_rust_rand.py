@@ -1,0 +1,140 @@
+"""The bit-exact rand-0.9 StdRng shuffle (utils/rust_rand.py) used for
+reproduction-exact subsample parity (reference subsample.rs:143-145).
+
+Verification layers (no Rust toolchain exists in this image to diff
+against): the parametrised ChaCha core is diffed block-by-block against
+the `cryptography` package's ChaCha20 — including counter handling, by
+encoding the counter into the library's 16-byte nonce — which pins the
+quarter round, state layout and word serialisation; the published
+zero-seed first words then gate the 12-round reduction; the shuffle
+machinery is tested for its algebraic properties."""
+
+import numpy as np
+import pytest
+
+from autocycler_tpu.utils.rust_rand import (ChaCha12Rng, IncreasingUniform,
+                                            _calculate_bound_u32,
+                                            chacha_block, random_range_u32,
+                                            rust_shuffle, seed_from_u64,
+                                            self_test,
+                                            std_rng_shuffled_order)
+
+
+def _lib_keystream(key: bytes, nonce16: bytes, blocks: int) -> bytes:
+    cryptography = pytest.importorskip("cryptography")  # noqa: F841
+    from cryptography.hazmat.primitives.ciphers import Cipher, algorithms
+
+    algo = algorithms.ChaCha20(key, nonce16)
+    return Cipher(algo, mode=None).encryptor().update(b"\x00" * (64 * blocks))
+
+
+def test_chacha20_core_matches_cryptography_lib():
+    """Random keys and full 16-byte tails (counter + nonce words)."""
+    rng = np.random.default_rng(0)
+    for _ in range(8):
+        key = bytes(rng.integers(0, 256, size=32, dtype=np.uint8))
+        nonce = bytes(rng.integers(0, 256, size=16, dtype=np.uint8))
+        kw = [int.from_bytes(key[i:i + 4], "little") for i in range(0, 32, 4)]
+        tw = [int.from_bytes(nonce[i:i + 4], "little")
+              for i in range(0, 16, 4)]
+        mine = b"".join(w.to_bytes(4, "little")
+                        for w in chacha_block(kw, tw, 20))
+        assert mine == _lib_keystream(key, nonce, 1)
+
+
+def test_chacha12_rng_counter_layout_matches_lib():
+    """Successive next_u32 blocks must advance the 64-bit counter in words
+    12-13 exactly as the library does (counter encoded in the nonce's first
+    8 bytes)."""
+    key = bytes(range(32))
+    r = ChaCha12Rng(key)
+    got = b"".join(r.next_u32().to_bytes(4, "little") for _ in range(32))
+    # the library only exposes 20 rounds; check the layout with a 20-round
+    # twin of the RNG loop instead
+    blocks = []
+    for counter in (0, 1):
+        tail = [counter, 0, 0, 0]
+        kw = [int.from_bytes(key[i:i + 4], "little") for i in range(0, 32, 4)]
+        blocks.append(b"".join(w.to_bytes(4, "little")
+                               for w in chacha_block(kw, tail, 20)))
+    nonce = (0).to_bytes(8, "little") + (0).to_bytes(8, "little")
+    assert b"".join(blocks) == _lib_keystream(key, nonce, 2)
+    # and the 12-round RNG consumes blocks in the same counter order:
+    # words 16..31 must equal a fresh block with counter == 1
+    kw = [int.from_bytes(key[i:i + 4], "little") for i in range(0, 32, 4)]
+    block1 = b"".join(w.to_bytes(4, "little")
+                      for w in chacha_block(kw, [1, 0, 0, 0], 12))
+    assert got[64:] == block1
+
+
+def test_self_test_passes():
+    assert self_test() is True
+
+
+def test_seed_from_u64_deterministic_and_distinct():
+    a, b, c = seed_from_u64(0), seed_from_u64(0), seed_from_u64(1)
+    assert a == b and a != c and len(a) == 32
+
+
+def test_random_range_bounds_and_determinism():
+    rng = ChaCha12Rng(seed_from_u64(42))
+    vals = [random_range_u32(rng, 10) for _ in range(1000)]
+    assert all(0 <= v < 10 for v in vals)
+    assert len(set(vals)) == 10
+    rng2 = ChaCha12Rng(seed_from_u64(42))
+    assert vals == [random_range_u32(rng2, 10) for _ in range(1000)]
+
+
+def test_calculate_bound_u32():
+    # product of consecutive integers starting at m, largest fitting u32
+    for m in (1, 2, 3, 10, 1000, 2**16, 2**31):
+        product, count = _calculate_bound_u32(m)
+        assert product <= 2**32 - 1
+        check = 1
+        for j in range(count):
+            check *= m + j
+        assert check == product
+        assert product * (m + count) > 2**32 - 1
+
+
+def test_increasing_uniform_ranges():
+    rng = ChaCha12Rng(seed_from_u64(7))
+    chooser = IncreasingUniform(rng, 0)
+    for i in range(5000):
+        v = chooser.next_index()
+        assert 0 <= v <= i, (i, v)
+
+
+def test_rust_shuffle_is_permutation_and_seed_stable():
+    items = list(range(1000))
+    rust_shuffle(items, 0)
+    assert sorted(items) == list(range(1000))
+    assert items != list(range(1000))
+    again = list(range(1000))
+    rust_shuffle(again, 0)
+    assert items == again
+    other = list(range(1000))
+    rust_shuffle(other, 1)
+    assert other != items
+
+
+def test_std_rng_shuffled_order_smoke():
+    order = std_rng_shuffled_order(10, 0)
+    assert order is not None and sorted(order) == list(range(10))
+
+
+def test_subsample_stamps_shuffle_into_yaml(tmp_path):
+    """subsample.yaml records which shuffle produced the partition."""
+    from autocycler_tpu.commands.subsample import subsample
+
+    reads = []
+    rng = np.random.default_rng(3)
+    for i in range(120):
+        seq = "".join(rng.choice(list("ACGT"), size=300))
+        reads.append(f"@r{i}\n{seq}\n+\n{'I' * 300}\n")
+    fq = tmp_path / "reads.fastq"
+    fq.write_text("".join(reads))
+    out = tmp_path / "out"
+    subsample(fq, out, genome_size="1k", count=2, min_read_depth=3.0, seed=1)
+    text = (out / "subsample.yaml").read_text()
+    assert "shuffle: rust-stdrng-0.9" in text

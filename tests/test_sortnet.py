@@ -1,0 +1,93 @@
+"""The bitonic sort-network grouping kernel (ops/sortnet.py): the numpy
+oracle network against np.lexsort, and the Pallas kernels (interpret mode
+on the pinned-CPU test backend) against the oracle — block-local stages,
+global DMA substages, padding, and the end-to-end grouping integration."""
+
+import numpy as np
+import pytest
+
+from autocycler_tpu.ops.sortnet import (DEFAULT_BLOCK_ROWS, sortnet,
+                                        sortnet_padded, sortnet_reference)
+
+
+def _random_words(rng, n, w=2, hi=5**13):
+    words = [rng.integers(0, hi, size=n).astype(np.int32) for _ in range(w)]
+    # duplicates on purpose: grouping is the use case
+    for arr in words:
+        arr[rng.integers(0, n, size=n // 3)] = arr[0]
+    return words
+
+
+def _expect_sorted(words, idx=None):
+    """np.lexsort oracle: stable sort by word tuple."""
+    order = np.lexsort(tuple(reversed(words)))
+    out = [w[order] for w in words]
+    return out + [order.astype(np.int32)] if idx is None else out
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 8, 100, 256, 1000])
+def test_reference_network_sorts(n):
+    rng = np.random.default_rng(n)
+    words = _random_words(rng, n)
+    idx = np.arange(n, dtype=np.int32)
+    got = sortnet_reference(words + [idx])
+    expect = _expect_sorted(words)
+    for g, e in zip(got, expect):
+        np.testing.assert_array_equal(g, e)
+
+
+def test_reference_network_single_word():
+    rng = np.random.default_rng(0)
+    w = rng.integers(0, 100, size=500).astype(np.int32)
+    idx = np.arange(500, dtype=np.int32)
+    got = sortnet_reference([w, idx])
+    order = np.argsort(w, kind="stable")
+    np.testing.assert_array_equal(got[0], w[order])
+    np.testing.assert_array_equal(got[1], order)
+
+
+@pytest.mark.parametrize("n,block_rows", [
+    (1024, 8),        # single block (n == block elems)
+    (2048, 8),        # one global substage layer
+    (8192, 8),        # three global layers
+    (4096, 16),       # different block size
+])
+def test_pallas_network_matches_oracle(n, block_rows):
+    rng = np.random.default_rng(n + block_rows)
+    words = _random_words(rng, n, w=3)
+    idx = np.arange(n, dtype=np.int32)
+    got = [np.asarray(a) for a in
+           sortnet(
+               [np.asarray(w) for w in words] + [idx],
+               block_rows=block_rows, interpret=True)]
+    expect = _expect_sorted(words)
+    for g, e in zip(got, expect):
+        np.testing.assert_array_equal(g, e)
+
+
+def test_pallas_network_padded_arbitrary_n():
+    rng = np.random.default_rng(5)
+    n = 3000
+    words = _random_words(rng, n, w=2)
+    sorted_words, order = sortnet_padded(words, n, block_rows=8,
+                                         interpret=True)
+    expect = _expect_sorted(words)
+    for g, e in zip([np.asarray(w) for w in sorted_words], expect[:-1]):
+        np.testing.assert_array_equal(g, e)
+    np.testing.assert_array_equal(np.asarray(order), expect[-1])
+
+
+def test_pallas_network_all_equal_keys():
+    """Grouping's worst case: every key identical — the index tiebreak must
+    produce the identity permutation."""
+    n = 2048
+    w = np.full(n, 12345, np.int32)
+    sorted_words, order = sortnet_padded([w], n, block_rows=8,
+                                         interpret=True)
+    np.testing.assert_array_equal(np.asarray(order), np.arange(n))
+    np.testing.assert_array_equal(np.asarray(sorted_words[0]), w)
+
+
+def test_sortnet_rejects_non_power_of_two():
+    with pytest.raises(ValueError, match="power of two"):
+        sortnet([np.zeros(1000, np.int32)], block_rows=8)
