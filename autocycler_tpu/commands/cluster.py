@@ -418,6 +418,27 @@ def cluster_is_trusted(sequences: List[Sequence], c: int) -> bool:
     return any(s.cluster == c and s.is_trusted() for s in sequences)
 
 
+# single-slot memo for the cluster-assignment-INDEPENDENT part of
+# containment counting: the refinement hill-climb scores many candidate
+# clusterings against the same distance dict, and rebuilding the dense
+# [S, S] matrix per score evaluation would reintroduce the O(S²)-per-call
+# Python constant this module just removed (advisor r5 finding). Keyed on
+# the dict's identity + cutoff + the clustered id tuple; holding a strong
+# reference to the keyed dict keeps its id from being recycled.
+_contain_cache: Dict[str, object] = {}
+
+
+def _contain_ab_cached(distances: Dict[Tuple[int, int], float],
+                       cutoff: float, ids: Tuple[int, ...]) -> np.ndarray:
+    key = (id(distances), cutoff, len(distances), ids)
+    if _contain_cache.get("key") != key:
+        pos = {a: i for i, a in enumerate(ids)}
+        D = _distances_to_matrix(distances, pos, len(ids))
+        _contain_cache.update(key=key, distances_ref=distances,
+                              contain_ab=(D < D.T) & (D < cutoff))
+    return _contain_cache["contain_ab"]  # type: ignore[return-value]
+
+
 def containment_counts(sequences: List[Sequence],
                        distances: Dict[Tuple[int, int], float],
                        cutoff: float) -> Tuple[np.ndarray, np.ndarray]:
@@ -438,10 +459,9 @@ def containment_counts(sequences: List[Sequence],
     if not clustered:
         z = np.zeros((1, 1), np.int64)
         return z, z
-    pos = {s.id: i for i, s in enumerate(clustered)}
     S = len(clustered)
-    D = _distances_to_matrix(distances, pos, S)
-    contain_ab = (D < D.T) & (D < cutoff)
+    contain_ab = _contain_ab_cached(distances, cutoff,
+                                    tuple(s.id for s in clustered))
     P = np.zeros((max_cluster + 1, S), np.int64)
     P[np.array([s.cluster for s in clustered]), np.arange(S)] = 1
     # uint8 cast: the matmul promotes with int64 P, so the result is the

@@ -360,6 +360,11 @@ def match_tile_bits(a_words: np.ndarray, b_words: np.ndarray, tile_pairs,
         return np.zeros((0, tile_a, tile_b // 32), np.uint32)
     per_tile = tile_a * (tile_b // 32) * 4
     max_chunk = max(_TILE_BITS_BUDGET // per_tile, 1)
+    # floor to a power of two: chunks are padded UP to the next power of
+    # two below, so a non-power-of-two cap would let full chunks dispatch
+    # up to ~2x the budget
+    while max_chunk & (max_chunk - 1):
+        max_chunk &= max_chunk - 1
     fn = _tile_bits_fn(W, tile_a, tile_b)
     a_d, b_d = jnp.asarray(a_pad), jnp.asarray(b_pad)
     chunks = []

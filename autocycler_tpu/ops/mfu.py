@@ -51,13 +51,17 @@ def vpu_grid_mfu(rate_gcells: float, k: int) -> dict:
             "pct_peak": round(100.0 * ops / V5E_VPU_INT_OPS, 1)}
 
 
-def sort_bandwidth(n_elements: int, n_passes: int, seconds: float) -> dict:
-    """Multi-pass device sort: effective HBM traffic (16 B per element per
-    pass: key+value read+write) -> {GB/s, pct of HBM peak}. A lower bound on
+def sort_bandwidth(n_elements: int, n_passes: int, seconds: float,
+                   n_arrays: int = 2) -> dict:
+    """Multi-pass device sort: effective HBM traffic -> {GB/s, pct of HBM
+    peak}. Each pass reads + writes ``n_arrays`` parallel int32 streams
+    (8 B per array per element per pass) — 2 for a key+value sort, W+1 for
+    the grouping network's W key words + index. ``n_elements`` should be
+    the PADDED element count the kernel actually moves. A lower bound on
     real traffic (ignores scratch), so pct_peak is conservative."""
     if seconds <= 0:
         return {"gb_per_s": 0.0, "pct_peak": 0.0}
-    bytes_moved = 16.0 * n_elements * n_passes
+    bytes_moved = 8.0 * n_arrays * n_elements * n_passes
     rate = bytes_moved / seconds
     return {"gb_per_s": round(rate / 1e9, 1),
             "pct_peak": round(100.0 * rate / V5E_HBM_BYTES, 1)}

@@ -175,7 +175,12 @@ def _grouping_evidence(n_mbp: float = 24.0) -> dict:
                     round(dt, 2)
             out[f"{tag}_exact"] = bool((gid == gid_n).all()
                                        and (order == order_n).all())
-            out[f"{tag}_hbm"] = sort_bandwidth(len(starts), passes, dt)
+            # pallas network: W key words + index over the PADDED count;
+            # lsd: 2-array sort_key_val passes over the real count
+            w_arrays = ((k + 12) // 13) + 1
+            out[f"{tag}_hbm"] = sort_bandwidth(
+                n_pow2 if mode == "pallas" else len(starts), passes, dt,
+                n_arrays=w_arrays if mode == "pallas" else 2)
         except Exception as exc:  # noqa: BLE001
             print(f"grouping {tag} failed: {type(exc).__name__}: {exc}",
                   file=sys.stderr)

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Build the offline HTML docs site into docs/_site/ (counterpart of the
+# reference's wiki+mdBook build tooling; see make_site.py).
+set -euo pipefail
+cd "$(dirname "$0")"
+rm -rf _site
+python make_site.py _site
+echo "open docs/_site/index.html"

@@ -1,0 +1,113 @@
+"""Build an offline HTML site from the markdown docs.
+
+Counterpart of the reference's wiki build tooling
+(`/root/reference/docs/build.sh` + `create_summary.py`, which clone the
+GitHub wiki and run mdBook): this repo's docs live in-tree, so the build is
+self-contained — every `docs/**/*.md` page renders to `docs/_site/` with a
+shared sidebar, cross-page `.md` links rewritten to `.html`. Uses the
+`markdown` package (in the base image); no network, no mdBook.
+
+Run via `docs/build.sh` or `python docs/make_site.py [out_dir]`.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+import markdown
+
+DOCS = Path(__file__).resolve().parent
+
+PAGE = """<!DOCTYPE html>
+<html lang="en"><head><meta charset="utf-8">
+<meta name="viewport" content="width=device-width, initial-scale=1">
+<title>{title} — autocycler-tpu</title>
+<style>
+body {{ margin: 0; font: 16px/1.55 system-ui, sans-serif; color: #1a1a1a; }}
+.wrap {{ display: flex; min-height: 100vh; }}
+nav {{ width: 230px; flex-shrink: 0; background: #f5f5f2; padding: 1rem;
+      border-right: 1px solid #ddd; }}
+nav a {{ display: block; color: #345; text-decoration: none;
+        padding: .15rem 0; }}
+nav a.current {{ font-weight: 600; }}
+nav .group {{ margin-top: .7rem; font-size: .8rem; text-transform: uppercase;
+             letter-spacing: .05em; color: #888; }}
+main {{ padding: 1.5rem 2.5rem; max-width: 54rem; overflow-x: auto; }}
+pre {{ background: #f6f8fa; padding: .8rem; overflow-x: auto;
+      border-radius: 6px; }}
+code {{ background: #f6f8fa; padding: .1rem .3rem; border-radius: 4px; }}
+pre code {{ padding: 0; }}
+table {{ border-collapse: collapse; }}
+th, td {{ border: 1px solid #ccc; padding: .3rem .6rem; text-align: left; }}
+h1, h2, h3 {{ line-height: 1.25; }}
+a {{ color: #0b62a4; }}
+</style></head><body><div class="wrap">
+<nav>{nav}</nav>
+<main>{body}</main>
+</div></body></html>
+"""
+
+
+def _title(md_text: str, fallback: str) -> str:
+    for line in md_text.splitlines():
+        if line.startswith("# "):
+            return line[2:].strip()
+    return fallback
+
+
+def _rewrite_links(html: str, depth: int) -> str:
+    """Cross-page .md links -> .html (same tree); external links untouched."""
+    def sub(m: re.Match) -> str:
+        href = m.group(1)
+        if "://" in href or href.startswith("#"):
+            return m.group(0)
+        target, _, frag = href.partition("#")
+        if target.endswith(".md"):
+            target = target[:-3] + ".html"
+        return f'href="{target}{"#" + frag if frag else ""}"'
+
+    return re.sub(r'href="([^"]+)"', sub, html)
+
+
+def build(out_dir: Path) -> int:
+    pages = sorted(p for p in DOCS.rglob("*.md"))
+    out_dir.mkdir(parents=True, exist_ok=True)
+
+    entries = []  # (rel_html, title, group)
+    for src in pages:
+        rel = src.relative_to(DOCS)
+        group = rel.parts[0] if len(rel.parts) > 1 else ""
+        entries.append((rel.with_suffix(".html"),
+                        _title(src.read_text(), rel.stem), group))
+
+    def nav_for(current) -> str:
+        depth = len(current.parts) - 1
+        prefix = "../" * depth
+        items, last_group = [], None
+        for rel_html, title, group in entries:
+            if group != last_group:
+                if group:
+                    items.append(f'<div class="group">{group}</div>')
+                last_group = group
+            cls = ' class="current"' if rel_html == current else ""
+            items.append(f'<a{cls} href="{prefix}{rel_html}">{title}</a>')
+        return "\n".join(items)
+
+    md = markdown.Markdown(extensions=["tables", "fenced_code", "toc"])
+    for src, (rel_html, title, _) in zip(pages, entries):
+        body = md.reset().convert(src.read_text())
+        body = _rewrite_links(body, len(rel_html.parts) - 1)
+        dest = out_dir / rel_html
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        dest.write_text(PAGE.format(title=title, nav=nav_for(rel_html),
+                                    body=body))
+    # index.md renders to index.html at the root, which is the site entry
+    print(f"built {len(pages)} pages -> {out_dir}")
+    return len(pages)
+
+
+if __name__ == "__main__":
+    out = Path(sys.argv[1]) if len(sys.argv) > 1 else DOCS / "_site"
+    build(out)

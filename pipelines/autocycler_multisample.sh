@@ -1,0 +1,121 @@
+#!/usr/bin/env bash
+# Multi-sample driver: run the full reads -> consensus flow for MANY read
+# sets in one invocation, one output directory per sample.
+#
+# Native counterpart of the reference's community pipeline
+# `Auto-Autocycler_by_Tom_Stanton/autoautocycler.sh` (multi-sample loop,
+# auto genome size, assembler availability detection), restructured for
+# this package: the per-sample flow is the same subsample -> assemble ->
+# compress -> cluster -> trim/resolve -> combine staging as
+# autocycler_full.sh, and samples that already have a consensus are
+# skipped, so an interrupted batch can simply be re-run.
+#
+# Usage: autocycler_multisample.sh [options] <reads.fastq[.gz]> [...]
+#   -o DIR     output root (default: ./multisample_out); each sample gets
+#              DIR/<basename-of-reads>/
+#   -t N       threads (default: nproc)
+#   -c N       subsample count (default: 4)
+#   -k N       k-mer size (default: 51)
+#   -g SIZE    genome size (e.g. 5.5m); default: estimated per sample via
+#              `autocycler helper genome_size` (needs raven)
+#   -a LIST    space-separated assemblers to use, quoted (default: every
+#              assembler from the standard panel found on PATH)
+#
+# Set AUTOCYCLER to override the CLI (default: "python -m autocycler_tpu").
+
+set -euo pipefail
+
+AUTOCYCLER=${AUTOCYCLER:-"python -m autocycler_tpu"}
+OUT="multisample_out"
+THREADS=$(getconf _NPROCESSORS_ONLN 2>/dev/null || echo 8)
+COUNT=4
+KMER=51
+SIZE="auto"
+PANEL=(canu flye lja metamdbg miniasm necat nextdenovo raven redbean)
+ASSEMBLERS=()
+
+usage() {
+    sed -n '2,26p' "$0" | sed 's/^# \{0,1\}//'
+    exit 1
+}
+
+READS=()
+while [[ $# -gt 0 ]]; do
+    case "$1" in
+        -o) OUT="$2"; shift 2 ;;
+        -t) THREADS="$2"; shift 2 ;;
+        -c) COUNT="$2"; shift 2 ;;
+        -k) KMER="$2"; shift 2 ;;
+        -g) SIZE="$2"; shift 2 ;;
+        -a) read -r -a ASSEMBLERS <<< "$2"; shift 2 ;;
+        -h|--help) usage ;;
+        -*) echo "Error: unknown option $1" >&2; usage ;;
+        *) READS+=("$1"); shift ;;
+    esac
+done
+[[ ${#READS[@]} -gt 0 ]] || usage
+
+if [[ ${#ASSEMBLERS[@]} -eq 0 ]]; then
+    for a in "${PANEL[@]}"; do
+        command -v "$a" >/dev/null 2>&1 && ASSEMBLERS+=("$a")
+    done
+fi
+[[ ${#ASSEMBLERS[@]} -gt 0 ]] || {
+    echo "Error: no assemblers from the panel (${PANEL[*]}) are on PATH" >&2
+    exit 1
+}
+echo "assemblers: ${ASSEMBLERS[*]}" >&2
+
+fail=0
+for reads in "${READS[@]}"; do
+    [[ -f "$reads" ]] || { echo "Error: $reads does not exist" >&2; fail=1; continue; }
+    name=$(basename "$reads")
+    name=${name%.gz}; name=${name%.fastq}; name=${name%.fq}
+    sample_dir="$OUT/$name"
+    if [[ -s "$sample_dir/consensus_assembly.fasta" ]]; then
+        echo "=== $name: consensus already present, skipping ===" >&2
+        continue
+    fi
+    echo "=== $name ===" >&2
+    mkdir -p "$sample_dir"
+
+    size="$SIZE"
+    if [[ "$size" == "auto" ]]; then
+        size=$($AUTOCYCLER helper genome_size --reads "$reads" --threads "$THREADS") || {
+            echo "$name: genome size estimation failed (is raven installed?); skipping" >&2
+            fail=1; continue
+        }
+        echo "$name: estimated genome size $size" >&2
+    fi
+
+    $AUTOCYCLER subsample --reads "$reads" --out_dir "$sample_dir/subsampled_reads" \
+        --genome_size "$size" --count "$COUNT"
+
+    mkdir -p "$sample_dir/assemblies"
+    i=0
+    for assembler in "${ASSEMBLERS[@]}"; do
+        for sample in "$sample_dir"/subsampled_reads/sample_*.fastq; do
+            s=$(basename "$sample" .fastq)
+            prefix="$sample_dir/assemblies/${assembler}_${s#sample_}"
+            # non-fatal per the helper contract: a failed assembler job
+            # just contributes nothing to the consensus
+            $AUTOCYCLER helper "$assembler" --reads "$sample" \
+                --out_prefix "$prefix" --threads "$THREADS" \
+                --genome_size "$size" || \
+                echo "$name: $assembler on $s failed (continuing)" >&2
+            i=$((i + 1))
+        done
+    done
+
+    $AUTOCYCLER compress -i "$sample_dir/assemblies" -a "$sample_dir" --kmer "$KMER" \
+        --threads "$THREADS"
+    $AUTOCYCLER cluster -a "$sample_dir"
+    for c in "$sample_dir"/clustering/qc_pass/cluster_*; do
+        $AUTOCYCLER trim -c "$c" --threads "$THREADS"
+        $AUTOCYCLER resolve -c "$c"
+    done
+    $AUTOCYCLER combine -a "$sample_dir" \
+        -i "$sample_dir"/clustering/qc_pass/cluster_*/5_final.gfa
+    echo "=== $name: done -> $sample_dir/consensus_assembly.fasta ===" >&2
+done
+exit $fail

@@ -1,0 +1,32 @@
+"""The offline docs site builder (docs/make_site.py — the counterpart of
+the reference's wiki build tooling, /root/reference/docs/build.sh)."""
+
+import re
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "docs"))
+
+
+def test_site_builds_every_page_with_nav_and_rewritten_links(tmp_path):
+    make_site = pytest.importorskip("make_site")
+
+    n = make_site.build(tmp_path)
+    docs = Path(__file__).resolve().parent.parent / "docs"
+    md_pages = sorted(docs.rglob("*.md"))
+    assert n == len(md_pages) > 10
+    for src in md_pages:
+        dest = tmp_path / src.relative_to(docs).with_suffix(".html")
+        assert dest.is_file(), dest
+        html = dest.read_text()
+        assert "<nav>" in html and "<main>" in html
+        # no intra-site hrefs may still point at .md files
+        for m in re.finditer(r'href="([^"]+)"', html):
+            href = m.group(1)
+            if "://" in href or href.startswith("#"):
+                continue
+            assert not href.split("#")[0].endswith(".md"), (dest, href)
+    assert (tmp_path / "index.html").is_file()
+    assert (tmp_path / "commands").is_dir()

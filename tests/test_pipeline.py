@@ -44,6 +44,29 @@ def test_compress_then_cluster(tmp_path):
     assert min(s.length for s in seqs1) > max(s.length for s in seqs2)
 
 
+def test_compress_via_pallas_grouping_matches_default(tmp_path, monkeypatch,
+                                                      capsys):
+    """End-to-end compress with AUTOCYCLER_DEVICE_GROUPING=pallas (the
+    bitonic sort-network kernel, interpret mode on the pinned-CPU backend)
+    must write a byte-identical unitig graph to the default native-grouping
+    compress — the integration proof that the device kernel plugs into the
+    product path, not just the unit harness."""
+    from autocycler_tpu.ops import kmers
+
+    monkeypatch.setattr(kmers, "_PALLAS_BLOCK_ROWS", 8)
+    asm_dir = make_assemblies(tmp_path, n_assemblies=3, chromosome_len=1500,
+                              plasmid_len=400, seed=9)
+    out_a = tmp_path / "out_native"
+    compress(asm_dir, out_a, k_size=51)
+    monkeypatch.setenv("AUTOCYCLER_DEVICE_GROUPING", "pallas")
+    out_b = tmp_path / "out_pallas"
+    compress(asm_dir, out_b, k_size=51)
+    err = capsys.readouterr().err
+    assert "falling back" not in err, err
+    assert (out_a / "input_assemblies.gfa").read_bytes() == \
+        (out_b / "input_assemblies.gfa").read_bytes()
+
+
 def test_full_pipeline_to_consensus(tmp_path):
     """compress -> cluster -> trim -> resolve -> combine on clean synthetic
     data must produce a fully-resolved consensus: one circular contig per
