@@ -337,15 +337,22 @@ def generate_clusters(tree: TreeNode, sequences: List[Sequence],
                       distances: Dict[Tuple[int, int], float], cutoff: float,
                       min_assemblies: int, manual_clusters: List[int]
                       ) -> Dict[int, ClusterQC]:
-    if not manual_clusters:
-        auto = tree.automatic_clustering(cutoff)
-        clusters = refine_auto_clusters(tree, sequences, distances, auto, cutoff,
-                                        min_assemblies)
-    else:
-        clusters = tree.manual_clustering(cutoff, manual_clusters)
-    tree.check_complete_coverage(clusters)
-    return qc_clusters(tree, sequences, distances, clusters, manual_clusters, cutoff,
-                       min_assemblies)
+    try:
+        if not manual_clusters:
+            auto = tree.automatic_clustering(cutoff)
+            clusters = refine_auto_clusters(tree, sequences, distances, auto,
+                                            cutoff, min_assemblies)
+        else:
+            clusters = tree.manual_clustering(cutoff, manual_clusters)
+        tree.check_complete_coverage(clusters)
+        return qc_clusters(tree, sequences, distances, clusters, manual_clusters,
+                           cutoff, min_assemblies)
+    finally:
+        # the containment memo exists to serve the hill-climb's many score
+        # evaluations above; release the dense matrix + dict reference when
+        # clustering is done so a long batch run doesn't carry the largest
+        # isolate's S x S matrix to process exit (advisor r5)
+        _contain_cache.clear()
 
 
 def qc_clusters(tree: TreeNode, sequences: List[Sequence],

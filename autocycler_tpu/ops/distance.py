@@ -41,8 +41,11 @@ _probe_state: dict = {"probes": 0}
 def _record_probe(attached: bool, seconds: float, reason: str,
                   cache: bool) -> None:
     with _PROBE_LOCK:
+        fails = _probe_state.get("fails", 0)
+        if cache:
+            fails = 0 if attached else fails + 1
         _probe_state.update(attached=attached, seconds=round(seconds, 3),
-                            reason=reason, cached=cache,
+                            reason=reason, cached=cache, fails=fails,
                             at=_time.monotonic(),
                             probes=_probe_state.get("probes", 0) + (1 if cache else 0))
 
@@ -84,8 +87,11 @@ def _tpu_attached() -> bool:
     dispatch site has its own fallback), but FAILURE expires after
     AUTOCYCLER_DEVICE_PROBE_TTL seconds (default 120; <= 0 makes failure
     permanent), so one transient tunnel wedge at startup no longer pins a
-    long `batch` run to host forever. Every outcome is recorded and
-    retrievable via :func:`device_probe_report`."""
+    long `batch` run to host forever. Consecutive failures back off
+    exponentially (TTL, 2*TTL, 4*TTL, ...) so a dead tunnel costs a
+    bounded, shrinking share of a long run rather than one probe-deadline
+    stall per TTL window. Every outcome is recorded and retrievable via
+    :func:`device_probe_report`."""
     import os
     import sys
     platforms = os.environ.get("JAX_PLATFORMS", "").strip().lower()
@@ -121,7 +127,11 @@ def _tpu_attached() -> bool:
                 print("autocycler: ignoring malformed "
                       "AUTOCYCLER_DEVICE_PROBE_TTL", file=sys.stderr)
                 ttl = 120.0
-            if ttl <= 0 or _time.monotonic() - st["at"] < ttl:
+            # exponential backoff: consecutive failures double the wait
+            # before the next re-probe (a dead tunnel would otherwise cost
+            # a probe-deadline stall every TTL for the whole run)
+            backoff = ttl * (2 ** max(st.get("fails", 1) - 1, 0))
+            if ttl <= 0 or _time.monotonic() - st["at"] < backoff:
                 return False
             # failure older than the TTL: fall through and probe again (the
             # tunnel may have recovered). A timed-out earlier probe thread

@@ -57,7 +57,7 @@ def _title(md_text: str, fallback: str) -> str:
     return fallback
 
 
-def _rewrite_links(html: str, depth: int) -> str:
+def _rewrite_links(html: str) -> str:
     """Cross-page .md links -> .html (same tree); external links untouched."""
     def sub(m: re.Match) -> str:
         href = m.group(1)
@@ -76,11 +76,14 @@ def build(out_dir: Path) -> int:
     out_dir.mkdir(parents=True, exist_ok=True)
 
     entries = []  # (rel_html, title, group)
+    texts = []
     for src in pages:
         rel = src.relative_to(DOCS)
         group = rel.parts[0] if len(rel.parts) > 1 else ""
+        text = src.read_text()
+        texts.append(text)
         entries.append((rel.with_suffix(".html"),
-                        _title(src.read_text(), rel.stem), group))
+                        _title(text, rel.stem), group))
 
     def nav_for(current) -> str:
         depth = len(current.parts) - 1
@@ -96,9 +99,9 @@ def build(out_dir: Path) -> int:
         return "\n".join(items)
 
     md = markdown.Markdown(extensions=["tables", "fenced_code", "toc"])
-    for src, (rel_html, title, _) in zip(pages, entries):
-        body = md.reset().convert(src.read_text())
-        body = _rewrite_links(body, len(rel_html.parts) - 1)
+    for text, (rel_html, title, _) in zip(texts, entries):
+        body = md.reset().convert(text)
+        body = _rewrite_links(body)
         dest = out_dir / rel_html
         dest.parent.mkdir(parents=True, exist_ok=True)
         dest.write_text(PAGE.format(title=title, nav=nav_for(rel_html),

@@ -35,7 +35,10 @@ PANEL=(canu flye lja metamdbg miniasm necat nextdenovo raven redbean)
 ASSEMBLERS=()
 
 usage() {
-    sed -n '2,26p' "$0" | sed 's/^# \{0,1\}//'
+    # print the header comment block (everything up to the first
+    # non-comment line), stripped of the leading '# '
+    awk 'NR > 1 && /^#/ { sub(/^# ?/, ""); print; next }
+         NR > 1 { exit }' "$0"
     exit 1
 }
 
@@ -88,34 +91,49 @@ for reads in "${READS[@]}"; do
         echo "$name: estimated genome size $size" >&2
     fi
 
-    $AUTOCYCLER subsample --reads "$reads" --out_dir "$sample_dir/subsampled_reads" \
-        --genome_size "$size" --count "$COUNT"
+    # the whole per-sample flow runs in a subshell guarded by `if !`, so a
+    # failing stage marks THIS sample failed and the batch continues (the
+    # header's resume contract) instead of set -e killing every later
+    # sample
+    if ! (
+        set -e
+        $AUTOCYCLER subsample --reads "$reads" \
+            --out_dir "$sample_dir/subsampled_reads" \
+            --genome_size "$size" --count "$COUNT"
 
-    mkdir -p "$sample_dir/assemblies"
-    i=0
-    for assembler in "${ASSEMBLERS[@]}"; do
-        for sample in "$sample_dir"/subsampled_reads/sample_*.fastq; do
-            s=$(basename "$sample" .fastq)
-            prefix="$sample_dir/assemblies/${assembler}_${s#sample_}"
-            # non-fatal per the helper contract: a failed assembler job
-            # just contributes nothing to the consensus
-            $AUTOCYCLER helper "$assembler" --reads "$sample" \
-                --out_prefix "$prefix" --threads "$THREADS" \
-                --genome_size "$size" || \
-                echo "$name: $assembler on $s failed (continuing)" >&2
-            i=$((i + 1))
+        mkdir -p "$sample_dir/assemblies"
+        for assembler in "${ASSEMBLERS[@]}"; do
+            for sample in "$sample_dir"/subsampled_reads/sample_*.fastq; do
+                s=$(basename "$sample" .fastq)
+                prefix="$sample_dir/assemblies/${assembler}_${s#sample_}"
+                # non-fatal per the helper contract: a failed assembler job
+                # just contributes nothing to the consensus
+                $AUTOCYCLER helper "$assembler" --reads "$sample" \
+                    --out_prefix "$prefix" --threads "$THREADS" \
+                    --genome_size "$size" || \
+                    echo "$name: $assembler on $s failed (continuing)" >&2
+            done
         done
-    done
 
-    $AUTOCYCLER compress -i "$sample_dir/assemblies" -a "$sample_dir" --kmer "$KMER" \
-        --threads "$THREADS"
-    $AUTOCYCLER cluster -a "$sample_dir"
-    for c in "$sample_dir"/clustering/qc_pass/cluster_*; do
-        $AUTOCYCLER trim -c "$c" --threads "$THREADS"
-        $AUTOCYCLER resolve -c "$c"
-    done
-    $AUTOCYCLER combine -a "$sample_dir" \
-        -i "$sample_dir"/clustering/qc_pass/cluster_*/5_final.gfa
+        $AUTOCYCLER compress -i "$sample_dir/assemblies" -a "$sample_dir" \
+            --kmer "$KMER" --threads "$THREADS"
+        $AUTOCYCLER cluster -a "$sample_dir"
+        shopt -s nullglob
+        clusters=("$sample_dir"/clustering/qc_pass/cluster_*)
+        [[ ${#clusters[@]} -gt 0 ]] || {
+            echo "$name: no QC-pass clusters" >&2; exit 1; }
+        for c in "${clusters[@]}"; do
+            $AUTOCYCLER trim -c "$c" --threads "$THREADS"
+            $AUTOCYCLER resolve -c "$c"
+        done
+        finals=()
+        for c in "${clusters[@]}"; do finals+=("$c/5_final.gfa"); done
+        $AUTOCYCLER combine -a "$sample_dir" -i "${finals[@]}"
+    ); then
+        echo "=== $name: FAILED (continuing with remaining samples) ===" >&2
+        fail=1
+        continue
+    fi
     echo "=== $name: done -> $sample_dir/consensus_assembly.fasta ===" >&2
 done
 exit $fail

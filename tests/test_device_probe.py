@@ -121,8 +121,14 @@ def test_probe_failure_expires_after_ttl(monkeypatch, capsys):
     time.sleep(0.12)
     assert probe() is False          # TTL expired: re-probes
     assert len(calls) == 2
+    time.sleep(0.12)
+    assert probe() is False          # 2nd consecutive failure: backoff is
+    assert len(calls) == 2           # now 2*TTL, so no re-probe yet
+    time.sleep(0.12)
+    assert probe() is False          # past 2*TTL: re-probes again
+    assert len(calls) == 3
     report = distance.device_probe_report()
-    assert report["probes"] == 2
+    assert report["probes"] == 3
     assert "did not respond" in report["reason"]
     capsys.readouterr()
 
