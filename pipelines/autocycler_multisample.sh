@@ -93,15 +93,16 @@ for reads in "${READS[@]}"; do
 
     # the whole per-sample flow runs in a subshell guarded by `if !`, so a
     # failing stage marks THIS sample failed and the batch continues (the
-    # header's resume contract) instead of set -e killing every later
-    # sample
+    # header's resume contract). Every stage carries an explicit
+    # `|| exit 1`: bash DISABLES errexit for commands inside an `if`
+    # condition (even re-enabled in the subshell), so relying on set -e
+    # here would silently run later stages on a failed sample's leftovers.
     if ! (
-        set -e
         $AUTOCYCLER subsample --reads "$reads" \
             --out_dir "$sample_dir/subsampled_reads" \
-            --genome_size "$size" --count "$COUNT"
+            --genome_size "$size" --count "$COUNT" || exit 1
 
-        mkdir -p "$sample_dir/assemblies"
+        mkdir -p "$sample_dir/assemblies" || exit 1
         for assembler in "${ASSEMBLERS[@]}"; do
             for sample in "$sample_dir"/subsampled_reads/sample_*.fastq; do
                 s=$(basename "$sample" .fastq)
@@ -116,19 +117,19 @@ for reads in "${READS[@]}"; do
         done
 
         $AUTOCYCLER compress -i "$sample_dir/assemblies" -a "$sample_dir" \
-            --kmer "$KMER" --threads "$THREADS"
-        $AUTOCYCLER cluster -a "$sample_dir"
+            --kmer "$KMER" --threads "$THREADS" || exit 1
+        $AUTOCYCLER cluster -a "$sample_dir" || exit 1
         shopt -s nullglob
         clusters=("$sample_dir"/clustering/qc_pass/cluster_*)
         [[ ${#clusters[@]} -gt 0 ]] || {
             echo "$name: no QC-pass clusters" >&2; exit 1; }
         for c in "${clusters[@]}"; do
-            $AUTOCYCLER trim -c "$c" --threads "$THREADS"
-            $AUTOCYCLER resolve -c "$c"
+            $AUTOCYCLER trim -c "$c" --threads "$THREADS" || exit 1
+            $AUTOCYCLER resolve -c "$c" || exit 1
         done
         finals=()
         for c in "${clusters[@]}"; do finals+=("$c/5_final.gfa"); done
-        $AUTOCYCLER combine -a "$sample_dir" -i "${finals[@]}"
+        $AUTOCYCLER combine -a "$sample_dir" -i "${finals[@]}" || exit 1
     ); then
         echo "=== $name: FAILED (continuing with remaining samples) ===" >&2
         fail=1
