@@ -148,10 +148,15 @@ def get_positions(seqs, res: int, kmer: int, top_left_gap: int, bottom_right_gap
 DEVICE_GRID_MIN_CELLS = None
 
 
-# Above this many grid cells the kernel's (8, 128)-broadcast count output no
-# longer fits device memory (out bytes = 1024 * cells / tile^2 * 4); pairs
-# beyond it always use the host sort-join, which is near-linear anyway.
+# Above this many grid cells the count grid no longer fits device memory;
+# pairs beyond it always use the host sort-join, which is near-linear
+# anyway.
 MAX_DEVICE_CELLS = 5e11
+
+# one warning per process when device grid mode degrades to host because
+# jax backend init is not known-safe (dozens of sequence pairs would
+# otherwise each repeat it)
+_WARNED_BACKEND_UNSAFE = False
 
 
 def _device_match_pair(a_words: np.ndarray, b_words: np.ndarray, tile: int = 2048
@@ -205,6 +210,23 @@ def kmer_match_positions_device(seq_a: np.ndarray, seq_b: np.ndarray, kmer: int
         z = np.zeros(0, np.int64)
         return z, z, z, z
     if float(n_a) * float(n_b) > MAX_DEVICE_CELLS:
+        return None
+    from ..ops.distance import device_probe_report, jax_backend_safe
+    if not jax_backend_safe():
+        # the installed TPU plugin overrides JAX_PLATFORMS, so when its
+        # transport is wedged even an "interpret-mode" grid would hang in
+        # backend init; the probe's deadline already ran — fall back to the
+        # host sort-join loudly (once, with the probe's actual reason, not
+        # a guess — the cause may equally be the operator's kill switch)
+        # instead of blocking the CLI forever
+        global _WARNED_BACKEND_UNSAFE
+        if not _WARNED_BACKEND_UNSAFE:
+            _WARNED_BACKEND_UNSAFE = True
+            import sys
+            print("autocycler: device grid mode requested but jax backend "
+                  "init is not known-safe "
+                  f"({device_probe_report()['reason']}); using the host "
+                  "matcher", file=sys.stderr)
         return None
     codes_a = encode_bytes(seq_a)
     codes_b = encode_bytes(seq_b)

@@ -39,27 +39,45 @@ _probe_state: dict = {"probes": 0}
 
 
 def _record_probe(attached: bool, seconds: float, reason: str,
-                  cache: bool) -> None:
+                  cache: bool, kind: str) -> None:
     with _PROBE_LOCK:
         fails = _probe_state.get("fails", 0)
         if cache:
             fails = 0 if attached else fails + 1
         _probe_state.update(attached=attached, seconds=round(seconds, 3),
                             reason=reason, cached=cache, fails=fails,
-                            at=_time.monotonic(),
+                            kind=kind, at=_time.monotonic(),
                             probes=_probe_state.get("probes", 0) + (1 if cache else 0))
 
 
 def device_probe_report() -> dict:
     """The last probe outcome, for artifacts: {"attached", "seconds",
-    "reason", "probes"} — ``attached`` is None if nothing has resolved yet.
-    A framework whose device defaults hinge on this probe must surface the
-    outcome, not bury it in stderr (VERDICT r4 item 1a)."""
+    "reason", "kind", "probes"} — ``attached`` is None if nothing has
+    resolved yet. ``kind`` is structured for callers that must distinguish
+    WHY a probe answered False: "pinned" (JAX_PLATFORMS names a non-TPU
+    backend — jax untouched but safe to initialise), "no-tpu" (backend
+    initialised fine, just not a TPU), "ok", "timeout" (wedged transport —
+    ANY jax backend init may hang), "error", "disabled". A framework whose
+    device defaults hinge on this probe must surface the outcome, not bury
+    it in stderr (VERDICT r4 item 1a)."""
     with _PROBE_LOCK:
         return {"attached": _probe_state.get("attached"),
                 "seconds": _probe_state.get("seconds"),
                 "reason": _probe_state.get("reason"),
+                "kind": _probe_state.get("kind"),
                 "probes": _probe_state.get("probes", 0)}
+
+
+def jax_backend_safe() -> bool:
+    """Whether touching jax (ANY backend init, even interpret-mode Pallas)
+    is known not to hang: True when the probe short-circuited on a pinned
+    non-TPU platform or a backend actually initialised. A timed-out probe
+    means the plugin transport is wedged — on this platform the plugin
+    overrides JAX_PLATFORMS, so even 'cpu-only' jax use can block in
+    backend init."""
+    _tpu_attached()
+    with _PROBE_LOCK:
+        return _probe_state.get("kind") in ("pinned", "no-tpu", "ok")
 
 
 def _probe_reset() -> None:
@@ -101,7 +119,7 @@ def _tpu_attached() -> bool:
         # must fall through to the probe.
         _record_probe(False, 0.0,
                       f"JAX_PLATFORMS={platforms!r} pins a non-TPU backend",
-                      cache=False)
+                      cache=False, kind="pinned")
         return False
     try:
         timeout = float(os.environ.get("AUTOCYCLER_DEVICE_PROBE_TIMEOUT", "60"))
@@ -112,7 +130,7 @@ def _tpu_attached() -> bool:
     if timeout <= 0:       # explicit kill switch: host backends, no probe
         _record_probe(False, 0.0,
                       "AUTOCYCLER_DEVICE_PROBE_TIMEOUT <= 0 disables the "
-                      "device path", cache=False)
+                      "device path", cache=False, kind="disabled")
         return False
 
     with _PROBE_LOCK:
@@ -145,7 +163,7 @@ def _tpu_attached() -> bool:
             return bool(st.get("attached", False))
         _probe_state["probing"] = True
 
-    result: List[Tuple[bool, str]] = []
+    result: List[Tuple[bool, str, str]] = []
 
     def probe() -> None:
         try:
@@ -153,12 +171,15 @@ def _tpu_attached() -> bool:
             import jax.numpy as jnp
             backend = jax.default_backend()
             if backend != "tpu":
-                result.append((False, f"jax default backend is {backend!r}"))
+                result.append((False, f"jax default backend is {backend!r}",
+                               "no-tpu"))
                 return
             float(jnp.asarray(1.0) + 1.0)  # end-to-end transport check
-            result.append((True, "tpu backend verified (tiny op round-tripped)"))
+            result.append((True, "tpu backend verified (tiny op round-tripped)",
+                           "ok"))
         except Exception as e:  # noqa: BLE001 — no jax / no device: host matmul
-            result.append((False, f"device init failed: {type(e).__name__}: {e}"))
+            result.append((False, f"device init failed: {type(e).__name__}: {e}",
+                           "error"))
 
     t0 = _time.perf_counter()
     try:
@@ -166,14 +187,16 @@ def _tpu_attached() -> bool:
         t.start()
         t.join(timeout)
         if result:
-            attached, reason = result[0]
+            attached, reason, kind = result[0]
         else:
             attached = False
+            kind = "timeout"
             reason = (f"probe did not respond within {timeout:.0f}s "
                       "(wedged transport?)")
             print(f"autocycler: device {reason}; falling back to host "
                   "backends", file=sys.stderr)
-        _record_probe(attached, _time.perf_counter() - t0, reason, cache=True)
+        _record_probe(attached, _time.perf_counter() - t0, reason, cache=True,
+                      kind=kind)
     finally:
         with _PROBE_LOCK:
             _probe_state["probing"] = False

@@ -55,20 +55,25 @@ def _pad_to(words: np.ndarray, tile: int, fill: int) -> np.ndarray:
     return out
 
 
-def _grid_kernel(n_a, n_b, tile_a, tile_b, ga, gb, a_ref, b_ref, out_ref):
+def _grid_kernel(n_a, n_b, tile_a, tile_b, ga, gb, ia, ib, a_ref, b_ref,
+                 out_ref):
     """VPU word-compare grid with sub-grid output accumulation: grid step
-    (I, J, a, b) computes the scalar count of tile (I*8 + a, J*128 + b) and
+    (I, J, a, b) computes the scalar count of tile (I*ia + a, J*ib + b) and
     deposits it into element (a, b) of the (8, 128) output block owned by
-    (I, J). The block stays VMEM-resident across the 1024 inner steps (the
-    out index_map ignores a, b) and is written to HBM ONCE — round 4's
-    version broadcast each scalar over its own (8, 128) tile, a 1024x
-    output-bandwidth waste flagged by the round-4 verdict."""
+    (I, J). The block stays VMEM-resident across the inner steps (the out
+    index_map ignores a, b) and is written to HBM ONCE — round 4's version
+    broadcast each scalar over its own (8, 128) tile, a 1024x
+    output-bandwidth waste flagged by the round-4 verdict. The inner
+    sub-grid (ia, ib) = (min(8, ga), min(128, gb)) shrinks with the tile
+    grid so small inputs don't pay 1024 inner steps for a handful of tiles
+    (cells the inner grid never reaches stay at the first step's
+    zero-init)."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
-    ti = pl.program_id(0) * 8 + pl.program_id(2)     # global tile row
-    tj = pl.program_id(1) * 128 + pl.program_id(3)   # global tile col
+    ti = pl.program_id(0) * ia + pl.program_id(2)    # global tile row
+    tj = pl.program_id(1) * ib + pl.program_id(3)    # global tile col
     a = pl.program_id(2)
     b = pl.program_id(3)
 
@@ -118,35 +123,36 @@ def _grid_kernel(n_a, n_b, tile_a, tile_b, ga, gb, a_ref, b_ref, out_ref):
         out_ref[:, :] = out_ref[:, :] + jnp.where(onehot, count(True), 0)
 
 
-def match_grid(a_words: np.ndarray, b_words: np.ndarray,
-               tile_a: int = TILE_A, tile_b: int = TILE_B):
-    """[W, nA] × [W, nB] k-mer words -> [ceil(nA/tile), ceil(nB/tile)] match
-    counts. Runs the Pallas kernel on TPU, falling back to interpret mode on
-    CPU backends."""
+def _grid_call(a_pad, b_pad, n_a: int, n_b: int, tile_a: int, tile_b: int,
+               interpret: bool):
+    """The traced VPU-grid dispatch (tile-padded device arrays in, tile
+    counts out) — the exact code the chip runs, shared by :func:`match_grid`
+    and the AOT TPU-lowering tests (tests/test_tpu_lowering.py export THIS
+    with interpret=False, so the production dispatch can't drift from what
+    CI lowers)."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
-    W, n_a = a_words.shape
-    _, n_b = b_words.shape
-    a_pad = _pad_to(a_words, tile_a, -1)
-    b_pad = _pad_to(b_words, tile_b, -2)
+    W = a_pad.shape[0]
     ga = a_pad.shape[1] // tile_a
     gb = b_pad.shape[1] // tile_b
-    GA = -(-ga // 8)        # output blocks: 8 tile rows x 128 tile cols
-    GB = -(-gb // 128)
+    ia = min(8, ga)         # inner sub-grid: up to 8 x 128 tiles share one
+    ib = min(128, gb)       # (8, 128) output block
+    GA = -(-ga // ia)
+    GB = -(-gb // ib)
 
     def a_map(I, J, a, b):  # noqa: E741 — grid index names
         # clamp: sub-grid tiles past the edge load a valid (ignored) block
-        return (0, jnp.minimum(I * 8 + a, ga - 1))
+        return (0, jnp.minimum(I * ia + a, ga - 1))
 
     def b_map(I, J, a, b):
-        return (0, jnp.minimum(J * 128 + b, gb - 1))
+        return (0, jnp.minimum(J * ib + b, gb - 1))
 
-    interpret = jax.default_backend() != "tpu"
     tiles = pl.pallas_call(
-        functools.partial(_grid_kernel, n_a, n_b, tile_a, tile_b, ga, gb),
-        grid=(GA, GB, 8, 128),
+        functools.partial(_grid_kernel, n_a, n_b, tile_a, tile_b, ga, gb,
+                          ia, ib),
+        grid=(GA, GB, ia, ib),
         in_specs=[
             pl.BlockSpec((W, tile_a), a_map),
             pl.BlockSpec((W, tile_b), b_map),
@@ -154,8 +160,25 @@ def match_grid(a_words: np.ndarray, b_words: np.ndarray,
         out_specs=pl.BlockSpec((8, 128), lambda I, J, a, b: (I, J)),
         out_shape=jax.ShapeDtypeStruct((GA * 8, GB * 128), jnp.int32),
         interpret=interpret,
-    )(jnp.asarray(a_pad), jnp.asarray(b_pad))
+    )(a_pad, b_pad)
     return tiles[:ga, :gb]
+
+
+def match_grid(a_words: np.ndarray, b_words: np.ndarray,
+               tile_a: int = TILE_A, tile_b: int = TILE_B):
+    """[W, nA] × [W, nB] k-mer words -> [ceil(nA/tile), ceil(nB/tile)] match
+    counts. Runs the Pallas kernel on TPU, falling back to interpret mode on
+    CPU backends."""
+    import jax
+    import jax.numpy as jnp
+
+    _, n_a = a_words.shape
+    _, n_b = b_words.shape
+    a_pad = _pad_to(a_words, tile_a, -1)
+    b_pad = _pad_to(b_words, tile_b, -2)
+    return _grid_call(jnp.asarray(a_pad), jnp.asarray(b_pad), n_a, n_b,
+                      tile_a, tile_b,
+                      interpret=jax.default_backend() != "tpu")
 
 
 TILE_MXU = 1024
@@ -189,7 +212,7 @@ def expand_pm1_words(words, k: int, n_valid: int = None, dtype="bfloat16"):
     return pm
 
 
-def _mxu_kernel(two_k, acc_dtype, ga, gb, a_ref, b_ref, out_ref):
+def _mxu_kernel(two_k, acc_dtype, ga, gb, ia, ib, a_ref, b_ref, out_ref):
     """±1-matmul grid with the same sub-grid output accumulation as
     _grid_kernel: inner step (a, b) deposits its scalar into element (a, b)
     of the (8, 128) block resident for (I, J)."""
@@ -197,8 +220,8 @@ def _mxu_kernel(two_k, acc_dtype, ga, gb, a_ref, b_ref, out_ref):
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
-    ti = pl.program_id(0) * 8 + pl.program_id(2)
-    tj = pl.program_id(1) * 128 + pl.program_id(3)
+    ti = pl.program_id(0) * ia + pl.program_id(2)
+    tj = pl.program_id(1) * ib + pl.program_id(3)
     a = pl.program_id(2)
     b = pl.program_id(3)
     rows = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 0)
@@ -258,15 +281,21 @@ def _mxu_jit():
 
     return jax.jit(_mxu_run_impl,
                    static_argnames=("k", "n_a", "n_b", "tile_a", "tile_b",
-                                    "in_dtype"))
+                                    "in_dtype", "interpret"))
 
 
 def _mxu_run(a_pad, b_pad, k, n_a, n_b, tile_a, tile_b, in_dtype):
+    import jax
+
     return _mxu_jit()(a_pad, b_pad, k=k, n_a=n_a, n_b=n_b,
-                      tile_a=tile_a, tile_b=tile_b, in_dtype=in_dtype)
+                      tile_a=tile_a, tile_b=tile_b, in_dtype=in_dtype,
+                      interpret=jax.default_backend() != "tpu")
 
 
-def _mxu_run_impl(a_pad, b_pad, *, k, n_a, n_b, tile_a, tile_b, in_dtype):
+def _mxu_run_impl(a_pad, b_pad, *, k, n_a, n_b, tile_a, tile_b, in_dtype,
+                  interpret):
+    """The traced MXU-grid dispatch — exported verbatim by the AOT
+    TPU-lowering tests with interpret=False (tests/test_tpu_lowering.py)."""
     import functools as ft
 
     import jax
@@ -275,24 +304,26 @@ def _mxu_run_impl(a_pad, b_pad, *, k, n_a, n_b, tile_a, tile_b, in_dtype):
 
     ga = a_pad.shape[1] // tile_a
     gb = b_pad.shape[1] // tile_b
-    GA = -(-ga // 8)
-    GB = -(-gb // 128)
+    ia = min(8, ga)
+    ib = min(128, gb)
+    GA = -(-ga // ia)
+    GB = -(-gb // ib)
     D = 2 * k
     acc = jnp.int32 if in_dtype == "int8" else jnp.float32
     a_pm = expand_pm1_words(a_pad, k, n_valid=n_a, dtype=in_dtype)
     b_pm = expand_pm1_words(b_pad, k, n_valid=n_b, dtype=in_dtype)
     tiles = pl.pallas_call(
-        ft.partial(_mxu_kernel, 2 * k, acc, ga, gb),
-        grid=(GA, GB, 8, 128),
+        ft.partial(_mxu_kernel, 2 * k, acc, ga, gb, ia, ib),
+        grid=(GA, GB, ia, ib),
         in_specs=[
             pl.BlockSpec((tile_a, D),
-                         lambda I, J, a, b: (jnp.minimum(I * 8 + a, ga - 1), 0)),
+                         lambda I, J, a, b: (jnp.minimum(I * ia + a, ga - 1), 0)),
             pl.BlockSpec((tile_b, D),
-                         lambda I, J, a, b: (jnp.minimum(J * 128 + b, gb - 1), 0)),
+                         lambda I, J, a, b: (jnp.minimum(J * ib + b, gb - 1), 0)),
         ],
         out_specs=pl.BlockSpec((8, 128), lambda I, J, a, b: (I, J)),
         out_shape=jax.ShapeDtypeStruct((GA * 8, GB * 128), jnp.int32),
-        interpret=jax.default_backend() != "tpu",
+        interpret=interpret,
     )(a_pm, b_pm)
     return tiles[:ga, :gb]
 

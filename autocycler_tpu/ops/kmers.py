@@ -58,8 +58,19 @@ def _resolve_use_jax(use_jax: UseJax) -> UseJax:
         return use_jax
     value = os.environ.get("AUTOCYCLER_DEVICE_GROUPING", "").strip().lower()
     if value in ("1", "true", "yes", "on"):
-        from .distance import _tpu_attached
-        return "pallas" if _tpu_attached() else "bucketed"
+        from .distance import _tpu_attached, jax_backend_safe
+        if _tpu_attached():
+            return "pallas"
+        if jax_backend_safe():
+            return "bucketed"
+        # probe timed out / errored: on this platform the plugin overrides
+        # JAX_PLATFORMS, so ANY jax-touching mode could hang in backend
+        # init — keep the native/host default, loudly
+        import sys
+        print("autocycler: device grouping requested but jax backend init "
+              "is not known-safe (wedged device transport?); keeping the "
+              "host grouping default", file=sys.stderr)
+        return False
     if value == "pallas":
         return "pallas"
     if value == "bucketed":

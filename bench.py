@@ -418,19 +418,19 @@ def bench_grouping(n_mbp: float = 147.0) -> None:
 
     from autocycler_tpu.utils import timing
 
-    def timed(tag, use_jax):
+    def timed(tag, use_jax, suffix=""):
         fail0, _ = timing.device_failures()
         t0 = time.perf_counter()
         gid, order = group_windows_full(codes, starts, k, use_jax=use_jax)
         dt = time.perf_counter() - t0
         fail1, what = timing.device_failures()
-        # the flag tracks the MOST RECENT attempt for this tag: a cold-run
-        # fallback that recovers by the warm (reported) run must not
-        # permanently disqualify the tag's device time
-        results.pop(f"{tag}_fell_back", None)
+        # per-attempt flag (suffix distinguishes cold from the reported
+        # warm run): a cold-run fallback must be recorded AS the cold
+        # attempt's, and must not disqualify a warm run that genuinely ran
+        # on device
         if fail1 > fail0:
             # the time measured is the HOST fallback's, not the device's
-            results[f"{tag}_fell_back"] = what
+            results[f"{tag}{suffix}_fell_back"] = what
         return (gid, order), dt
 
     (gid_n, order_n), native_s = timed("native", False)
@@ -443,16 +443,21 @@ def bench_grouping(n_mbp: float = 147.0) -> None:
             # full-size run is reported separately as the cold time
             group_windows_full(codes[:1 << 16], starts[:1 << 15], k,
                                use_jax=mode)
-            (gid, order), dt = timed(tag, mode)
-            ok = bool((gid == gid_n).all() and (order == order_n).all())
-            results[f"{tag}_s"] = round(dt, 2)
-            results[f"{tag}_exact"] = ok
             if mode == "pallas":
-                results[f"{tag}_cold_s"] = results.pop(f"{tag}_s")
+                # first full-size run = cold (per-size compile), annotated
+                # per attempt; then the warm reported run
+                (gid, order), dt = timed(tag, mode, suffix="_cold")
+                ok = bool((gid == gid_n).all() and (order == order_n).all())
+                results[f"{tag}_cold_s"] = round(dt, 2)
                 (gid, order), dt = timed(tag, mode)
                 results[f"{tag}_s"] = round(dt, 2)
                 results[f"{tag}_exact"] = ok and bool(
                     (gid == gid_n).all() and (order == order_n).all())
+            else:
+                (gid, order), dt = timed(tag, mode)
+                results[f"{tag}_s"] = round(dt, 2)
+                results[f"{tag}_exact"] = bool((gid == gid_n).all()
+                                               and (order == order_n).all())
         except Exception as exc:
             print(f"{tag} failed: {type(exc).__name__}: {exc}",
                   file=sys.stderr)

@@ -1,0 +1,77 @@
+"""AOT TPU lowering of the Pallas kernels (no device needed).
+
+`jax.export` with platforms=["tpu"] runs the full Pallas -> Mosaic lowering
+pipeline on any host, producing the `tpu_custom_call` payload the chip
+executes. These tests export the PRODUCTION traced dispatches (not copies)
+at production block shapes, so the dispatch CI lowers is the dispatch the
+chip runs — catching the class of Mosaic rejections that interpret-mode
+tests cannot see (unsupported ops, bad block shapes, rank/layout errors at
+lowering time). Chip-side Mosaic verification at compile time remains the
+residual risk.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+
+def _export_tpu(fn, *args):
+    exp = jax.export.export(jax.jit(fn), platforms=["tpu"])(*args)
+    assert "tpu_custom_call" in exp.mlir_module()
+    return exp
+
+
+def test_sortnet_network_lowers_for_tpu_production_shape():
+    """The bitonic grouping network at the PRODUCTION block size
+    (block_rows=1024 -> 2**17-element blocks) with both local and global
+    substages (N = 2 blocks), and the production array count for k=51
+    (4 base-5 words + index)."""
+    from autocycler_tpu.ops.sortnet import DEFAULT_BLOCK_ROWS, run_network
+
+    def net(*arrs):
+        return run_network(list(arrs), block_rows=DEFAULT_BLOCK_ROWS,
+                           interpret=False)
+
+    args = [jnp.zeros(1 << 18, jnp.int32) for _ in range(5)]
+    _export_tpu(net, *args)
+
+
+def test_grouping_pipeline_lowers_for_tpu_production_shape():
+    """The full fused grouping dispatch (packing + network + group ids) as
+    _pack_and_rank_jax_pallas builds it: k=51, production block size."""
+    from autocycler_tpu.ops import kmers
+
+    fn = kmers._pallas_rank_fn.__wrapped__(1 << 18, 1 << 20, 51, False,
+                                           kmers._PALLAS_BLOCK_ROWS)
+    _export_tpu(fn, jnp.zeros(1 << 20, jnp.uint8),
+                jnp.zeros(1 << 18, jnp.int32), jnp.int32(100000))
+
+
+def test_dotplot_vpu_grid_lowers_for_tpu():
+    """The production VPU-grid dispatch (_grid_call) at the benchmark tile
+    shape (2048 x 4096)."""
+    from autocycler_tpu.ops.dotplot_pallas import _grid_call
+
+    tile_a, tile_b = 2048, 4096
+    a = jnp.zeros((2, 8 * tile_a), jnp.int32)
+    b = jnp.zeros((2, 2 * tile_b), jnp.int32)
+    _export_tpu(
+        functools.partial(_grid_call, n_a=16000, n_b=8000, tile_a=tile_a,
+                          tile_b=tile_b, interpret=False), a, b)
+
+
+@pytest.mark.parametrize("in_dtype", ["bfloat16", "int8"])
+def test_dotplot_mxu_grid_lowers_for_tpu(in_dtype):
+    """The production MXU-grid dispatch (_mxu_run_impl) at the benchmark
+    tile shape (1024 x 1024), both input precisions."""
+    from autocycler_tpu.ops.dotplot_pallas import _mxu_run_impl
+
+    tile = 1024
+    a = jnp.zeros((2, 8 * tile), jnp.int32)
+    b = jnp.zeros((2, 2 * tile), jnp.int32)
+    _export_tpu(
+        functools.partial(_mxu_run_impl, k=32, n_a=8000, n_b=2000,
+                          tile_a=tile, tile_b=tile, in_dtype=in_dtype,
+                          interpret=False), a, b)
