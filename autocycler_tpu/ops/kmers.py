@@ -41,35 +41,49 @@ SYMS_PER_WORD_DEV = 13
 # sorts), False, or None (resolve via env)
 UseJax = Union[bool, str, None]
 
+# one warning per process when a generic device-grouping enable degrades to
+# the host default because jax backend init is not known-safe
+_WARNED_BACKEND_UNSAFE = False
+
 
 def _resolve_use_jax(use_jax: UseJax) -> UseJax:
     """None resolves through AUTOCYCLER_DEVICE_GROUPING: a generic enable
     value ('1'/'true'/'yes'/'on') opts into the Pallas bitonic sort-network
     kernel (ops/sortnet.py) when a TPU answers the probe, else the bucketed
-    XLA sort — the Pallas path on a host backend would run the network
-    through the interpret-mode simulator, which at product scale is an
-    effective hang, not a fallback. 'pallas' / 'bucketed' / 'lsd' /
-    'direct' select a variant explicitly (benchmarks and tests); explicit
-    disable spellings and '' keep the native/host default. Unrecognised
-    values keep the default too, with a stderr note — guessing an
-    operator's intent the expensive way ('off' enabling a ~170 s/sort
-    tunnel path) is worse than ignoring a typo."""
+    XLA sort WHEN jax backend init is known-safe (the Pallas path on a host
+    backend would run the network through the interpret-mode simulator,
+    which at product scale is an effective hang; and with the probe timed
+    out — or disabled without a platform pin — even "host" jax use can
+    block in the plugin's backend init, so the native default is kept with
+    a stderr note). 'pallas' / 'bucketed' / 'lsd' / 'direct' select a
+    variant explicitly (benchmarks and tests); explicit disable spellings
+    and '' keep the native/host default. Unrecognised values keep the
+    default too, with a stderr note — guessing an operator's intent the
+    expensive way ('off' enabling a ~170 s/sort tunnel path) is worse than
+    ignoring a typo."""
     if use_jax is not None:
         return use_jax
     value = os.environ.get("AUTOCYCLER_DEVICE_GROUPING", "").strip().lower()
     if value in ("1", "true", "yes", "on"):
-        from .distance import _tpu_attached, jax_backend_safe
+        from .distance import (_tpu_attached, device_probe_report,
+                               jax_backend_safe)
         if _tpu_attached():
             return "pallas"
         if jax_backend_safe():
             return "bucketed"
-        # probe timed out / errored: on this platform the plugin overrides
-        # JAX_PLATFORMS, so ANY jax-touching mode could hang in backend
-        # init — keep the native/host default, loudly
-        import sys
-        print("autocycler: device grouping requested but jax backend init "
-              "is not known-safe (wedged device transport?); keeping the "
-              "host grouping default", file=sys.stderr)
+        # probe timed out / errored / disabled without a platform pin: the
+        # plugin overrides JAX_PLATFORMS, so ANY jax-touching mode (even
+        # the "host" bucketed sort) could hang in backend init — keep the
+        # native/host default, loudly but once per process, with the
+        # probe's actual reason (it may equally be the operator's
+        # AUTOCYCLER_DEVICE_PROBE_TIMEOUT<=0 kill switch)
+        global _WARNED_BACKEND_UNSAFE
+        if not _WARNED_BACKEND_UNSAFE:
+            _WARNED_BACKEND_UNSAFE = True
+            import sys
+            print("autocycler: device grouping requested but jax backend "
+                  f"init is not known-safe ({device_probe_report()['reason']});"
+                  " keeping the host grouping default", file=sys.stderr)
         return False
     if value == "pallas":
         return "pallas"

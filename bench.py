@@ -285,7 +285,19 @@ def bench_dotplot() -> None:
                                                    match_grid_reference,
                                                    pack_2bit_words)
 
+    from autocycler_tpu.ops.distance import device_probe_report, jax_backend_safe
     from autocycler_tpu.ops.mfu import mxu_grid_mfu, vpu_grid_mfu
+
+    if not jax_backend_safe():
+        # the TPU plugin overrides JAX_PLATFORMS; with a wedged transport
+        # even backend init can hang, so refuse with the probe's reason
+        # instead of blocking the benchmark forever
+        print(json.dumps({
+            "metric": "dotplot_kmer_match_grid", "value": 0,
+            "unit": "Gcells/s", "vs_baseline": 0,
+            "device_probe": device_probe_report(),
+        }))
+        return
 
     k = 32
     n = 524288  # a full all-vs-all plasmid-cluster grid: 512k x 512k k-mers
