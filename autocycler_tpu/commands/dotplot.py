@@ -153,11 +153,6 @@ DEVICE_GRID_MIN_CELLS = None
 # anyway.
 MAX_DEVICE_CELLS = 5e11
 
-# one warning per process when device grid mode degrades to host because
-# jax backend init is not known-safe (dozens of sequence pairs would
-# otherwise each repeat it)
-_WARNED_BACKEND_UNSAFE = False
-
 
 def _device_match_pair(a_words: np.ndarray, b_words: np.ndarray, tile: int = 2048
                        ) -> Tuple[np.ndarray, np.ndarray]:
@@ -211,22 +206,14 @@ def kmer_match_positions_device(seq_a: np.ndarray, seq_b: np.ndarray, kmer: int
         return z, z, z, z
     if float(n_a) * float(n_b) > MAX_DEVICE_CELLS:
         return None
-    from ..ops.distance import device_probe_report, jax_backend_safe
+    from ..ops.distance import jax_backend_safe, warn_backend_unsafe_once
     if not jax_backend_safe():
         # the installed TPU plugin overrides JAX_PLATFORMS, so when its
         # transport is wedged even an "interpret-mode" grid would hang in
         # backend init; the probe's deadline already ran — fall back to the
-        # host sort-join loudly (once, with the probe's actual reason, not
-        # a guess — the cause may equally be the operator's kill switch)
-        # instead of blocking the CLI forever
-        global _WARNED_BACKEND_UNSAFE
-        if not _WARNED_BACKEND_UNSAFE:
-            _WARNED_BACKEND_UNSAFE = True
-            import sys
-            print("autocycler: device grid mode requested but jax backend "
-                  "init is not known-safe "
-                  f"({device_probe_report()['reason']}); using the host "
-                  "matcher", file=sys.stderr)
+        # host sort-join loudly (once per process, with the probe's actual
+        # reason) instead of blocking the CLI forever
+        warn_backend_unsafe_once("device grid mode")
         return None
     codes_a = encode_bytes(seq_a)
     codes_b = encode_bytes(seq_b)

@@ -68,6 +68,24 @@ def device_probe_report() -> dict:
                 "probes": _probe_state.get("probes", 0)}
 
 
+_WARNED_UNSAFE: set = set()
+
+
+def warn_backend_unsafe_once(context: str) -> None:
+    """One stderr warning per (process, context) when a device feature
+    degrades to a host path because jax backend init is not known-safe —
+    shared by every call site so the flag, message shape and probe reason
+    can't drift between them."""
+    with _PROBE_LOCK:
+        if context in _WARNED_UNSAFE:
+            return
+        _WARNED_UNSAFE.add(context)
+    import sys
+    print(f"autocycler: {context} requested but jax backend init is not "
+          f"known-safe ({device_probe_report()['reason']}); using the host "
+          "path", file=sys.stderr)
+
+
 def jax_backend_safe() -> bool:
     """Whether touching jax (ANY backend init, even interpret-mode Pallas)
     is known not to hang: True when the probe short-circuited on a pinned

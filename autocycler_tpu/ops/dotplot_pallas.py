@@ -137,8 +137,10 @@ def _grid_call(a_pad, b_pad, n_a: int, n_b: int, tile_a: int, tile_b: int,
     W = a_pad.shape[0]
     ga = a_pad.shape[1] // tile_a
     gb = b_pad.shape[1] // tile_b
-    if ga == 0 or gb == 0:  # zero k-mers on a side: empty tile grid
-        return jnp.zeros((ga, gb), jnp.int32)
+    if ga == 0 or gb == 0:
+        # zero k-mers on a side: a 1x-floor zero grid, matching the host
+        # oracle's shape convention (match_grid_reference uses max(n, 1))
+        return jnp.zeros((max(ga, 1), max(gb, 1)), jnp.int32)
     ia = min(8, ga)         # inner sub-grid: up to 8 x 128 tiles share one
     ib = min(128, gb)       # (8, 128) output block
     GA = -(-ga // ia)
@@ -306,8 +308,10 @@ def _mxu_run_impl(a_pad, b_pad, *, k, n_a, n_b, tile_a, tile_b, in_dtype,
 
     ga = a_pad.shape[1] // tile_a
     gb = b_pad.shape[1] // tile_b
-    if ga == 0 or gb == 0:  # zero k-mers on a side: empty tile grid
-        return jnp.zeros((ga, gb), jnp.int32)
+    if ga == 0 or gb == 0:
+        # zero k-mers on a side: a 1x-floor zero grid, matching the host
+        # oracle's shape convention (match_grid_reference uses max(n, 1))
+        return jnp.zeros((max(ga, 1), max(gb, 1)), jnp.int32)
     ia = min(8, ga)
     ib = min(128, gb)
     GA = -(-ga // ia)

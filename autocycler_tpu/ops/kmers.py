@@ -41,10 +41,6 @@ SYMS_PER_WORD_DEV = 13
 # sorts), False, or None (resolve via env)
 UseJax = Union[bool, str, None]
 
-# one warning per process when a generic device-grouping enable degrades to
-# the host default because jax backend init is not known-safe
-_WARNED_BACKEND_UNSAFE = False
-
 
 def _resolve_use_jax(use_jax: UseJax) -> UseJax:
     """None resolves through AUTOCYCLER_DEVICE_GROUPING: a generic enable
@@ -65,8 +61,8 @@ def _resolve_use_jax(use_jax: UseJax) -> UseJax:
         return use_jax
     value = os.environ.get("AUTOCYCLER_DEVICE_GROUPING", "").strip().lower()
     if value in ("1", "true", "yes", "on"):
-        from .distance import (_tpu_attached, device_probe_report,
-                               jax_backend_safe)
+        from .distance import (_tpu_attached, jax_backend_safe,
+                               warn_backend_unsafe_once)
         if _tpu_attached():
             return "pallas"
         if jax_backend_safe():
@@ -74,16 +70,8 @@ def _resolve_use_jax(use_jax: UseJax) -> UseJax:
         # probe timed out / errored / disabled without a platform pin: the
         # plugin overrides JAX_PLATFORMS, so ANY jax-touching mode (even
         # the "host" bucketed sort) could hang in backend init — keep the
-        # native/host default, loudly but once per process, with the
-        # probe's actual reason (it may equally be the operator's
-        # AUTOCYCLER_DEVICE_PROBE_TIMEOUT<=0 kill switch)
-        global _WARNED_BACKEND_UNSAFE
-        if not _WARNED_BACKEND_UNSAFE:
-            _WARNED_BACKEND_UNSAFE = True
-            import sys
-            print("autocycler: device grouping requested but jax backend "
-                  f"init is not known-safe ({device_probe_report()['reason']});"
-                  " keeping the host grouping default", file=sys.stderr)
+        # native/host default, loudly but once per process
+        warn_backend_unsafe_once("device grouping")
         return False
     if value == "pallas":
         return "pallas"

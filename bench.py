@@ -285,13 +285,14 @@ def bench_dotplot() -> None:
                                                    match_grid_reference,
                                                    pack_2bit_words)
 
-    from autocycler_tpu.ops.distance import device_probe_report, jax_backend_safe
+    from autocycler_tpu.ops.distance import _tpu_attached, device_probe_report
     from autocycler_tpu.ops.mfu import mxu_grid_mfu, vpu_grid_mfu
 
-    if not jax_backend_safe():
-        # the TPU plugin overrides JAX_PLATFORMS; with a wedged transport
-        # even backend init can hang, so refuse with the probe's reason
-        # instead of blocking the benchmark forever
+    if not _tpu_attached():
+        # this benchmark only means something on a chip: without one, the
+        # 512k² grid would either hang in wedged backend init or grind for
+        # hours in the interpret simulator — refuse with the probe's
+        # recorded reason either way
         print(json.dumps({
             "metric": "dotplot_kmer_match_grid", "value": 0,
             "unit": "Gcells/s", "vs_baseline": 0,

@@ -163,6 +163,55 @@ def test_probe_failure_permanent_when_ttl_disabled(monkeypatch, capsys):
     capsys.readouterr()
 
 
+def test_jax_backend_safe_kinds(monkeypatch):
+    """jax_backend_safe: True for 'pinned' (platform names a non-TPU
+    backend; jax untouched but safe) and 'no-tpu'/'ok' (a backend actually
+    initialised); False for 'timeout'/'disabled' — with the plugin
+    overriding JAX_PLATFORMS, an unprobed or wedged transport can hang ANY
+    backend init."""
+    import threading
+
+    from autocycler_tpu.ops import distance
+
+    distance._tpu_attached.cache_clear()
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert distance.jax_backend_safe() is True
+    assert distance.device_probe_report()["kind"] == "pinned"
+
+    distance._tpu_attached.cache_clear()
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    monkeypatch.setenv("AUTOCYCLER_DEVICE_PROBE_TIMEOUT", "0")
+    assert distance.jax_backend_safe() is False
+    assert distance.device_probe_report()["kind"] == "disabled"
+
+    distance._tpu_attached.cache_clear()
+    monkeypatch.setenv("AUTOCYCLER_DEVICE_PROBE_TIMEOUT", "0.05")
+
+    class HangingThread(threading.Thread):
+        def __init__(self, *a, **kw):
+            kw["target"] = lambda: threading.Event().wait(5)
+            super().__init__(*a, **kw)
+
+    monkeypatch.setattr(distance._threading, "Thread", HangingThread)
+    assert distance.jax_backend_safe() is False
+    assert distance.device_probe_report()["kind"] == "timeout"
+    monkeypatch.undo()
+
+    # a real probe on the pinned-CPU test backend initialises cpu -> no-tpu
+    distance._tpu_attached.cache_clear()
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")  # reach the real probe
+    # re-pin a positive deadline: undo() restored the AMBIENT environment,
+    # which may export the TIMEOUT<=0 kill switch
+    monkeypatch.setenv("AUTOCYCLER_DEVICE_PROBE_TIMEOUT", "30")
+    import jax
+
+    # conftest pins the platform via jax.config, so default_backend()
+    # answers 'cpu' without touching any device transport
+    assert jax.default_backend() == "cpu"
+    assert distance.jax_backend_safe() is True
+    assert distance.device_probe_report()["kind"] == "no-tpu"
+
+
 def test_probe_failure_keeps_host_matmul_exact():
     """With the probe answering False, pairwise distances use the host
     matmul and stay exact — the degraded mode is bit-identical, not
