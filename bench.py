@@ -103,11 +103,51 @@ def _run_headline_once():
     return elapsed, stages
 
 
-def _dotplot_rates(n: int = 524288, k: int = 32, repeats: int = 3) -> dict:
+def _with_deadline(fn, seconds: float, label: str):
+    """Run a device-evidence block in a daemon thread with a deadline: a
+    wedged device call cannot be interrupted, but it CAN be abandoned so
+    the artifact still prints (with the timeout recorded) instead of the
+    whole benchmark dying without output.
+
+    ``fn`` receives a dict it fills AS IT MEASURES, so evidence gathered
+    before a wedge survives into the artifact (partial evidence beats
+    none). Returns (evidence dict, still_running) — a True flag means the
+    abandoned thread may still be touching the device, so later evidence
+    blocks should be skipped rather than contaminated."""
+    import threading
+
+    partial: dict = {}
+    result: dict = {}
+
+    def run() -> None:
+        try:
+            result["value"] = fn(partial)
+        except BaseException as exc:  # noqa: BLE001 — recorded, not fatal
+            result["error"] = f"{type(exc).__name__}: {exc}"
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(seconds)
+    if "value" in result:
+        return result["value"], False
+    out = dict(partial)          # whatever was measured before the wedge
+    if "error" in result:
+        out["error"] = result["error"]
+    elif t.is_alive():
+        out["error"] = (f"{label} did not finish within {seconds:.0f}s; "
+                        "abandoned")
+    else:
+        out["error"] = f"{label} worker died without a result"
+    return out, t.is_alive()
+
+
+def _dotplot_rates(n: int = 524288, k: int = 32, repeats: int = 3,
+                   out: dict = None) -> dict:
     """Match-grid kernel rates at benchmark scale (512k² by default) with
     MFU anchoring (VERDICT r4 items 3/4). Returns {} on a non-TPU backend
     (interpret-mode Pallas at 512k² would run for hours, not measure
-    anything)."""
+    anything). ``out`` (when given) is filled per kernel as rates land, so
+    a deadline-abandoned run keeps its partial evidence."""
     import jax
 
     from autocycler_tpu.ops.dotplot_pallas import benchmark_gcells
@@ -115,7 +155,7 @@ def _dotplot_rates(n: int = 524288, k: int = 32, repeats: int = 3) -> dict:
 
     if jax.default_backend() != "tpu":
         return {}
-    out = {}
+    out = {} if out is None else out
     for kern, mfu in (("vpu", vpu_grid_mfu),
                       ("mxu", lambda r, k: mxu_grid_mfu(r, k)),
                       ("mxu8", lambda r, k: mxu_grid_mfu(r, k, int8=True))):
@@ -132,12 +172,14 @@ def _dotplot_rates(n: int = 524288, k: int = 32, repeats: int = 3) -> dict:
     return out
 
 
-def _grouping_evidence(n_mbp: float = 24.0) -> dict:
+def _grouping_evidence(n_mbp: float = 24.0, out: dict = None) -> dict:
     """Device k-mer grouping vs the native hash kernel at a bounded scale
     (default 24 Mbp of both-strand windows — one assembly's worth), with the
     exactness gate. The full 147 Mbp shootout stays under
     `python bench.py grouping`; this bounded version puts chip evidence in
-    the DEFAULT artifact (VERDICT r4 item 1c)."""
+    the DEFAULT artifact (VERDICT r4 item 1c). ``out`` (when given) is
+    filled per backend as results land, so a deadline-abandoned run keeps
+    its partial evidence."""
     import numpy as np
 
     from autocycler_tpu.ops.kmers import group_windows_full
@@ -150,7 +192,8 @@ def _grouping_evidence(n_mbp: float = 24.0) -> dict:
     codes = np.concatenate([np.roll(genome, int(rng.integers(0, len(genome))))
                             for _ in range(4)])[:n]
     starts = np.arange(0, len(codes) - k, dtype=np.int64)
-    out = {"windows": len(starts), "k": k}
+    out = {} if out is None else out
+    out.update(windows=len(starts), k=k)
     t0 = time.perf_counter()
     gid_n, order_n = group_windows_full(codes, starts, k, use_jax=False)
     out["native_s"] = round(time.perf_counter() - t0, 2)
@@ -236,16 +279,25 @@ def bench_headline() -> None:
     # when the probe says a TPU is attached, measure the match-grid kernels
     # (with MFU anchoring) and the device grouping backends here, so the
     # round artifact carries chip numbers — not only the pipeline wall.
+    # Each evidence block runs under its own deadline: the headline number
+    # is already measured at this point, and a wedging device call (or a
+    # multi-minute Mosaic compile) must delay the artifact, not lose it.
     device_kernels = {}
     if probe["attached"]:
-        try:
-            device_kernels["dotplot"] = _dotplot_rates()
-        except Exception as exc:  # noqa: BLE001
-            device_kernels["dotplot"] = {"error": f"{type(exc).__name__}: {exc}"}
-        try:
-            device_kernels["grouping"] = _grouping_evidence()
-        except Exception as exc:  # noqa: BLE001
-            device_kernels["grouping"] = {"error": f"{type(exc).__name__}: {exc}"}
+        dot, dot_wedged = _with_deadline(
+            lambda out: _dotplot_rates(out=out), 900, "dotplot rates")
+        device_kernels["dotplot"] = dot
+        if dot_wedged:
+            # the abandoned thread may still be dispatching to the device;
+            # running more evidence now would contaminate its timings and
+            # the shared failure counters
+            device_kernels["grouping"] = {
+                "skipped": "dotplot block still wedged on the device"}
+        else:
+            grp, _ = _with_deadline(
+                lambda out: _grouping_evidence(out=out), 1500,
+                "grouping shootout")
+            device_kernels["grouping"] = grp
         bench_failures, bench_failure_last = timing.device_failures()
         device_kernels["failures"] = bench_failures - failures
         if bench_failures > failures:
