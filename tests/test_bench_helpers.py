@@ -1,0 +1,78 @@
+"""The benchmark's device-evidence helpers (bench.py): the deadline
+harness (partial evidence survives a wedge; crash vs timeout), the
+caller-dict threading of the evidence blocks, and the MFU conversions the
+artifacts are anchored with."""
+
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import bench  # noqa: E402
+
+
+def test_with_deadline_fast_path():
+    v, wedged = bench._with_deadline(lambda p: {"x": 1}, 5, "fast")
+    assert v == {"x": 1} and wedged is False
+
+
+def test_with_deadline_preserves_partial_evidence_on_timeout():
+    def slow(p):
+        p["vpu"] = {"gcells_per_s": 400}
+        time.sleep(10)
+
+    v, wedged = bench._with_deadline(slow, 0.3, "slow block")
+    assert wedged is True
+    assert v["vpu"] == {"gcells_per_s": 400}
+    assert "did not finish" in v["error"]
+
+
+def test_with_deadline_distinguishes_crash_from_timeout():
+    def crash(p):
+        p["early"] = 1
+        raise SystemExit(3)
+
+    v, wedged = bench._with_deadline(crash, 5, "boom")
+    assert wedged is False
+    assert "SystemExit" in v["error"] and v["early"] == 1
+
+
+def test_grouping_evidence_fills_caller_dict(monkeypatch, capsys):
+    """The evidence block writes into the dict the deadline harness hands
+    it (so abandoned runs keep partial results). The grouping backends are
+    stubbed — their exactness is covered by tests/test_kmers_backends.py;
+    this test targets the artifact plumbing."""
+    from autocycler_tpu.ops import kmers
+
+    def fake_group(codes, starts, k, use_jax=None):
+        n = len(starts)
+        return np.zeros(n, np.int64), np.arange(n, dtype=np.int64)
+
+    monkeypatch.setattr(kmers, "group_windows_full", fake_group)
+    out = {}
+    result = bench._grouping_evidence(n_mbp=0.02, out=out)
+    assert result is out
+    assert out["k"] == 51 and out["windows"] > 10_000
+    assert out["native_s"] is not None
+    assert out["lsd_exact"] is True and out["pallas_exact"] is True
+    assert "pallas_cold_s" in out and "pallas_hbm" in out
+    capsys.readouterr()
+
+
+def test_mfu_conversions_anchor_to_v5e_peaks():
+    from autocycler_tpu.ops.mfu import (V5E_HBM_BYTES, V5E_MXU_BF16_FLOPS,
+                                        mxu_grid_mfu, sort_bandwidth,
+                                        vpu_grid_mfu)
+
+    # a rate equal to peak must read ~100%
+    peak_rate_gcells = V5E_MXU_BF16_FLOPS / (4.0 * 32) / 1e9
+    assert abs(mxu_grid_mfu(peak_rate_gcells, 32)["pct_peak"] - 100.0) < 0.2
+    assert mxu_grid_mfu(peak_rate_gcells, 32, int8=True)["pct_peak"] < 60
+    assert vpu_grid_mfu(491, 32)["pct_peak"] > 40      # round-3 capture
+    bw = sort_bandwidth(2**27, 10, seconds=1.0, n_arrays=5)
+    expect = 8.0 * 5 * 2**27 * 10 / V5E_HBM_BYTES * 100
+    assert abs(bw["pct_peak"] - round(expect, 1)) < 0.2
+    assert sort_bandwidth(100, 1, 0.0) == {"gb_per_s": 0.0, "pct_peak": 0.0}
