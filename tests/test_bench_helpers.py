@@ -40,7 +40,7 @@ def test_with_deadline_distinguishes_crash_from_timeout():
     assert "SystemExit" in v["error"] and v["early"] == 1
 
 
-def test_grouping_evidence_fills_caller_dict(monkeypatch, capsys):
+def test_grouping_evidence_fills_caller_dict(monkeypatch):
     """The evidence block writes into the dict the deadline harness hands
     it (so abandoned runs keep partial results). The grouping backends are
     stubbed — their exactness is covered by tests/test_kmers_backends.py;
@@ -59,7 +59,6 @@ def test_grouping_evidence_fills_caller_dict(monkeypatch, capsys):
     assert out["native_s"] is not None
     assert out["lsd_exact"] is True and out["pallas_exact"] is True
     assert "pallas_cold_s" in out and "pallas_hbm" in out
-    capsys.readouterr()
 
 
 def test_mfu_conversions_anchor_to_v5e_peaks():

@@ -77,6 +77,24 @@ def test_pallas_network_padded_arbitrary_n():
     np.testing.assert_array_equal(np.asarray(order), expect[-1])
 
 
+def test_pallas_network_deep_global_layers():
+    """A 2^14-element network over 2^10-element blocks exercises four
+    global stage layers (s = 11..14, up to 4 global substages per stage)
+    — the closest interpret-mode analogue of the production shape's 11
+    layers, beyond the 1-3 layers the small cases cover. Uses the shared
+    helpers so key duplicates (the grouping use case) ride through the
+    deep layers too."""
+    rng = np.random.default_rng(42)
+    n = 1 << 14
+    words = _random_words(rng, n)
+    sorted_words, order = sortnet_padded(words, n, block_rows=8,
+                                         interpret=True)
+    expect = _expect_sorted(words)
+    for got, e in zip([np.asarray(w) for w in sorted_words], expect[:-1]):
+        np.testing.assert_array_equal(got, e)
+    np.testing.assert_array_equal(np.asarray(order), expect[-1])
+
+
 def test_pallas_network_all_equal_keys():
     """Grouping's worst case: every key identical — the index tiebreak must
     produce the identity permutation."""
