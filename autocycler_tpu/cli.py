@@ -42,6 +42,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-a", "--out_parent", required=True)
     p.add_argument("-k", "--kmer", type=int, default=51)
     p.add_argument("--max_contigs", type=int, default=25)
+    p.add_argument("--resume", action="store_true",
+                   help="replay a previous run from its batch_manifest.json, "
+                        "retrying only failed/pending isolates")
 
     p = sub.add_parser("clean",
                        help="manual manipulation of the final consensus assembly graph")
@@ -103,6 +106,12 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["ont_r9", "ont_r10", "pacbio_clr", "pacbio_hifi"])
     p.add_argument("--min_depth_abs", type=float)
     p.add_argument("--min_depth_rel", type=float)
+    p.add_argument("--timeout", type=float,
+                   help="per-subprocess wall-clock limit in seconds (a hung "
+                        "assembler is killed and counts as a failed attempt)")
+    p.add_argument("--retries", type=int,
+                   help="failed/hung subprocess retries with exponential "
+                        "backoff (default 0)")
     p.add_argument("--args", dest="extra_args", nargs="+", default=[])
 
     p = sub.add_parser("resolve", help="resolve repeats in the unitig graph")
@@ -134,11 +143,14 @@ def build_parser() -> argparse.ArgumentParser:
     return parser
 
 
-def dispatch(args) -> None:
+def dispatch(args) -> int:
+    """Run the selected subcommand; returns the process exit code (batch
+    returns 2 on partial failure — some isolates quarantined, the rest
+    completed — so orchestrators can distinguish it from total failure)."""
     if args.command == "batch":
         from .commands.batch import batch
-        batch(args.assemblies_parent, args.out_parent, args.kmer,
-              args.max_contigs)
+        return batch(args.assemblies_parent, args.out_parent, args.kmer,
+                     args.max_contigs, resume=args.resume)
     elif args.command == "clean":
         from .commands.clean import clean
         clean(args.in_gfa, args.out_gfa, args.remove, args.duplicate, args.min_depth)
@@ -166,7 +178,7 @@ def dispatch(args) -> None:
         from .commands.helper import helper
         helper(args.task, args.reads, args.out_prefix, args.genome_size, args.threads,
                args.dir, args.read_type, args.min_depth_abs, args.min_depth_rel,
-               args.extra_args)
+               args.extra_args, timeout=args.timeout, retries=args.retries)
     elif args.command == "resolve":
         from .commands.resolve import resolve
         resolve(args.cluster_dir, args.verbose)
@@ -218,11 +230,11 @@ def main(argv=None) -> int:
         import gc
         gc.disable()
     try:
-        dispatch(args)
+        rc = dispatch(args)
     except AutocyclerError as e:
         print(f"\nError: {e}", file=sys.stderr)
         return 1
-    return 0
+    return int(rc) if rc else 0
 
 
 if __name__ == "__main__":

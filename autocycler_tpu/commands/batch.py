@@ -1,5 +1,5 @@
 """`autocycler batch`: many isolates through compress + cluster distances in
-one mesh-batched device step.
+one mesh-batched device step, with per-isolate fault isolation and resume.
 
 This subcommand is greenfield (the reference processes one isolate per
 invocation; SURVEY.md §2.4 lists multi-chip batching as this port's design
@@ -15,6 +15,15 @@ directory is ready for `trim`/`resolve`.
 The distances are bit-identical to what `autocycler cluster` computes per
 isolate (integer intersection matmul + the same float division), which is
 asserted by tests/test_parallel.py on a 96-isolate CPU mesh.
+
+Fault isolation (utils.resilience): a malformed isolate — corrupt FASTA,
+too many contigs, an unreadable cluster GFA — is quarantined, recorded in
+``<out_parent>/batch_manifest.json`` (per-isolate status, error, attempt
+count) and skipped; the batch completes the rest. The exit status reflects
+partial failure (2), and ``--resume`` replays only failed/pending isolates
+from the manifest. This mirrors the reference's per-assembler tolerance
+(helper.rs:645-654) one level up: some of N isolates failing must not cost
+the other N-1 their multi-hour run.
 """
 
 from __future__ import annotations
@@ -29,12 +38,15 @@ from ..ops.distance import intersections_to_distances, membership_matrix
 from ..ops.graph_build import build_unitig_graph
 from ..parallel.batch import batched_membership_intersections
 from ..parallel.mesh import make_mesh
-from ..utils import log, quit_with_error
+from ..utils import AutocyclerError, log, quit_with_error
+from ..utils.resilience import RunManifest, collect_errors
 from .cluster import cluster as run_cluster
 from .combine import combine
 from .compress import load_sequences
 from .resolve import resolve
 from .trim import trim
+
+MANIFEST_NAME = "batch_manifest.json"
 
 
 def find_isolate_dirs(parent) -> List[Path]:
@@ -48,53 +60,92 @@ def find_isolate_dirs(parent) -> List[Path]:
 
 
 def batch(assemblies_parent, out_parent, k_size: int = 51,
-          max_contigs: int = 25) -> None:
+          max_contigs: int = 25, resume: bool = False) -> int:
     """Compress every isolate and emit per-isolate clustering from one
-    batched device distance step."""
+    batched device distance step. Per-isolate failures are quarantined into
+    the run manifest; returns the process exit code (0 = all complete,
+    2 = partial failure; all-failed raises)."""
     if k_size < 11 or k_size > 501 or k_size % 2 == 0:
         quit_with_error("--kmer must be an odd number between 11 and 501")
     log.section_header("Starting autocycler batch")
     log.explanation("Each isolate subdirectory is compressed into a unitig graph; the "
                     "exact all-vs-all contig distance matrices of ALL isolates are then "
-                    "computed in a single sharded device step and clustered per isolate.")
+                    "computed in a single sharded device step and clustered per isolate. "
+                    "A malformed isolate is quarantined and recorded in the run "
+                    "manifest; the batch completes the rest.")
     isolates = find_isolate_dirs(assemblies_parent)
     out_parent = Path(out_parent)
     os.makedirs(out_parent, exist_ok=True)
+    manifest_path = out_parent / MANIFEST_NAME
+    manifest = RunManifest.load(manifest_path) if resume \
+        else RunManifest(manifest_path)
 
-    seq_lists, Ms, ws = [], [], []
+    todo = []
     for iso in isolates:
+        if resume and manifest.status(iso.name) == "done":
+            log.message(f"{iso.name}: already complete — skipped (--resume)")
+            continue
+        manifest.pending(iso.name)
+        todo.append(iso)
+    if not todo:
+        log.message("All isolates already complete; nothing to do")
+        return 0
+    errs = collect_errors()
+
+    # ---- per-isolate compress (quarantined) ----
+    compressed = []   # (iso, (sequences, ids), M, w)
+    for iso in todo:
+        manifest.start(iso.name)
         log.message(f"Compressing isolate {iso.name}")
-        from ..metrics import InputAssemblyMetrics
-        sequences, _ = load_sequences(iso, k_size, InputAssemblyMetrics(),
-                                      max_contigs)
-        graph = build_unitig_graph(sequences, k_size)
-        simplify_structure(graph, sequences)
-        out_dir = out_parent / iso.name
-        os.makedirs(out_dir, exist_ok=True)
-        graph.save_gfa(out_dir / "input_assemblies.gfa", sequences)
-        M, w, ids = membership_matrix(graph, sequences)
-        seq_lists.append((sequences, ids))
-        Ms.append(M)
-        ws.append(w)
-        del graph
-        # the CLI disables the cycle collector; each isolate's graph is
-        # reference-cyclic, so reclaim it explicitly or RSS grows by one
-        # full graph per isolate
-        gc.collect()
+        with errs.quarantine(iso.name):
+            from ..metrics import InputAssemblyMetrics
+            sequences, _ = load_sequences(iso, k_size, InputAssemblyMetrics(),
+                                          max_contigs)
+            graph = build_unitig_graph(sequences, k_size)
+            simplify_structure(graph, sequences)
+            out_dir = out_parent / iso.name
+            os.makedirs(out_dir, exist_ok=True)
+            graph.save_gfa(out_dir / "input_assemblies.gfa", sequences)
+            M, w, ids = membership_matrix(graph, sequences)
+            compressed.append((iso, (sequences, ids), M, w))
+            del graph
+            # the CLI disables the cycle collector; each isolate's graph is
+            # reference-cyclic, so reclaim it explicitly or RSS grows by one
+            # full graph per isolate
+            gc.collect()
+        if errs.failed(iso.name):
+            manifest.fail(iso.name, str(errs.errors[iso.name].cause),
+                          stage="compress")
+        else:
+            manifest.advance(iso.name, "compress")
     log.message()
+    if not compressed:
+        raise AutocyclerError(
+            f"all {len(todo)} isolate(s) failed during compress; "
+            f"see {manifest_path}")
 
     log.section_header("Batched distance step")
     log.explanation("Isolates ride the mesh 'data' axis; the unitig axis is sharded over "
                     "'seq' and contracted with an integer matmul + psum, so every "
                     "isolate's matrix is exactly the single-isolate computation.")
     mesh = make_mesh()
-    inters = batched_membership_intersections(mesh, Ms, ws)
+    inters = batched_membership_intersections(
+        mesh, [c[2] for c in compressed], [c[3] for c in compressed])
 
-    for iso, (sequences, ids), inter in zip(isolates, seq_lists, inters):
-        distances = intersections_to_distances(inter, ids)
-        run_cluster(out_parent / iso.name, max_contigs=max_contigs,
-                    precomputed_distances=distances)
-        log.message(f"{iso.name}: {len(sequences)} contigs clustered")
+    # ---- per-isolate clustering (quarantined) ----
+    clustered = []
+    for (iso, (sequences, ids), _, _), inter in zip(compressed, inters):
+        with errs.quarantine(iso.name):
+            distances = intersections_to_distances(inter, ids)
+            run_cluster(out_parent / iso.name, max_contigs=max_contigs,
+                        precomputed_distances=distances)
+            log.message(f"{iso.name}: {len(sequences)} contigs clustered")
+            clustered.append(iso)
+        if errs.failed(iso.name):
+            manifest.fail(iso.name, str(errs.errors[iso.name].cause),
+                          stage="cluster")
+        else:
+            manifest.advance(iso.name, "cluster")
 
     log.section_header("Batched trim screen")
     log.explanation("Every isolate's trim overlap DPs (start-end + both hairpin "
@@ -104,13 +155,27 @@ def batch(assemblies_parent, out_parent, k_size: int = 51,
                     "decoded from the device DP's packed traceback bits, so the "
                     "host never re-runs the DP and the final graphs are bitwise "
                     "identical to sequential trim.")
-    cluster_dirs = []
-    for iso in isolates:
+    # per-isolate graph loading is quarantined too: one unreadable cluster
+    # GFA must not sink the whole batched screen
+    from ..models import UnitigGraph
+    iso_cluster_dirs = {}
+    graphs = {}
+    for iso in clustered:
         qc_pass = out_parent / iso.name / "clustering" / "qc_pass"
-        if qc_pass.is_dir():
-            cluster_dirs.extend(sorted(d for d in qc_pass.iterdir()
-                                       if d.is_dir()))
-    screens, graphs = _batched_trim_screens(cluster_dirs, mesh=mesh)
+        dirs = sorted(d for d in qc_pass.iterdir() if d.is_dir()) \
+            if qc_pass.is_dir() else []
+        with errs.quarantine(iso.name):
+            for cdir in dirs:
+                graphs[cdir] = UnitigGraph.from_gfa_file(cdir / "1_untrimmed.gfa")
+        if errs.failed(iso.name):
+            manifest.fail(iso.name, str(errs.errors[iso.name].cause),
+                          stage="trim")
+            for cdir in dirs:
+                graphs.pop(cdir, None)
+        else:
+            iso_cluster_dirs[iso.name] = dirs
+    cluster_dirs = [d for dirs in iso_cluster_dirs.values() for d in dirs]
+    screens = _batched_trim_screens(cluster_dirs, graphs, mesh=mesh)
     n_all = sum(len(s) for s in screens.values())
     n_dev = sum(isinstance(v, list) for s in screens.values()
                 for v in s.values())
@@ -119,49 +184,72 @@ def batch(assemblies_parent, out_parent, k_size: int = 51,
                 f"the device traceback; {n_host} need the full host DP")
     log.message()
 
-    for cdir in cluster_dirs:
-        trimmed = trim(cdir, dp_screen=screens[cdir], preloaded=graphs.pop(cdir))
-        resolve(cdir, preloaded=trimmed)
-        del trimmed   # the graph is reference-cyclic; drop it before collecting
-        gc.collect()
-    for iso in isolates:
-        qc_pass = out_parent / iso.name / "clustering" / "qc_pass"
-        finals = sorted(qc_pass.glob("cluster_*/5_final.gfa")) \
-            if qc_pass.is_dir() else []
-        if finals:
-            combine(out_parent / iso.name, finals)
+    # ---- per-isolate trim + resolve + combine (quarantined) ----
+    completed = []
+    for iso in clustered:
+        if iso.name not in iso_cluster_dirs:
+            continue
+        with errs.quarantine(iso.name):
+            for cdir in iso_cluster_dirs[iso.name]:
+                trimmed = trim(cdir, dp_screen=screens[cdir],
+                               preloaded=graphs.pop(cdir))
+                resolve(cdir, preloaded=trimmed)
+                del trimmed   # reference-cyclic; drop before collecting
+                gc.collect()
+            qc_pass = out_parent / iso.name / "clustering" / "qc_pass"
+            finals = sorted(qc_pass.glob("cluster_*/5_final.gfa")) \
+                if qc_pass.is_dir() else []
+            if finals:
+                combine(out_parent / iso.name, finals)
+        if errs.failed(iso.name):
+            manifest.fail(iso.name, str(errs.errors[iso.name].cause),
+                          stage="finalise")
+        else:
+            manifest.done(iso.name)
+            completed.append(iso.name)
 
     log.section_header("Finished!")
+    n_failed = len(errs)
+    log.message(f"{len(completed)} isolate(s) complete, {n_failed} failed "
+                f"(statuses recorded in {manifest_path})")
+    if n_failed:
+        for name in sorted(errs.errors):
+            log.message(f"  FAILED {name}: {errs.errors[name].cause}")
+        log.message("Re-run with --resume to retry only the failed isolates.")
     log.message(f"Per-isolate outputs: {out_parent}/<isolate>/clustering/ "
                 f"+ consensus_assembly.gfa/.fasta")
     log.message()
+    if not completed:
+        raise AutocyclerError(
+            f"all {len(todo)} isolate(s) failed; see {manifest_path}")
+    return 2 if n_failed else 0
 
 
-def _batched_trim_screens(cluster_dirs, max_unitigs: int = 5000, mesh=None,
-                          min_identity: float = 0.75):
+def _batched_trim_screens(cluster_dirs, graphs, max_unitigs: int = 5000,
+                          mesh=None, min_identity: float = 0.75):
     """One batched screen call covering every (sequence, trim kind) of every
     cluster, then ONE device traceback pass for the screened-positive jobs;
-    returns {cluster_dir: {(seq_id, kind): False | alignment pieces}}. With
-    a mesh the screen shards over every device
-    (parallel.batch.sharded_overlap_screen). Job construction mirrors
-    trim_path_start_end / trim_path_hairpin_* (trim.rs:288-326): start_end
-    aligns path vs itself off-diagonal, hairpin_start aligns path vs its
-    signed reverse, hairpin_end the mirror. Screened-positive jobs get their
-    full alignment decoded from the device DP's packed direction bits
-    (ops.align.overlap_tracebacks_batch) — the host never re-runs the DP;
-    jobs outside the int32 traceback domain stay True (host DP in trim)."""
+    returns {cluster_dir: {(seq_id, kind): False | alignment pieces}}.
+    ``graphs`` maps each cluster dir to its preloaded (graph, sequences) —
+    loading happens in `batch` under per-isolate quarantine, so an
+    unreadable GFA skips one isolate, not the screen. With a mesh the
+    screen shards over every device (parallel.batch.sharded_overlap_screen).
+    Job construction mirrors trim_path_start_end / trim_path_hairpin_*
+    (trim.rs:288-326): start_end aligns path vs itself off-diagonal,
+    hairpin_start aligns path vs its signed reverse, hairpin_end the
+    mirror. Screened-positive jobs get their full alignment decoded from
+    the device DP's packed direction bits (ops.align.overlap_tracebacks_batch)
+    — the host never re-runs the DP; jobs outside the int32 traceback
+    domain stay True (host DP in trim)."""
     import numpy as np
 
-    from ..models import UnitigGraph
     from ..ops.align import overlap_positive_batch, overlap_tracebacks_batch
     from ..parallel.batch import sharded_overlap_screen
     from ..utils import reverse_signed_path
 
     jobs, keys = [], []
-    graphs = {}
     for cdir in cluster_dirs:
-        graph, sequences = UnitigGraph.from_gfa_file(cdir / "1_untrimmed.gfa")
-        graphs[cdir] = (graph, sequences)
+        graph, sequences = graphs[cdir]
         max_num = max((u.number for u in graph.unitigs), default=0)
         weights = np.zeros(max_num + 1, dtype=np.int64)
         for u in graph.unitigs:
@@ -189,4 +277,4 @@ def _batched_trim_screens(cluster_dirs, max_unitigs: int = 5000, mesh=None,
         cdir, seq_id, kind = keys[i]
         if pieces is not None:
             screens[cdir][(seq_id, kind)] = pieces
-    return screens, graphs
+    return screens

@@ -19,13 +19,14 @@ import random
 import re
 import shutil
 import signal
-import subprocess
 import sys
 import tempfile
 from pathlib import Path
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..utils import is_fasta_empty, load_fasta, log, quit_with_error, total_fasta_length
+from ..utils import resilience
+from ..utils.resilience import SubprocessError
 from .subsample import parse_genome_size
 
 READ_TYPES = ("ont_r9", "ont_r10", "pacbio_clr", "pacbio_hifi")
@@ -39,23 +40,27 @@ def check_requirements(programs: List[str]) -> None:
             quit_with_error(f"required program '{cmd}' not found in $PATH")
 
 
-def run_command(cmd: List[str], stdout_file=None, cwd=None) -> None:
+def run_command(cmd: List[str], stdout_file=None, cwd=None, timeout=None,
+                retries=None) -> None:
     """Run a subprocess; failure is printed but NOT fatal
-    (reference helper.rs:645-654)."""
+    (reference helper.rs:645-654).
+
+    Execution goes through the hardened resilience runner
+    (utils.resilience.run_command): per-command timeout and bounded
+    retries with backoff (``--timeout``/``--retries`` flags or the
+    AUTOCYCLER_SUBPROCESS_* env vars), stderr tails captured into the
+    logged :class:`SubprocessError`, and partial stdout files removed on
+    failure so `copy_output_file` never mistakes them for real output."""
     log.message()
     log.message(" ".join(f'"{c}"' if " " in str(c) else str(c) for c in cmd))
     log.message()
-    stdout = open(stdout_file, "w") if stdout_file is not None else None
     try:
-        status = subprocess.run([str(c) for c in cmd], stdout=stdout or None,
-                                stdin=subprocess.DEVNULL, cwd=cwd)
-        if status.returncode != 0:
-            log.message(f"{cmd[0]} failed with status {status.returncode}")
+        resilience.run_command(cmd, stdout_file=stdout_file, cwd=cwd,
+                               timeout=timeout, retries=retries)
+    except SubprocessError as e:
+        log.message(str(e))
     except FileNotFoundError as e:
         quit_with_error(f"failed to launch {cmd[0]}: {e}")
-    finally:
-        if stdout is not None:
-            stdout.close()
 
 
 def add_extension(prefix, extension: str) -> Path:
@@ -613,7 +618,13 @@ def helper(task: str, reads, out_prefix=None, genome_size: Optional[str] = None,
            threads: int = 8, directory=None, read_type: str = "ont_r10",
            min_depth_abs: Optional[float] = None,
            min_depth_rel: Optional[float] = None,
-           extra_args: Optional[List[str]] = None) -> None:
+           extra_args: Optional[List[str]] = None,
+           timeout: Optional[float] = None,
+           retries: Optional[int] = None) -> None:
+    if timeout is not None or retries is not None:
+        # CLI flags become the process-wide subprocess policy so every
+        # assembler invocation in this run inherits them
+        resilience.set_subprocess_policy(timeout=timeout, retries=retries)
     if task not in TASKS:
         quit_with_error(f"unknown helper task: {task} "
                         f"(choose from {', '.join(sorted(TASKS))})")

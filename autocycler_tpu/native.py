@@ -40,6 +40,9 @@ _lib_lock = threading.Lock()
 
 
 def _build(lib_path: Path) -> bool:
+    from .utils import resilience
+    if resilience.fault_fire("native_build", str(lib_path)) is not None:
+        return False
     src = _NATIVE_DIR / "seqkernel.cpp"
     if not src.is_file():
         return False
@@ -68,7 +71,18 @@ def get_lib() -> Optional[ctypes.CDLL]:
         return _get_lib_locked()
 
 
+def _reset_for_tests() -> None:
+    """Forget the loaded/attempted library so fault-injection tests can walk
+    the load paths again; production code never calls this."""
+    global _lib, _tried
+    with _lib_lock:
+        _lib = None
+        _tried = False
+
+
 def _get_lib_locked() -> Optional[ctypes.CDLL]:
+    from .utils import resilience
+
     global _lib, _tried
     if _lib is not None:
         return _lib
@@ -76,8 +90,16 @@ def _get_lib_locked() -> Optional[ctypes.CDLL]:
         return None
     _tried = True
     lib_path = _lib_path()
+    if resilience.fault_fire("native_load", str(lib_path)) is not None:
+        resilience.record_degrade(
+            "native", "ctypes", "numpy",
+            "fault-injected library load failure")
+        return None
     if (not lib_path.is_file() or _stale(lib_path)) and not _build(lib_path):
         if not lib_path.is_file():
+            resilience.record_degrade(
+                "native", "ctypes", "numpy",
+                f"{lib_path.name} missing and build failed (no compiler?)")
             return None
         if _stale(lib_path):
             # the ABI gate below only catches signature changes; semantic
@@ -93,9 +115,20 @@ def _get_lib_locked() -> Optional[ctypes.CDLL]:
         try:
             lib.sk_abi_version.restype = ctypes.c_int32
             lib.sk_abi_version.argtypes = []
-            abi_ok = lib.sk_abi_version() == ABI_VERSION
+            got_abi = lib.sk_abi_version()
+            abi_ok = got_abi == ABI_VERSION
         except AttributeError:
+            got_abi = None
             abi_ok = False
+        if abi_ok and \
+                resilience.fault_fire("native_abi", str(lib_path)) is not None:
+            got_abi = "fault-injected mismatch"
+            abi_ok = False
+        if not abi_ok:
+            resilience.record_degrade(
+                "native-abi", f"abi-v{ABI_VERSION}", "numpy (ABI-gated kernels)",
+                f"{lib_path.name} reports ABI {got_abi!r}, expected "
+                f"{ABI_VERSION}; versioned kernels fall back to numpy")
         lib._abi_ok = abi_ok
         lib.sk_group_windows.restype = ctypes.c_int64
         lib.sk_group_windows.argtypes = [
@@ -190,11 +223,17 @@ def _get_lib_locked() -> Optional[ctypes.CDLL]:
             lib._has_chain_walk = abi_ok
         _lib = lib
         return lib
-    except OSError:
+    except OSError as e:
+        resilience.record_degrade(
+            "native", "ctypes", "numpy",
+            f"loading {lib_path.name} failed: {e}")
         return None
-    except AttributeError:
+    except AttributeError as e:
         # a pinned AUTOCYCLER_NATIVE_LIB predating even the stable symbol set
         # (sk_group_windows, sk_overlap_dp, ...) — treat as unavailable
+        resilience.record_degrade(
+            "native", "ctypes", "numpy",
+            f"{lib_path.name} predates the stable symbol set ({e})")
         return None
 
 

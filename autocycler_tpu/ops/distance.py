@@ -75,11 +75,17 @@ def warn_backend_unsafe_once(context: str) -> None:
     """One stderr warning per (process, context) when a device feature
     degrades to a host path because jax backend init is not known-safe —
     shared by every call site so the flag, message shape and probe reason
-    can't drift between them."""
+    can't drift between them. The event also lands in the unified backend
+    registry (utils.resilience.degrade_events) so a run's degradations are
+    inspectable in one place."""
     with _PROBE_LOCK:
         if context in _WARNED_UNSAFE:
             return
         _WARNED_UNSAFE.add(context)
+    from ..utils.resilience import record_degrade
+    record_degrade(context, "device", "host",
+                   "jax backend init is not known-safe "
+                   f"({device_probe_report()['reason']})")
     import sys
     print(f"autocycler: {context} requested but jax backend init is not "
           f"known-safe ({device_probe_report()['reason']}); using the host "

@@ -182,7 +182,7 @@ def match_grid(a_words: np.ndarray, b_words: np.ndarray,
     b_pad = _pad_to(b_words, tile_b, -2)
     return _grid_call(jnp.asarray(a_pad), jnp.asarray(b_pad), n_a, n_b,
                       tile_a, tile_b,
-                      interpret=jax.default_backend() != "tpu")
+                      interpret=_interpret_fallback())
 
 
 TILE_MXU = 1024
@@ -288,12 +288,25 @@ def _mxu_jit():
                                     "in_dtype", "interpret"))
 
 
-def _mxu_run(a_pad, b_pad, k, n_a, n_b, tile_a, tile_b, in_dtype):
+def _interpret_fallback() -> bool:
+    """Whether the Pallas kernels must run under the interpret-mode
+    simulator (no TPU answers); the Pallas→jnp degrade is recorded once per
+    process through the unified backend registry."""
     import jax
 
+    backend = jax.default_backend()
+    if backend == "tpu":
+        return False
+    from ..utils.resilience import record_degrade
+    record_degrade("pallas-match-grid", "pallas-tpu", "jnp-interpret",
+                   f"jax default backend is {backend!r}, not 'tpu'")
+    return True
+
+
+def _mxu_run(a_pad, b_pad, k, n_a, n_b, tile_a, tile_b, in_dtype):
     return _mxu_jit()(a_pad, b_pad, k=k, n_a=n_a, n_b=n_b,
                       tile_a=tile_a, tile_b=tile_b, in_dtype=in_dtype,
-                      interpret=jax.default_backend() != "tpu")
+                      interpret=_interpret_fallback())
 
 
 def _mxu_run_impl(a_pad, b_pad, *, k, n_a, n_b, tile_a, tile_b, in_dtype,

@@ -38,16 +38,42 @@ def encode_batch(seq_strings: List[List[str]], length: Optional[int] = None) -> 
     """[isolate][assembly] sequence strings -> [B, S, L] uint8 code batch,
     zero-padded (code 0 = '.', which never matches a real k-mer hash
     bucket-for-bucket since dot windows are masked out)."""
+    from ..utils.resilience import InputError
+    if not seq_strings:
+        raise InputError("encode_batch: no isolates to encode "
+                         "(empty isolate list)")
+    empties = [b for b, iso in enumerate(seq_strings) if not iso]
+    if empties:
+        raise InputError(f"encode_batch: isolate(s) at index "
+                         f"{', '.join(map(str, empties))} have no assemblies")
     B = len(seq_strings)
     S = max(len(iso) for iso in seq_strings)
     if length is None:
         length = max(len(s) for iso in seq_strings for s in iso)
+        if length == 0:
+            raise InputError("encode_batch: all assembly sequences are empty")
     out = np.zeros((B, S, length), dtype=np.uint8)
     for b, iso in enumerate(seq_strings):
         for s, seq in enumerate(iso):
             raw = np.frombuffer(seq[:length].encode(), dtype=np.uint8)
             out[b, s, :len(raw)] = encode_bytes(raw)
     return out
+
+
+def _shard_map():
+    """shard_map graduated from jax.experimental to the jax namespace across
+    releases; probe the stable location and degrade to the experimental one
+    (recorded once in the backend-degradation registry)."""
+    import jax
+    try:
+        return jax.shard_map
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map
+        from ..utils.resilience import record_degrade
+        record_degrade(
+            "shard-map", "jax.shard_map", "jax.experimental.shard_map",
+            f"jax {jax.__version__} predates the stable shard_map API")
+        return shard_map
 
 
 def _kmer_bucket_sketch(codes, k: int, buckets: int):
@@ -139,7 +165,7 @@ def sharded_multi_isolate_step(mesh, codes: np.ndarray, k: int = DEFAULT_K,
     by the seq-axis size. Returns [B, S, S] distances (sharded over 'data').
     """
     import jax
-    from jax import shard_map
+    shard_map = _shard_map()
     from jax.sharding import PartitionSpec as P
 
     body = functools.partial(_sharded_step_body, k=k, buckets=buckets,
@@ -182,7 +208,7 @@ def batched_membership_intersections(mesh, M_list: List[np.ndarray],
     (divide by the diagonal on the host for the asymmetric distances).
     """
     import jax
-    from jax import shard_map
+    shard_map = _shard_map()
     from jax.sharding import PartitionSpec as P
 
     B = len(M_list)
@@ -233,7 +259,7 @@ def sharded_overlap_screen(mesh, jobs, max_unitigs: int) -> np.ndarray:
 
     Returns the bool verdicts for `jobs` (padding rows dropped)."""
     import jax
-    from jax import shard_map
+    shard_map = _shard_map()
     from jax.sharding import PartitionSpec as P
 
     from ..ops.align import overlap_screen_scores, pack_overlap_jobs

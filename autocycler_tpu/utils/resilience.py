@@ -1,0 +1,550 @@
+"""Resilience layer: fault isolation, hardened subprocess execution,
+deterministic fault injection, resume manifests, and the unified
+backend-degradation registry.
+
+Autocycler is a *consensus* pipeline: some of N inputs failing is expected
+(reference helper.rs:645-654 treats assembler failure as non-fatal). This
+module makes that contract first-class and scalable:
+
+- an error taxonomy on top of :class:`AutocyclerError` so callers can tell
+  bad input from a crashed subprocess from a degraded backend, and an
+  :func:`collect_errors` quarantine that turns per-item failures into
+  recorded skips instead of run-fatal aborts (`autocycler batch`);
+- :func:`run_command`, a hardened subprocess runner with per-command
+  timeout, bounded retries with exponential backoff + deterministic
+  jitter, captured stderr tails in the raised :class:`SubprocessError`,
+  and cleanup of partial stdout files;
+- :class:`FaultPlan`, a deterministic fault-injection hook (env var
+  ``AUTOCYCLER_FAULTS`` or :func:`set_fault_plan` from tests) that can
+  force subprocess failures/hangs, corrupt FASTA/GFA reads, native-library
+  load failures, ABI mismatches and rebuild failures — so every degraded
+  path has a test that actually walks it;
+- a backend registry (:func:`record_degrade` / :func:`degrade_events`)
+  that unifies the scattered native→numpy / Pallas→jnp / device→host
+  fallbacks into explicit degrade events, logged exactly once per process
+  per transition;
+- :class:`RunManifest`, the JSON resume manifest `autocycler batch` writes
+  (per-item status / error / attempt count) so a partially-failed run can
+  be replayed with ``--resume`` retrying only failed/pending items.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import random
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .misc import AutocyclerError
+
+# ---------------------------------------------------------------------------
+# Error taxonomy
+# ---------------------------------------------------------------------------
+
+
+class InputError(AutocyclerError):
+    """Malformed or missing user input (corrupt FASTA/GFA, empty isolate,
+    bad flag value)."""
+
+
+class SubprocessError(AutocyclerError):
+    """An external command failed, hung past its timeout, or could not be
+    launched — after any configured retries. Carries the command, the final
+    returncode (None for a timeout kill), the attempt count and the tail of
+    the captured stderr, all of which also appear in str(error) so logs are
+    self-contained."""
+
+    def __init__(self, cmd: List[str], returncode: Optional[int],
+                 attempts: int, stderr_tail: str = "",
+                 reason: str = "nonzero exit"):
+        self.cmd = [str(c) for c in cmd]
+        self.returncode = returncode
+        self.attempts = attempts
+        self.stderr_tail = stderr_tail
+        self.reason = reason
+        status = "timed out" if returncode is None \
+            else f"exited with status {returncode}"
+        text = (f"{self.cmd[0]} {status} after {attempts} "
+                f"attempt{'s' if attempts != 1 else ''} ({reason})")
+        if stderr_tail.strip():
+            text += f"; stderr tail:\n{stderr_tail.rstrip()}"
+        super().__init__(text)
+
+
+class BackendError(AutocyclerError):
+    """A compute backend (native library, device mesh, Pallas kernel) is
+    unavailable or misbehaving and no fallback exists."""
+
+
+class IsolateError(AutocyclerError):
+    """A per-isolate failure inside a multi-isolate batch: quarantined and
+    recorded in the run manifest instead of killing the whole run."""
+
+    def __init__(self, isolate: str, cause: BaseException):
+        self.isolate = isolate
+        self.cause = cause
+        super().__init__(f"isolate {isolate}: {cause}")
+
+
+# ---------------------------------------------------------------------------
+# Per-item fault quarantine
+# ---------------------------------------------------------------------------
+
+
+class ErrorCollector:
+    """Quarantines per-item failures: code inside :meth:`quarantine` that
+    raises an :class:`AutocyclerError` (or OSError — malformed inputs often
+    surface as file errors) records the failure against the item and
+    continues, instead of aborting the run."""
+
+    def __init__(self):
+        self.errors: Dict[str, IsolateError] = {}
+
+    @contextlib.contextmanager
+    def quarantine(self, item: str):
+        try:
+            yield
+        except (AutocyclerError, OSError) as e:
+            from . import log
+            err = IsolateError(item, e)
+            log.message(f"WARNING: {err} — skipping")
+            self.errors[item] = err
+
+    def failed(self, item: str) -> bool:
+        return item in self.errors
+
+    def __len__(self) -> int:
+        return len(self.errors)
+
+
+def collect_errors() -> ErrorCollector:
+    """A fresh quarantine collector (the `collect_errors` context of the
+    resilience design: ``with errs.quarantine(name): ...``)."""
+    return ErrorCollector()
+
+
+# ---------------------------------------------------------------------------
+# Deterministic fault injection
+# ---------------------------------------------------------------------------
+
+# Recognised sites (hooks live at the named call sites):
+#   subprocess    run_command, keyed by argv[0]
+#   fasta         utils.io.load_fasta, keyed by filename
+#   gfa           models.UnitigGraph.from_gfa_file, keyed by filename
+#   native_load   native._get_lib_locked (library load fails)
+#   native_abi    native._get_lib_locked (ABI version mismatch)
+#   native_build  native._build (rebuild fails)
+FAULT_SITES = ("subprocess", "fasta", "gfa", "native_load", "native_abi",
+               "native_build")
+
+
+@dataclass
+class FaultRule:
+    """One injection rule: fire at `site` when `match` is a substring of the
+    hook's key, in `mode` ("fail" or "hang"), at most `times` times
+    (-1 = unlimited)."""
+    site: str
+    match: str = ""
+    mode: str = "fail"
+    times: int = -1
+    fired: int = 0
+
+    def exhausted(self) -> bool:
+        return 0 <= self.times <= self.fired
+
+
+@dataclass
+class FaultPlan:
+    """An ordered set of :class:`FaultRule`. Deterministic by construction:
+    rules fire on exact site/substring matches with bounded counts — no
+    randomness — so an injected failure reproduces identically every run."""
+    rules: List[FaultRule] = field(default_factory=list)
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        """Parse the ``AUTOCYCLER_FAULTS`` spec: comma-separated rules of
+        the form ``site[:match[:mode[:times]]]`` — e.g.
+        ``subprocess:flye:hang:1,fasta:iso_001,native_abi``."""
+        rules = []
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            fields = part.split(":")
+            site = fields[0]
+            if site not in FAULT_SITES:
+                raise InputError(
+                    f"unknown fault-injection site {site!r} in "
+                    f"AUTOCYCLER_FAULTS (choose from {', '.join(FAULT_SITES)})")
+            match = fields[1] if len(fields) > 1 else ""
+            mode = fields[2] if len(fields) > 2 and fields[2] else "fail"
+            if mode not in ("fail", "hang"):
+                raise InputError(f"unknown fault mode {mode!r} "
+                                 "(choose 'fail' or 'hang')")
+            times = int(fields[3]) if len(fields) > 3 and fields[3] else -1
+            rules.append(FaultRule(site, match, mode, times))
+        return cls(rules)
+
+    def fire(self, site: str, key: str = "") -> Optional[FaultRule]:
+        for rule in self.rules:
+            if rule.site == site and not rule.exhausted() \
+                    and rule.match in str(key):
+                rule.fired += 1
+                return rule
+        return None
+
+
+_fault_lock = threading.Lock()
+_fault_plan: Optional[FaultPlan] = None
+_env_plan: Optional[Tuple[str, FaultPlan]] = None  # (spec it was parsed from, plan)
+
+
+def set_fault_plan(plan: Optional[FaultPlan]) -> None:
+    """Install (or clear, with None) an explicit fault plan. Takes
+    precedence over ``AUTOCYCLER_FAULTS``. Test-fixture entry point."""
+    global _fault_plan
+    with _fault_lock:
+        _fault_plan = plan
+
+
+def fault_fire(site: str, key: str = "") -> Optional[FaultRule]:
+    """The hook the instrumented call sites invoke: returns the matching
+    rule (consuming one firing) or None. Cheap when no plan is active."""
+    global _env_plan
+    with _fault_lock:
+        if _fault_plan is not None:
+            return _fault_plan.fire(site, key)
+        spec = os.environ.get("AUTOCYCLER_FAULTS", "")
+        if not spec:
+            _env_plan = None
+            return None
+        if _env_plan is None or _env_plan[0] != spec:
+            _env_plan = (spec, FaultPlan.parse(spec))
+        return _env_plan[1].fire(site, key)
+
+
+# ---------------------------------------------------------------------------
+# Hardened subprocess execution
+# ---------------------------------------------------------------------------
+
+_STDERR_TAIL_BYTES = 2000
+
+# commands fault rules substitute for the real one, so injected failures
+# exercise the genuine subprocess machinery (launch, wait, kill-on-timeout)
+_FAIL_CMD = [sys.executable, "-c",
+             "import sys; sys.stderr.write('autocycler fault injection: "
+             "forced subprocess failure\\n'); sys.exit(3)"]
+_HANG_CMD = [sys.executable, "-c",
+             "import sys, time; sys.stderr.write('autocycler fault "
+             "injection: forced hang\\n'); sys.stderr.flush(); "
+             "time.sleep(600)"]
+
+
+@dataclass
+class SubprocessPolicy:
+    """Process-wide defaults for :func:`run_command`, settable from CLI
+    flags (`autocycler helper --timeout/--retries`) or the environment
+    (``AUTOCYCLER_SUBPROCESS_TIMEOUT`` / ``AUTOCYCLER_SUBPROCESS_RETRIES``)."""
+    timeout: Optional[float] = None
+    retries: int = 0
+    backoff: float = 1.0
+
+
+_policy: Optional[SubprocessPolicy] = None
+
+
+def set_subprocess_policy(timeout: Optional[float] = None,
+                          retries: Optional[int] = None,
+                          backoff: Optional[float] = None) -> None:
+    global _policy
+    base = current_policy()
+    _policy = SubprocessPolicy(
+        timeout=timeout if timeout is not None else base.timeout,
+        retries=retries if retries is not None else base.retries,
+        backoff=backoff if backoff is not None else base.backoff)
+
+
+def current_policy() -> SubprocessPolicy:
+    if _policy is not None:
+        return _policy
+    timeout = os.environ.get("AUTOCYCLER_SUBPROCESS_TIMEOUT")
+    retries = os.environ.get("AUTOCYCLER_SUBPROCESS_RETRIES")
+    return SubprocessPolicy(
+        timeout=float(timeout) if timeout else None,
+        retries=int(retries) if retries else 0)
+
+
+def backoff_delay(attempt: int, base: float, key: str = "") -> float:
+    """Exponential backoff with deterministic jitter: base * 2^(attempt-1)
+    * (1 + j), j in [0, 0.25) seeded from (key, attempt) — reproducible
+    across runs, decorrelated across commands."""
+    jitter = random.Random(f"{key}:{attempt}").random() * 0.25
+    return base * (2.0 ** (attempt - 1)) * (1.0 + jitter)
+
+
+def _tail(path: Path) -> str:
+    try:
+        size = path.stat().st_size
+        with open(path, "rb") as f:
+            if size > _STDERR_TAIL_BYTES:
+                f.seek(-_STDERR_TAIL_BYTES, os.SEEK_END)
+            return f.read().decode(errors="replace")
+    except OSError:
+        return ""
+
+
+def run_command(cmd: List[str], stdout_file=None, cwd=None,
+                timeout: Optional[float] = None,
+                retries: Optional[int] = None,
+                backoff: Optional[float] = None,
+                sleep: Callable[[float], None] = time.sleep) -> int:
+    """Run a subprocess with timeout, bounded retries and stderr capture.
+
+    - ``timeout``/``retries``/``backoff`` default to the process policy
+      (:func:`set_subprocess_policy` / env vars); timeout None = unlimited.
+    - stderr is captured to a spool file (disk, not memory — assembler runs
+      are long) and forwarded to our stderr afterwards, so interactive
+      behaviour is preserved up to buffering; the last 2000 bytes ride in
+      the raised :class:`SubprocessError`.
+    - a hung command is killed at the timeout and counts as a failed
+      attempt; retries wait ``backoff_delay`` (exponential + deterministic
+      jitter) between attempts.
+    - a partial/empty ``stdout_file`` is deleted on every failed attempt,
+      so downstream `copy_output_file` can never mistake it for real
+      output.
+    - fault-injection rules at site "subprocess" (keyed by argv[0])
+      substitute a forced-failure or forced-hang command, exercising the
+      real launch/kill machinery.
+
+    Returns 0 on success; raises :class:`SubprocessError` after the final
+    failed attempt. FileNotFoundError (unlaunchable command) propagates —
+    retrying cannot fix a missing binary.
+    """
+    policy = current_policy()
+    timeout = policy.timeout if timeout is None else timeout
+    retries = policy.retries if retries is None else retries
+    backoff = policy.backoff if backoff is None else backoff
+    cmd = [str(c) for c in cmd]
+    attempts = retries + 1
+    last_error: Optional[SubprocessError] = None
+
+    for attempt in range(1, attempts + 1):
+        run_cmd = cmd
+        rule = fault_fire("subprocess", cmd[0])
+        if rule is not None:
+            run_cmd = _HANG_CMD if rule.mode == "hang" else _FAIL_CMD
+        stdout = open(stdout_file, "w") if stdout_file is not None else None
+        stderr_spool = tempfile.NamedTemporaryFile(
+            prefix="autocycler_stderr_", suffix=".log", delete=False)
+        stderr_path = Path(stderr_spool.name)
+        try:
+            try:
+                proc = subprocess.run(run_cmd, stdout=stdout or None,
+                                      stderr=stderr_spool,
+                                      stdin=subprocess.DEVNULL, cwd=cwd,
+                                      timeout=timeout)
+                returncode: Optional[int] = proc.returncode
+                reason = "nonzero exit"
+            except subprocess.TimeoutExpired:
+                returncode = None
+                reason = f"killed after {timeout}s timeout"
+            except FileNotFoundError:
+                # an unlaunchable binary: clean up both spool files, then
+                # propagate — a retry cannot conjure the executable
+                if stdout_file is not None:
+                    with contextlib.suppress(OSError):
+                        os.remove(stdout_file)
+                with contextlib.suppress(OSError):
+                    os.remove(stderr_spool.name)
+                raise
+        finally:
+            if stdout is not None:
+                stdout.close()
+            stderr_spool.close()
+
+        tail = _tail(stderr_path)
+        if tail:
+            sys.stderr.write(tail if tail.endswith("\n") else tail + "\n")
+        try:
+            os.remove(stderr_path)
+        except OSError:
+            pass
+
+        if returncode == 0:
+            return 0
+
+        # failed attempt: never leave a partial stdout file behind
+        # (`copy_output_file` would treat it as real assembler output)
+        if stdout_file is not None:
+            try:
+                os.remove(stdout_file)
+            except OSError:
+                pass
+        last_error = SubprocessError(cmd, returncode, attempt, tail, reason)
+        if attempt < attempts:
+            delay = backoff_delay(attempt, backoff, key=cmd[0])
+            from . import log
+            log.message(f"{cmd[0]} attempt {attempt}/{attempts} failed "
+                        f"({reason}); retrying in {delay:.2f}s")
+            sleep(delay)
+
+    raise last_error
+
+
+# ---------------------------------------------------------------------------
+# Backend degradation registry
+# ---------------------------------------------------------------------------
+
+_degrade_lock = threading.Lock()
+_degrade_events: List[dict] = []
+_degrade_seen: set = set()
+
+
+def record_degrade(chain: str, from_tier: str, to_tier: str,
+                   reason: str) -> bool:
+    """Record (and log to stderr) a backend degradation — e.g.
+    native→numpy or Pallas→interpret. Deduplicated on (chain, from, to):
+    each transition is logged exactly once per process, so an 8-hour batch
+    doesn't bury the signal under a million repeats. Returns True when the
+    event was newly recorded."""
+    key = (chain, from_tier, to_tier)
+    with _degrade_lock:
+        if key in _degrade_seen:
+            return False
+        _degrade_seen.add(key)
+        _degrade_events.append({"chain": chain, "from": from_tier,
+                                "to": to_tier, "reason": reason})
+    print(f"autocycler backend degrade: {chain}: {from_tier} -> {to_tier} "
+          f"({reason})", file=sys.stderr)
+    return True
+
+
+def degrade_events(chain: Optional[str] = None) -> List[dict]:
+    """The degrade events recorded so far (optionally for one chain) — for
+    tests, artifacts and run manifests."""
+    with _degrade_lock:
+        events = list(_degrade_events)
+    if chain is not None:
+        events = [e for e in events if e["chain"] == chain]
+    return events
+
+
+def _reset_degrades_for_tests() -> None:
+    with _degrade_lock:
+        _degrade_events.clear()
+        _degrade_seen.clear()
+
+
+# ---------------------------------------------------------------------------
+# Resume manifests
+# ---------------------------------------------------------------------------
+
+
+class RunManifest:
+    """A JSON manifest of per-item status for a resumable multi-item run
+    (`autocycler batch` writes ``batch_manifest.json``).
+
+    Schema (version 1)::
+
+        {"version": 1,
+         "items": {"<name>": {"status": "pending|running|failed|done",
+                              "stage": "<last stage reached>" | null,
+                              "error": "<message>" | null,
+                              "attempts": <int>}}}
+
+    Every mutation rewrites the file atomically (tmp + rename), so a run
+    killed at any point leaves a loadable manifest; items still "running"
+    at load time are treated as interrupted and eligible for resume."""
+
+    VERSION = 1
+
+    def __init__(self, path):
+        self.path = Path(path)
+        self.items: Dict[str, dict] = {}
+
+    @classmethod
+    def load(cls, path) -> "RunManifest":
+        manifest = cls(path)
+        path = Path(path)
+        if path.is_file():
+            try:
+                data = json.loads(path.read_text())
+            except (OSError, json.JSONDecodeError) as e:
+                raise InputError(f"unreadable run manifest {path}: {e}")
+            if data.get("version") != cls.VERSION:
+                raise InputError(
+                    f"run manifest {path} has unsupported version "
+                    f"{data.get('version')!r} (expected {cls.VERSION})")
+            manifest.items = data.get("items", {})
+        return manifest
+
+    def _entry(self, name: str) -> dict:
+        return self.items.setdefault(
+            name, {"status": "pending", "stage": None, "error": None,
+                   "attempts": 0})
+
+    def status(self, name: str) -> Optional[str]:
+        entry = self.items.get(name)
+        return entry["status"] if entry else None
+
+    def attempts(self, name: str) -> int:
+        entry = self.items.get(name)
+        return entry["attempts"] if entry else 0
+
+    def pending(self, name: str) -> None:
+        self._entry(name)
+        self.save()
+
+    def start(self, name: str) -> None:
+        entry = self._entry(name)
+        entry["status"] = "running"
+        entry["attempts"] += 1
+        entry["error"] = None
+        self.save()
+
+    def advance(self, name: str, stage: str) -> None:
+        self._entry(name)["stage"] = stage
+        self.save()
+
+    def done(self, name: str) -> None:
+        entry = self._entry(name)
+        entry["status"] = "done"
+        entry["error"] = None
+        self.save()
+
+    def fail(self, name: str, error: str, stage: Optional[str] = None) -> None:
+        entry = self._entry(name)
+        entry["status"] = "failed"
+        entry["error"] = str(error)
+        if stage is not None:
+            entry["stage"] = stage
+        self.save()
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for entry in self.items.values():
+            out[entry["status"]] = out.get(entry["status"], 0) + 1
+        return out
+
+    def save(self) -> None:
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        payload = json.dumps({"version": self.VERSION, "items": self.items},
+                             indent=2, sort_keys=True)
+        fd, tmp = tempfile.mkstemp(dir=self.path.parent,
+                                   prefix=self.path.name, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(payload + "\n")
+            os.replace(tmp, self.path)
+        except BaseException:
+            with contextlib.suppress(OSError):
+                os.remove(tmp)
+            raise

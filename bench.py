@@ -592,6 +592,34 @@ def bench_batch() -> None:
     }))
 
 
+def bench_faultsmoke() -> None:
+    """Run the fault-injection resilience suite (-m faultinject) in a pinned
+    CPU subprocess and report pass/fail as one JSON line — the smoke check
+    that every degraded path (subprocess retry/timeout, corrupt inputs,
+    native ABI gates, batch quarantine + resume) still walks."""
+    import os
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/", "-q", "-m", "faultinject"],
+        cwd=Path(__file__).parent, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    elapsed = time.perf_counter() - t0
+    tail = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+    print(json.dumps({
+        "bench": "faultsmoke",
+        "passed": proc.returncode == 0,
+        "exit_status": proc.returncode,
+        "seconds": round(elapsed, 2),
+        "pytest_summary": tail,
+    }))
+    if proc.returncode != 0:
+        print(proc.stdout, file=sys.stderr)
+        sys.exit(1)
+
+
 def main() -> None:
     import os
 
@@ -619,6 +647,8 @@ def main() -> None:
         bench_batch()
     elif len(sys.argv) > 1 and sys.argv[1] == "grouping":
         bench_grouping(float(sys.argv[2]) if len(sys.argv) > 2 else 147.0)
+    elif len(sys.argv) > 1 and sys.argv[1] == "faultsmoke":
+        bench_faultsmoke()
     else:
         bench_headline()
 

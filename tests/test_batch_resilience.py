@@ -1,0 +1,124 @@
+"""End-to-end fault isolation for `autocycler batch`: a corrupt isolate in a
+3-isolate batch is quarantined and recorded in batch_manifest.json, the
+other two isolates complete, the exit status reflects partial failure, and
+--resume replays only the failed isolate."""
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+from synthetic import make_isolate_dirs  # noqa: E402
+
+from autocycler_tpu.utils import AutocyclerError  # noqa: E402
+from autocycler_tpu.utils import resilience as rz  # noqa: E402
+
+pytestmark = pytest.mark.faultinject
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_plan():
+    rz.set_fault_plan(None)
+    yield
+    rz.set_fault_plan(None)
+
+
+def _manifest(out):
+    return json.loads((Path(out) / "batch_manifest.json").read_text())["items"]
+
+
+def _is_complete(out, iso):
+    clustering = Path(out) / iso / "clustering"
+    return (clustering / "clustering.tsv").is_file() and \
+        list(clustering.glob("qc_pass/cluster_*/5_final.gfa")) != []
+
+
+def test_batch_quarantines_corrupt_isolate_and_resumes(tmp_path, monkeypatch):
+    from autocycler_tpu.commands import batch as batch_mod
+
+    parent = make_isolate_dirs(tmp_path / "isolates", 3, seed0=40,
+                               n_assemblies=3, chromosome_len=160,
+                               plasmid_len=70)
+    # corrupt the middle isolate: a FASTA record with no sequence
+    bad = parent / "iso_001" / "assembly_1.fasta"
+    assert bad.is_file()
+    good_bytes = bad.read_bytes()
+    bad.write_text(">broken_record\n")
+
+    out = tmp_path / "out"
+    rc = batch_mod.batch(parent, out, k_size=21)
+    assert rc == 2, "partial failure must be visible in the exit status"
+
+    items = _manifest(out)
+    assert items["iso_001"]["status"] == "failed"
+    assert items["iso_001"]["stage"] == "compress"
+    assert items["iso_001"]["attempts"] == 1
+    assert "sequence" in items["iso_001"]["error"]  # load_fasta's diagnosis
+    for iso in ("iso_000", "iso_002"):
+        assert items[iso]["status"] == "done", iso
+        assert items[iso]["attempts"] == 1
+        assert _is_complete(out, iso), iso
+    assert not _is_complete(out, "iso_001")
+
+    # fix the input, resume: only the failed isolate is reprocessed
+    bad.write_bytes(good_bytes)
+    compressed = []
+    real_load = batch_mod.load_sequences
+
+    def spy_load(iso_dir, *a, **k):
+        compressed.append(Path(iso_dir).name)
+        return real_load(iso_dir, *a, **k)
+
+    monkeypatch.setattr(batch_mod, "load_sequences", spy_load)
+    rc = batch_mod.batch(parent, out, k_size=21, resume=True)
+    assert rc == 0
+    assert compressed == ["iso_001"], \
+        "--resume must replay only the failed isolate"
+
+    items = _manifest(out)
+    assert items["iso_001"]["status"] == "done"
+    assert items["iso_001"]["attempts"] == 2
+    assert items["iso_000"]["attempts"] == 1  # untouched by the resume
+    assert _is_complete(out, "iso_001")
+
+    # everything done: a second resume is a no-op
+    rc = batch_mod.batch(parent, out, k_size=21, resume=True)
+    assert rc == 0
+    assert _manifest(out)["iso_001"]["attempts"] == 2
+
+
+def test_batch_all_isolates_failed_raises(tmp_path):
+    from autocycler_tpu.commands.batch import batch
+
+    parent = make_isolate_dirs(tmp_path / "isolates", 2, seed0=60,
+                               n_assemblies=2, chromosome_len=120,
+                               plasmid_len=60)
+    rz.set_fault_plan(rz.FaultPlan.parse("fasta:iso_"))
+    out = tmp_path / "out"
+    with pytest.raises(AutocyclerError, match="failed during compress"):
+        batch(parent, out, k_size=21)
+    items = _manifest(out)
+    assert all(v["status"] == "failed" for v in items.values())
+    assert all("fault injection" in v["error"] for v in items.values())
+
+
+def test_batch_gfa_fault_quarantines_at_trim_stage(tmp_path):
+    """A cluster GFA that fails to load (injected at the gfa site) fails
+    only its isolate, at the trim stage; the rest complete."""
+    from autocycler_tpu.commands.batch import batch
+
+    parent = make_isolate_dirs(tmp_path / "isolates", 2, seed0=80,
+                               n_assemblies=3, chromosome_len=160,
+                               plasmid_len=70)
+    # fire on the first 1_untrimmed.gfa read under iso_000's output tree
+    rz.set_fault_plan(rz.FaultPlan.parse("gfa:iso_000::1"))
+    out = tmp_path / "out"
+    rc = batch(parent, out, k_size=21)
+    assert rc == 2
+    items = _manifest(out)
+    assert items["iso_000"]["status"] == "failed"
+    assert items["iso_000"]["stage"] == "trim"
+    assert items["iso_001"]["status"] == "done"
+    assert _is_complete(out, "iso_001")

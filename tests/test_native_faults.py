@@ -1,0 +1,105 @@
+"""Fault-injected coverage of native.py's degraded paths: library load
+failure, ABI-version mismatch (versioned kernels gated off while the stable
+symbol set keeps working), and the rebuild-failed / stale-binary warning.
+All driven through utils.resilience's deterministic fault plans — no real
+compiler breakage needed."""
+
+import os
+
+import numpy as np
+import pytest
+
+from autocycler_tpu import native
+from autocycler_tpu.utils import resilience as rz
+
+pytestmark = pytest.mark.faultinject
+
+
+@pytest.fixture(autouse=True)
+def _pristine_native():
+    """Each test walks the load path from scratch and leaves the module
+    state clean for whoever runs next."""
+    rz.set_fault_plan(None)
+    rz._reset_degrades_for_tests()
+    native._reset_for_tests()
+    yield
+    rz.set_fault_plan(None)
+    rz._reset_degrades_for_tests()
+    native._reset_for_tests()
+
+
+def _require_native():
+    if native.get_lib() is None:
+        pytest.skip("native library unavailable (no compiler in image)")
+    native._reset_for_tests()
+
+
+def test_fault_injected_load_failure_degrades_to_numpy():
+    rz.set_fault_plan(rz.FaultPlan.parse("native_load"))
+    assert native.get_lib() is None
+    assert not native.available()
+    codes = np.array([1, 2, 3, 4, 1, 2], dtype=np.uint8)
+    starts = np.arange(3, dtype=np.int64)
+    assert native.pack_words_native(codes, starts, 3) is None
+    events = rz.degrade_events("native")
+    assert len(events) == 1
+    assert events[0]["from"] == "ctypes" and events[0]["to"] == "numpy"
+    assert "fault-injected" in events[0]["reason"]
+
+
+def test_fault_injected_abi_mismatch_gates_versioned_kernels():
+    _require_native()
+    rz.set_fault_plan(rz.FaultPlan.parse("native_abi"))
+    lib = native.get_lib()
+    assert lib is not None, "an ABI mismatch must not unload the library"
+    assert lib._abi_ok is False
+    # every versioned feature flag is gated off...
+    for flag in ("_has_occ_index", "_has_gram_begin", "_has_dp_tb",
+                 "_has_collect", "_has_chain_walk"):
+        assert getattr(lib, flag) is False, flag
+    # ...so the gated entry points fall back (return None -> numpy path)
+    assert native.overlap_dp_tb_native(
+        np.zeros(2, dtype=np.int64), np.zeros(2), np.zeros(2, dtype=np.int64),
+        np.zeros(2), 2, 1, False) is None
+    assert native.chain_walk(np.array([-1], dtype=np.int64)) is None
+    # while the stable ABI-v1 symbol set keeps working
+    codes = np.array([1, 2, 3, 4, 1, 2], dtype=np.uint8)
+    starts = np.arange(3, dtype=np.int64)
+    words = native.pack_words_native(codes, starts, 3)
+    assert words is not None and words.shape == (1, 3)
+    # and the degrade event names the mismatch, exactly once
+    events = rz.degrade_events("native-abi")
+    assert len(events) == 1
+    assert events[0]["from"] == f"abi-v{native.ABI_VERSION}"
+    assert "fault-injected mismatch" in events[0]["reason"]
+
+
+def test_stale_binary_with_failed_rebuild_warns_but_loads(capfd):
+    _require_native()
+    lib_path = native._lib_path()
+    src = native._NATIVE_DIR / "seqkernel.cpp"
+    if not (lib_path.is_file() and src.is_file()):
+        pytest.skip("source tree layout required for the stale-binary path")
+    src_times = (src.stat().st_atime, src.stat().st_mtime)
+    try:
+        # make the source newer than the binary, and the rebuild fail
+        os.utime(src, (src_times[0], lib_path.stat().st_mtime + 10))
+        rz.set_fault_plan(rz.FaultPlan.parse("native_build"))
+        lib = native.get_lib()
+        assert lib is not None, "stale binary should still load"
+        err = capfd.readouterr().err
+        assert "STALE" in err and "rebuild" in err
+    finally:
+        os.utime(src, src_times)
+
+
+def test_fault_injected_build_failure_with_missing_lib(tmp_path, monkeypatch):
+    """No binary + rebuild fails -> None + a native->numpy degrade event."""
+    monkeypatch.setenv("AUTOCYCLER_NATIVE_LIB",
+                       str(tmp_path / "libseqkernel.so"))
+    native._reset_for_tests()
+    rz.set_fault_plan(rz.FaultPlan.parse("native_build"))
+    assert native.get_lib() is None
+    events = rz.degrade_events("native")
+    assert len(events) == 1
+    assert "build failed" in events[0]["reason"]

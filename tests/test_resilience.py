@@ -1,0 +1,304 @@
+"""Unit tests for utils.resilience: the error taxonomy, deterministic fault
+injection, hardened run_command (timeout / retry / stderr tail / stdout
+cleanup), the quarantine collector, the resume manifest and the backend
+degradation registry."""
+
+import json
+import os
+import sys
+
+import pytest
+
+from autocycler_tpu.utils import AutocyclerError
+from autocycler_tpu.utils import resilience as rz
+
+pytestmark = pytest.mark.faultinject
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience_state(monkeypatch):
+    monkeypatch.delenv("AUTOCYCLER_FAULTS", raising=False)
+    monkeypatch.delenv("AUTOCYCLER_SUBPROCESS_TIMEOUT", raising=False)
+    monkeypatch.delenv("AUTOCYCLER_SUBPROCESS_RETRIES", raising=False)
+    rz.set_fault_plan(None)
+    rz._policy = None
+    yield
+    rz.set_fault_plan(None)
+    rz._policy = None
+
+
+# ---------------------------------------------------------------------------
+# taxonomy
+# ---------------------------------------------------------------------------
+
+def test_taxonomy_is_rooted_at_autocycler_error():
+    for cls in (rz.InputError, rz.BackendError, rz.SubprocessError,
+                rz.IsolateError):
+        assert issubclass(cls, AutocyclerError)
+
+
+def test_subprocess_error_message_carries_diagnostics():
+    e = rz.SubprocessError(["flye", "-o", "out"], 137, attempts=3,
+                           stderr_tail="boom\nlast line",
+                           reason="nonzero exit")
+    s = str(e)
+    assert "flye" in s and "status 137" in s and "3 attempts" in s
+    assert "last line" in s
+    assert e.returncode == 137 and e.attempts == 3
+    timeout = rz.SubprocessError(["flye"], None, attempts=1,
+                                 reason="killed after 5s timeout")
+    assert "timed out" in str(timeout) and "5s timeout" in str(timeout)
+
+
+def test_isolate_error_wraps_cause():
+    cause = rz.InputError("bad fasta")
+    e = rz.IsolateError("iso_007", cause)
+    assert e.isolate == "iso_007" and e.cause is cause
+    assert "iso_007" in str(e) and "bad fasta" in str(e)
+
+
+# ---------------------------------------------------------------------------
+# fault injection
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_parse_full_spec():
+    plan = rz.FaultPlan.parse("subprocess:flye:hang:1,fasta:iso_001,native_abi")
+    assert [r.site for r in plan.rules] == ["subprocess", "fasta",
+                                           "native_abi"]
+    assert plan.rules[0].mode == "hang" and plan.rules[0].times == 1
+    assert plan.rules[1].match == "iso_001" and plan.rules[1].times == -1
+
+
+def test_fault_plan_parse_rejects_bad_site_and_mode():
+    with pytest.raises(rz.InputError):
+        rz.FaultPlan.parse("frobnicate")
+    with pytest.raises(rz.InputError):
+        rz.FaultPlan.parse("subprocess::explode")
+
+
+def test_fault_fire_matches_substring_and_respects_times():
+    rz.set_fault_plan(rz.FaultPlan.parse("fasta:iso_001::2"))
+    assert rz.fault_fire("fasta", "/data/iso_000/a.fasta") is None
+    assert rz.fault_fire("gfa", "/data/iso_001/a.gfa") is None  # wrong site
+    assert rz.fault_fire("fasta", "/data/iso_001/a.fasta") is not None
+    assert rz.fault_fire("fasta", "/data/iso_001/b.fasta") is not None
+    assert rz.fault_fire("fasta", "/data/iso_001/c.fasta") is None  # spent
+
+
+def test_fault_fire_reads_env_spec(monkeypatch):
+    monkeypatch.setenv("AUTOCYCLER_FAULTS", "gfa:cluster_002")
+    assert rz.fault_fire("gfa", "cluster_001/1_untrimmed.gfa") is None
+    assert rz.fault_fire("gfa", "cluster_002/1_untrimmed.gfa") is not None
+
+
+def test_fasta_and_gfa_hooks_raise_input_error(tmp_path):
+    from autocycler_tpu.models import UnitigGraph
+    from autocycler_tpu.utils.io import load_fasta
+    rz.set_fault_plan(rz.FaultPlan.parse("fasta,gfa"))
+    with pytest.raises(rz.InputError, match="corrupt FASTA"):
+        load_fasta(tmp_path / "x.fasta")
+    with pytest.raises(rz.InputError, match="corrupt GFA"):
+        UnitigGraph.from_gfa_file(tmp_path / "x.gfa")
+
+
+# ---------------------------------------------------------------------------
+# run_command
+# ---------------------------------------------------------------------------
+
+def _py(code):
+    return [sys.executable, "-c", code]
+
+
+def test_run_command_success_writes_stdout_file(tmp_path):
+    out = tmp_path / "out.txt"
+    rc = rz.run_command(_py("print('hello')"), stdout_file=out)
+    assert rc == 0
+    assert out.read_text().strip() == "hello"
+
+
+def test_run_command_failure_removes_partial_stdout_and_tails_stderr(tmp_path):
+    out = tmp_path / "out.txt"
+    cmd = _py("import sys; print('partial'); "
+              "sys.stderr.write('the reason\\n'); sys.exit(9)")
+    with pytest.raises(rz.SubprocessError) as ei:
+        rz.run_command(cmd, stdout_file=out)
+    assert not out.exists(), "partial stdout file must be cleaned up"
+    assert ei.value.returncode == 9 and ei.value.attempts == 1
+    assert "the reason" in ei.value.stderr_tail
+
+
+def test_run_command_retries_with_exponential_backoff():
+    delays = []
+    with pytest.raises(rz.SubprocessError) as ei:
+        rz.run_command(_py("import sys; sys.exit(2)"), retries=2,
+                       backoff=0.01, sleep=delays.append)
+    assert ei.value.attempts == 3
+    assert len(delays) == 2
+    # exponential with deterministic jitter in [0, 25%)
+    assert 0.01 <= delays[0] < 0.0125
+    assert 0.02 <= delays[1] < 0.025
+    # deterministic: same key + attempt = same delay
+    assert delays[0] == rz.backoff_delay(1, 0.01, key=sys.executable)
+
+
+def test_run_command_kills_hung_process_at_timeout_and_retries():
+    delays = []
+    hang = _py("import sys, time; sys.stderr.write('oops\\n'); "
+               "sys.stderr.flush(); time.sleep(30)")
+    with pytest.raises(rz.SubprocessError) as ei:
+        rz.run_command(hang, timeout=0.5, retries=1, backoff=0.01,
+                       sleep=delays.append)
+    e = ei.value
+    assert e.returncode is None and e.attempts == 2
+    assert "timed out" in str(e) and "0.5s timeout" in str(e)
+    assert "oops" in e.stderr_tail
+    assert len(delays) == 1
+
+
+def test_run_command_missing_binary_propagates_and_cleans_up(tmp_path):
+    out = tmp_path / "out.txt"
+    with pytest.raises(FileNotFoundError):
+        rz.run_command(["/no/such/binary-xyz"], stdout_file=out, retries=3)
+    assert not out.exists()
+
+
+def test_run_command_fault_injection_forces_failure_and_hang():
+    rz.set_fault_plan(rz.FaultPlan.parse("subprocess:mycmd:fail:1"))
+    with pytest.raises(rz.SubprocessError) as ei:
+        # argv[0] "mycmd" doesn't exist: proof the injected command ran
+        rz.run_command(["mycmd"])
+    assert ei.value.returncode == 3
+    assert "forced subprocess failure" in ei.value.stderr_tail
+
+    rz.set_fault_plan(rz.FaultPlan.parse("subprocess::hang"))
+    with pytest.raises(rz.SubprocessError) as ei:
+        rz.run_command(["mycmd"], timeout=0.5)
+    assert ei.value.returncode is None and "timed out" in str(ei.value)
+
+
+def test_subprocess_policy_env_and_setter(monkeypatch):
+    monkeypatch.setenv("AUTOCYCLER_SUBPROCESS_TIMEOUT", "12.5")
+    monkeypatch.setenv("AUTOCYCLER_SUBPROCESS_RETRIES", "4")
+    p = rz.current_policy()
+    assert p.timeout == 12.5 and p.retries == 4
+    rz.set_subprocess_policy(timeout=3.0)
+    assert rz.current_policy().timeout == 3.0
+
+
+# ---------------------------------------------------------------------------
+# quarantine collector
+# ---------------------------------------------------------------------------
+
+def test_collect_errors_quarantines_and_continues(capfd):
+    errs = rz.collect_errors()
+    done = []
+    for item in ["a", "b", "c"]:
+        with errs.quarantine(item):
+            if item == "b":
+                raise rz.InputError("b is corrupt")
+            done.append(item)
+    assert done == ["a", "c"]
+    assert errs.failed("b") and not errs.failed("a") and len(errs) == 1
+    assert isinstance(errs.errors["b"], rz.IsolateError)
+    assert "b is corrupt" in capfd.readouterr().err
+
+
+def test_collect_errors_does_not_swallow_programming_errors():
+    errs = rz.collect_errors()
+    with pytest.raises(ZeroDivisionError):
+        with errs.quarantine("x"):
+            1 / 0
+
+
+# ---------------------------------------------------------------------------
+# resume manifest
+# ---------------------------------------------------------------------------
+
+def test_run_manifest_lifecycle_and_round_trip(tmp_path):
+    path = tmp_path / "batch_manifest.json"
+    m = rz.RunManifest(path)
+    m.pending("iso_000")
+    m.start("iso_000")
+    m.advance("iso_000", "compress")
+    m.done("iso_000")
+    m.start("iso_001")
+    m.fail("iso_001", "corrupt FASTA", stage="compress")
+
+    data = json.loads(path.read_text())
+    assert data["version"] == 1
+    assert data["items"]["iso_000"]["status"] == "done"
+    assert data["items"]["iso_001"] == {
+        "status": "failed", "stage": "compress", "error": "corrupt FASTA",
+        "attempts": 1}
+
+    m2 = rz.RunManifest.load(path)
+    assert m2.status("iso_000") == "done"
+    assert m2.status("iso_001") == "failed"
+    assert m2.attempts("iso_001") == 1
+    m2.start("iso_001")          # resume retry
+    assert m2.attempts("iso_001") == 2
+    assert m2.counts() == {"done": 1, "running": 1}
+
+
+def test_run_manifest_load_rejects_garbage_and_wrong_version(tmp_path):
+    bad = tmp_path / "m.json"
+    bad.write_text("{not json")
+    with pytest.raises(rz.InputError):
+        rz.RunManifest.load(bad)
+    bad.write_text(json.dumps({"version": 99, "items": {}}))
+    with pytest.raises(rz.InputError):
+        rz.RunManifest.load(bad)
+
+
+def test_run_manifest_missing_file_is_empty(tmp_path):
+    m = rz.RunManifest.load(tmp_path / "nope.json")
+    assert m.status("anything") is None and m.counts() == {}
+
+
+# ---------------------------------------------------------------------------
+# backend degradation registry
+# ---------------------------------------------------------------------------
+
+def test_record_degrade_logs_exactly_once_per_transition(capfd):
+    rz._reset_degrades_for_tests()
+    try:
+        assert rz.record_degrade("native", "ctypes", "numpy", "no compiler")
+        assert not rz.record_degrade("native", "ctypes", "numpy",
+                                     "no compiler")
+        assert rz.record_degrade("pallas", "tpu", "interpret", "cpu backend")
+        err = capfd.readouterr().err
+        assert err.count("native: ctypes -> numpy") == 1
+        assert err.count("pallas: tpu -> interpret") == 1
+        assert len(rz.degrade_events()) == 2
+        assert rz.degrade_events("native") == [
+            {"chain": "native", "from": "ctypes", "to": "numpy",
+             "reason": "no compiler"}]
+    finally:
+        rz._reset_degrades_for_tests()
+
+
+def test_pallas_interpret_fallback_records_degrade_on_cpu():
+    from autocycler_tpu.ops import dotplot_pallas
+    rz._reset_degrades_for_tests()
+    try:
+        assert dotplot_pallas._interpret_fallback() is True  # tests pin CPU
+        events = rz.degrade_events("pallas-match-grid")
+        assert len(events) == 1
+        assert events[0]["from"] == "pallas-tpu"
+        assert events[0]["to"] == "jnp-interpret"
+        assert "'cpu'" in events[0]["reason"]
+        # second call: same fallback, no second event
+        assert dotplot_pallas._interpret_fallback() is True
+        assert len(rz.degrade_events("pallas-match-grid")) == 1
+    finally:
+        rz._reset_degrades_for_tests()
+
+
+def test_encode_batch_empty_inputs_raise_input_error():
+    from autocycler_tpu.parallel.batch import encode_batch
+    with pytest.raises(rz.InputError, match="empty isolate list"):
+        encode_batch([])
+    with pytest.raises(rz.InputError, match="no assemblies"):
+        encode_batch([["ACGT"], []])
+    with pytest.raises(rz.InputError, match="empty"):
+        encode_batch([[""], [""]])
