@@ -45,6 +45,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--resume", action="store_true",
                    help="replay a previous run from its batch_manifest.json, "
                         "retrying only failed/pending isolates")
+    p.add_argument("-t", "--threads", type=int, default=8)
 
     p = sub.add_parser("clean",
                        help="manual manipulation of the final consensus assembly graph")
@@ -150,7 +151,8 @@ def dispatch(args) -> int:
     if args.command == "batch":
         from .commands.batch import batch
         return batch(args.assemblies_parent, args.out_parent, args.kmer,
-                     args.max_contigs, resume=args.resume)
+                     args.max_contigs, resume=args.resume,
+                     threads=args.threads)
     elif args.command == "clean":
         from .commands.clean import clean
         clean(args.in_gfa, args.out_gfa, args.remove, args.duplicate, args.min_depth)

@@ -60,13 +60,17 @@ def find_isolate_dirs(parent) -> List[Path]:
 
 
 def batch(assemblies_parent, out_parent, k_size: int = 51,
-          max_contigs: int = 25, resume: bool = False) -> int:
+          max_contigs: int = 25, resume: bool = False,
+          threads: int = 1) -> int:
     """Compress every isolate and emit per-isolate clustering from one
     batched device distance step. Per-isolate failures are quarantined into
     the run manifest; returns the process exit code (0 = all complete,
-    2 = partial failure; all-failed raises)."""
+    2 = partial failure; all-failed raises). ``threads`` reaches end-repair
+    and the k-mer grouping of every isolate's compress."""
     if k_size < 11 or k_size > 501 or k_size % 2 == 0:
         quit_with_error("--kmer must be an odd number between 11 and 501")
+    from ..utils import check_threads
+    check_threads(threads)
     log.section_header("Starting autocycler batch")
     log.explanation("Each isolate subdirectory is compressed into a unitig graph; the "
                     "exact all-vs-all contig distance matrices of ALL isolates are then "
@@ -100,8 +104,8 @@ def batch(assemblies_parent, out_parent, k_size: int = 51,
         with errs.quarantine(iso.name):
             from ..metrics import InputAssemblyMetrics
             sequences, _ = load_sequences(iso, k_size, InputAssemblyMetrics(),
-                                          max_contigs)
-            graph = build_unitig_graph(sequences, k_size)
+                                          max_contigs, threads)
+            graph = build_unitig_graph(sequences, k_size, threads=threads)
             simplify_structure(graph, sequences)
             out_dir = out_parent / iso.name
             os.makedirs(out_dir, exist_ok=True)

@@ -61,7 +61,8 @@ def compress(assemblies_dir, autocycler_dir, k_size: int = 51,
                     "compacted De Bruijn graph, a.k.a. a unitig graph.")
     with stage_timer("compress/build_graph"), \
             Spinner("adding k-mers to graph..."):
-        graph = build_unitig_graph(sequences, k_size, use_jax=use_jax)
+        graph = build_unitig_graph(sequences, k_size, use_jax=use_jax,
+                                   threads=threads)
     graph.print_basic_graph_info()
 
     log.section_header("Simplifying unitig graph")

@@ -23,10 +23,10 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..models import UnitigGraph
-from ..ops.encode import encode_bytes
+from ..ops.encode import encode_both_strands
 from ..ops.kmers import group_windows
 from ..utils import (Spinner, find_all_assemblies, load_fasta, log,
-                     quit_with_error, reverse_complement_bytes)
+                     quit_with_error)
 
 # layout constants (reference dotplot.rs:28-41)
 INITIAL_TOP_LEFT_GAP = 0.1
@@ -190,13 +190,17 @@ def _device_match_pair(a_words: np.ndarray, b_words: np.ndarray, tile: int = 204
     return np.concatenate(iis), np.concatenate(jjs)
 
 
-def kmer_match_positions_device(seq_a: np.ndarray, seq_b: np.ndarray, kmer: int
+def kmer_match_positions_device(seq_a: np.ndarray, seq_b: np.ndarray,
+                                kmer: int, enc_a=None, enc_b=None
                                 ) -> Optional[Tuple[np.ndarray, np.ndarray,
                                                     np.ndarray, np.ndarray]]:
     """Device-grid variant of :func:`kmer_match_positions` (same contract and
     identical results). Returns None when inputs contain non-ACGT bytes —
     the 2-bit device packing cannot represent them, so the caller falls back
-    to the host sort-join."""
+    to the host sort-join. ``enc_a``/``enc_b`` are optional precomputed
+    (forward codes, revcomp codes) pairs from encode_both_strands, so
+    create_dotplot's N^2 pair loop encodes each sequence once, not per
+    pair."""
     from ..ops.dotplot_pallas import pack_2bit_words
 
     n_a = len(seq_a) - kmer + 1
@@ -215,11 +219,12 @@ def kmer_match_positions_device(seq_a: np.ndarray, seq_b: np.ndarray, kmer: int
         # reason) instead of blocking the CLI forever
         warn_backend_unsafe_once("device grid mode")
         return None
-    codes_a = encode_bytes(seq_a)
-    codes_b = encode_bytes(seq_b)
+    codes_a, codes_rc = enc_a if enc_a is not None \
+        else encode_both_strands(seq_a)
+    codes_b = (enc_b if enc_b is not None
+               else encode_both_strands(seq_b))[0]
     if (codes_a == 0).any() or (codes_b == 0).any():
         return None
-    codes_rc = encode_bytes(reverse_complement_bytes(seq_a))
     wa = pack_2bit_words(codes_a, kmer)
     wrc = pack_2bit_words(codes_rc, kmer)
     wb = pack_2bit_words(codes_b, kmer)
@@ -229,18 +234,25 @@ def kmer_match_positions_device(seq_a: np.ndarray, seq_b: np.ndarray, kmer: int
     return fwd_i, fwd_j, rev_i, rev_j
 
 
-def kmer_match_positions(seq_a: np.ndarray, seq_b: np.ndarray, kmer: int
+def kmer_match_positions(seq_a: np.ndarray, seq_b: np.ndarray, kmer: int,
+                         enc_a=None, enc_b=None
                          ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """All (i, j) k-mer matches of A-forward vs B and A-reverse vs B, with
     A-reverse positions mapped like the reference (n_a - i - 1,
-    dotplot.rs:433-450). Returns (fwd_i, fwd_j, rev_i, rev_j)."""
-    rc_a = reverse_complement_bytes(seq_a)
+    dotplot.rs:433-450). Returns (fwd_i, fwd_j, rev_i, rev_j).
+    ``enc_a``/``enc_b`` are optional precomputed encode_both_strands pairs;
+    A's reverse strand comes from the arithmetic code-space reverse
+    complement, not a reverse_complement_bytes + re-encode round trip."""
     n_a = len(seq_a) - kmer + 1
     n_b = len(seq_b) - kmer + 1
     if n_a <= 0 or n_b <= 0:
         z = np.zeros(0, np.int64)
         return z, z, z, z
-    codes = encode_bytes(np.concatenate([seq_a, rc_a, seq_b]))
+    if enc_a is None:
+        enc_a = encode_both_strands(seq_a)
+    if enc_b is None:
+        enc_b = encode_both_strands(seq_b)
+    codes = np.concatenate([enc_a[0], enc_a[1], enc_b[0]])
     starts = np.concatenate([
         np.arange(n_a, dtype=np.int64),
         len(seq_a) + np.arange(n_a, dtype=np.int64),
@@ -303,16 +315,22 @@ def create_dotplot(seqs, png_filename, res: int, kmer: int,
 
     arr = np.array(img)
     count = 0
-    for name_a, seq_a in seqs:
-        for name_b, seq_b in seqs:
+    # one both-strand encoding per sequence, shared by every pair in the
+    # N^2 loop (each sequence previously re-encoded — forward AND a byte
+    # revcomp round trip — once per pair)
+    encs = [encode_both_strands(seq) for _, seq in seqs]
+    for (name_a, seq_a), enc_a in zip(seqs, encs):
+        for (name_b, seq_b), enc_b in zip(seqs, encs):
             use_device = grid_mode == "device" or (
                 grid_mode == "auto" and DEVICE_GRID_MIN_CELLS is not None and
                 max(0, len(seq_a) - kmer + 1) * max(0, len(seq_b) - kmer + 1)
                 >= DEVICE_GRID_MIN_CELLS)
-            matches = kmer_match_positions_device(seq_a, seq_b, kmer) \
+            matches = kmer_match_positions_device(seq_a, seq_b, kmer,
+                                                  enc_a, enc_b) \
                 if use_device else None
             if matches is None:
-                matches = kmer_match_positions(seq_a, seq_b, kmer)
+                matches = kmer_match_positions(seq_a, seq_b, kmer,
+                                               enc_a, enc_b)
             fwd_i, fwd_j, rev_i, rev_j = matches
             a0, b0 = start_positions[name_a], start_positions[name_b]
             # reverse dots first so forward dots win overlaps, like the

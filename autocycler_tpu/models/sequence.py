@@ -22,18 +22,51 @@ _ACGT = frozenset(b"ACGT")
 
 
 class Sequence:
-    __slots__ = ("id", "forward_seq", "reverse_seq", "filename", "contig_header",
-                 "length", "cluster")
+    __slots__ = ("id", "_forward_seq", "_reverse_seq", "filename",
+                 "contig_header", "length", "cluster", "_strand_codes")
 
     def __init__(self, id: int, forward_seq: np.ndarray, reverse_seq: np.ndarray,
                  filename: str, contig_header: str, length: int, cluster: int = 0):
         self.id = id
+        self._strand_codes = None
         self.forward_seq = forward_seq      # uint8 array, dot-padded (may be empty)
         self.reverse_seq = reverse_seq
         self.filename = filename
         self.contig_header = contig_header
         self.length = length                # unpadded length
         self.cluster = cluster
+
+    # the strand bytes are exposed through properties so reassignment (e.g.
+    # sequence-end repair swapping in repaired strands) invalidates the
+    # cached encoding — a length check would miss same-length rewrites
+    @property
+    def forward_seq(self) -> np.ndarray:
+        return self._forward_seq
+
+    @forward_seq.setter
+    def forward_seq(self, value: np.ndarray) -> None:
+        self._forward_seq = value
+        self._strand_codes = None
+
+    @property
+    def reverse_seq(self) -> np.ndarray:
+        return self._reverse_seq
+
+    @reverse_seq.setter
+    def reverse_seq(self, value: np.ndarray) -> None:
+        self._reverse_seq = value
+        self._strand_codes = None
+
+    def encoded_strands(self):
+        """(forward codes, reverse codes) of the padded strands, encoded at
+        most once per sequence: the reverse strand is the arithmetic
+        code-space reverse complement of the forward encoding (identical to
+        encoding ``reverse_seq``, since reverse_seq is always the byte-space
+        reverse complement of forward_seq)."""
+        if self._strand_codes is None:
+            from ..ops.encode import encode_both_strands
+            self._strand_codes = encode_both_strands(self._forward_seq)
+        return self._strand_codes
 
     @classmethod
     def with_seq(cls, id: int, seq: str, filename: str, contig_header: str,

@@ -138,13 +138,15 @@ def build_chains(index: KmerIndex) -> Chains:
     if U == 0:
         return Chains(np.zeros(0, np.int64), np.zeros(1, np.int64), np.zeros(0, bool))
 
-    next_int = internal_edges(index)
-    from .. import native
-    walked = native.chain_walk(next_int) if native.available() else None
-    if walked is not None:
-        members, chain_off, chain_is_cycle = walked
-    else:
-        members, chain_off, chain_is_cycle = _chains_numpy(next_int)
+    from ..utils.timing import substage
+    with substage("chains"):
+        next_int = internal_edges(index)
+        from .. import native
+        walked = native.chain_walk(next_int) if native.available() else None
+        if walked is not None:
+            members, chain_off, chain_is_cycle = walked
+        else:
+            members, chain_off, chain_is_cycle = _chains_numpy(next_int)
 
     C = len(chain_off) - 1
     sizes = np.diff(chain_off)

@@ -31,6 +31,21 @@ def decode_codes(codes: np.ndarray) -> np.ndarray:
     return _DECODE[codes]
 
 
+# complement in code space: dot->dot, A<->T, C<->G — the table gather beats
+# the arithmetic (5 - c) % 5 form (one lookup, no modulo)
+_COMPLEMENT = np.array([0, 4, 3, 2, 1], dtype=np.uint8)
+
+
 def revcomp_codes(codes: np.ndarray) -> np.ndarray:
     """Reverse complement in code space."""
-    return ((5 - codes[::-1]) % 5).astype(codes.dtype)
+    return _COMPLEMENT[codes[::-1]].astype(codes.dtype, copy=False)
+
+
+def encode_both_strands(seq: np.ndarray):
+    """(forward codes, reverse-complement codes) of one ASCII strand with a
+    single encode pass: the reverse strand is derived arithmetically in code
+    space instead of round-tripping through reverse_complement_bytes +
+    re-encode. Identical to encoding the byte-space reverse complement —
+    unknown bytes encode to 0 on both routes."""
+    fwd = _ENCODE[seq]
+    return fwd, _COMPLEMENT[fwd[::-1]]

@@ -125,10 +125,13 @@ def unitig_graph_from_chains(index: KmerIndex, chains: Chains) -> UnitigGraph:
 
 
 def build_unitig_graph(sequences: List[Sequence], k: int,
-                       use_jax=None) -> UnitigGraph:
-    """Sequences (padded, end-repaired) -> compacted unitig graph."""
+                       use_jax=None, threads=None) -> UnitigGraph:
+    """Sequences (padded, end-repaired) -> compacted unitig graph.
+    ``threads`` flows into the k-mer grouping (the radix-partitioned
+    parallel path engages above one worker on large inputs); results are
+    bit-identical at every thread count."""
     from ..utils import log
-    index = build_kmer_index(sequences, k, use_jax=use_jax)
+    index = build_kmer_index(sequences, k, use_jax=use_jax, threads=threads)
     log.message(f"Graph contains {index.num_kmers} k-mers")
     log.message()
     chains = build_chains(index)

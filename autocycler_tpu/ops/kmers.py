@@ -38,7 +38,8 @@ SYMS_PER_WORD_DEV = 13
 
 # use_jax accepts True (direct device sort), "bucketed" (fixed-shape,
 # persistently-cacheable device sort), "lsd" (multi-pass 2-operand stable
-# sorts), False, or None (resolve via env)
+# sorts), "radix" (radix-partitioned buckets sharded across the mesh,
+# fixed-shape per-shard sorts), False, or None (resolve via env)
 UseJax = Union[bool, str, None]
 
 
@@ -51,8 +52,9 @@ def _resolve_use_jax(use_jax: UseJax) -> UseJax:
     which at product scale is an effective hang; and with the probe timed
     out — or disabled without a platform pin — even "host" jax use can
     block in the plugin's backend init, so the native default is kept with
-    a stderr note). 'pallas' / 'bucketed' / 'lsd' / 'direct' select a
-    variant explicitly (benchmarks and tests); explicit disable spellings
+    a stderr note). 'pallas' / 'bucketed' / 'lsd' / 'radix' / 'direct'
+    select a variant explicitly (benchmarks and tests); explicit disable
+    spellings
     and '' keep the native/host default. Unrecognised values keep the
     default too, with a stderr note — guessing an operator's intent the
     expensive way ('off' enabling a ~170 s/sort tunnel path) is worse than
@@ -79,6 +81,8 @@ def _resolve_use_jax(use_jax: UseJax) -> UseJax:
         return "bucketed"
     if value == "lsd":
         return "lsd"
+    if value == "radix":
+        return "radix"
     if value == "direct":
         return True
     if value not in ("", "0", "false", "no", "off", "disabled"):
@@ -116,6 +120,259 @@ def _pack_and_rank_numpy(codes: np.ndarray, starts: np.ndarray, k: int):
             new_group[1:] |= w[1:] != w[:-1]
     gid_sorted = np.cumsum(new_group, dtype=np.int64) - 1
     return order, gid_sorted
+
+
+# ---------------------------------------------------------------------------
+# Radix-partitioned parallel grouping (the KMC 2 / Gerbil shape: partition
+# k-mers into disjoint leading-prefix buckets, then group each bucket
+# independently). The leading base-5 radix of a window is a strict prefix of
+# its first packed word, so ascending radix ranges are ascending k-mer
+# ranges: per-bucket lexicographic ranks stitch into global ranks by adding
+# bucket offsets, preserving the exact rank semantics ops.debruijn and
+# ops.graph_build depend on. Buckets group concurrently — the per-bucket
+# work is the native hash kernel (ctypes releases the GIL) or numpy's
+# lexsort (also GIL-free) — and even single-threaded the partition wins:
+# each bucket's hash table stays cache-resident instead of thrashing one
+# giant table (measured ~2x on 12M windows before any thread scaling).
+# ---------------------------------------------------------------------------
+
+RADIX_SYMS = 6          # leading base-5 radix: 5**6 = 15625 keys fit uint16
+
+
+def _resolve_threads(threads) -> int:
+    return 1 if threads is None else max(1, int(threads))
+
+
+def _effective_workers(threads: int) -> int:
+    """Worker count actually worth spawning: more threads than cores only
+    adds contention to the GIL-free numpy/native chunk work. An explicit
+    AUTOCYCLER_GROUPING_EXECUTOR choice disables the core clamp — the
+    operator (or the parity suite, on single-core CI) asked for that
+    executor and gets the requested width."""
+    if os.environ.get("AUTOCYCLER_GROUPING_EXECUTOR", "").strip():
+        return max(1, threads)
+    return max(1, min(threads, os.cpu_count() or 1))
+
+
+def _radix_min_windows() -> int:
+    """Below this window count the radix path's partition overhead outweighs
+    the bucket wins; the single native/numpy call is used instead. Tests
+    (and tiny-machine operators) override via AUTOCYCLER_RADIX_MIN_WINDOWS."""
+    try:
+        return int(os.environ.get("AUTOCYCLER_RADIX_MIN_WINDOWS",
+                                  str(1 << 17)))
+    except ValueError:
+        return 1 << 17
+
+
+def _host_radix_enabled(n: int, k: int, workers: int, partitions) -> bool:
+    """Host dispatch policy: explicit ``partitions`` or
+    AUTOCYCLER_HOST_GROUPING=radix force the radix path; =native/=numpy
+    force the serial backends; otherwise radix engages when more than one
+    worker is usable and the input is large enough to amortise the
+    partition pass."""
+    if k < 1 or n == 0:
+        return False
+    if partitions is not None:
+        return True
+    mode = os.environ.get("AUTOCYCLER_HOST_GROUPING", "").strip().lower()
+    if mode == "radix":
+        return True
+    if mode in ("native", "numpy"):
+        return False
+    return workers > 1 and n >= _radix_min_windows()
+
+
+def _radix_slab(codes: np.ndarray, starts: np.ndarray, k: int,
+                lo: int, hi: int):
+    """Stable key-sort of one contiguous window slab: returns (slab order as
+    GLOBAL window indices, per-key counts). The key is the first
+    min(RADIX_SYMS, k) symbols packed base-5 into uint16 — numpy's stable
+    argsort on uint16 is an O(n) LSD radix sort."""
+    r = min(RADIX_SYMS, k)
+    sl = starts[lo:hi]
+    key = codes[sl].astype(np.uint16)
+    for i in range(1, r):
+        key *= np.uint16(5)
+        key += codes[sl + i]
+    order = np.argsort(key, kind="stable")
+    counts = np.bincount(key, minlength=5 ** r)
+    return order + lo, counts
+
+
+def _radix_partition(codes: np.ndarray, starts: np.ndarray, k: int,
+                     workers: int, n_parts: int):
+    """Stable O(N) partition of windows into at most ``n_parts`` contiguous
+    radix-key ranges with roughly equal window counts.
+
+    Returns (part, offs): ``part`` is a window permutation ordering windows
+    by ascending radix key (original order preserved inside equal keys);
+    chunk c owns ``part[offs[c]:offs[c+1]]``. Chunk boundaries always align
+    with key boundaries, so equal k-mers never straddle chunks — per-chunk
+    group ids stitch to global lexicographic ranks by offset addition.
+
+    Slabs of the window range are key-sorted concurrently; per-chunk output
+    concatenates each slab's key-range segment in slab order, which keeps
+    the global permutation stable (slab s precedes slab s+1 originally).
+    """
+    n = len(starts)
+    r = min(RADIX_SYMS, k)
+    n_keys = 5 ** r
+    n_slabs = max(1, min(workers, n // (1 << 16) or 1))
+    bounds = np.linspace(0, n, n_slabs + 1).astype(np.int64)
+    jobs = [(int(lo), int(hi)) for lo, hi in zip(bounds[:-1], bounds[1:])
+            if hi > lo]
+    if len(jobs) > 1 and workers > 1:
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            slabs = list(pool.map(
+                lambda j: _radix_slab(codes, starts, k, *j), jobs))
+    else:
+        slabs = [_radix_slab(codes, starts, k, *j) for j in jobs]
+
+    counts = np.stack([c for _, c in slabs])          # [S, n_keys]
+    cum_slab = np.cumsum(counts, axis=1)
+    cum_total = np.cumsum(counts.sum(axis=0))
+    n_parts = max(1, min(int(n_parts), n_keys))
+    targets = (np.arange(1, n_parts) * n) // n_parts
+    cut = np.searchsorted(cum_total, targets, side="left")
+    cut = np.unique(np.append(cut, n_keys - 1))       # key index ending each chunk
+
+    part = np.empty(n, np.int64)
+    offs = [0]
+    pos = 0
+    cursor = np.zeros(len(slabs), np.int64)
+    for key_end in cut:
+        for s, (order_s, _) in enumerate(slabs):
+            b = int(cum_slab[s][key_end])
+            seg = order_s[cursor[s]:b]
+            part[pos:pos + len(seg)] = seg
+            pos += len(seg)
+            cursor[s] = b
+        if pos > offs[-1]:                            # drop empty chunks
+            offs.append(pos)
+    return part, np.array(offs, np.int64)
+
+
+def _radix_chunk_job(codes: np.ndarray, chunk_starts: np.ndarray, k: int):
+    """Group one radix bucket: (local grouped order, local gid_sorted,
+    per-group depth, group-start positions in the sorted view). Runs the
+    fused native hash kernel when available (its table stays cache-resident
+    at bucket size), else the numpy lexsort."""
+    from .. import native
+    res = native.group_kmers_full(codes, chunk_starts, k) \
+        if native.available() else None
+    if res is not None:
+        gid_l, o = res
+        gid_sorted = gid_l[o]
+    else:
+        o, gid_sorted = _pack_and_rank_numpy(codes, chunk_starts, k)
+    m = len(chunk_starts)
+    change = np.empty(m, bool)
+    change[0] = True
+    np.not_equal(gid_sorted[1:], gid_sorted[:-1], out=change[1:])
+    gstart = np.flatnonzero(change)
+    depth = np.diff(np.append(gstart, m))
+    return o, gid_sorted, depth, gstart
+
+
+# shared operand for forked process-pool workers (set by _chunk_pool_map
+# immediately before the fork; children inherit it copy-on-write, so the
+# codes buffer is never pickled per chunk)
+_PROC_CODES: Optional[np.ndarray] = None
+
+
+def _radix_chunk_job_proc(args):
+    chunk_starts, k = args
+    return _radix_chunk_job(_PROC_CODES, chunk_starts, k)
+
+
+def _chunk_pool_map(codes: np.ndarray, chunk_starts_list, k: int,
+                    workers: int):
+    """Map _radix_chunk_job over buckets. Default executor is a thread pool
+    (the chunk work — native ctypes calls and numpy sorts — releases the
+    GIL); AUTOCYCLER_GROUPING_EXECUTOR=process switches to a forked process
+    pool for workloads where the GIL still binds."""
+    if workers <= 1 or len(chunk_starts_list) <= 1:
+        return [_radix_chunk_job(codes, cs, k) for cs in chunk_starts_list]
+    mode = os.environ.get("AUTOCYCLER_GROUPING_EXECUTOR", "").strip().lower()
+    if mode == "process":
+        import multiprocessing as mp
+        from concurrent.futures import ProcessPoolExecutor
+        global _PROC_CODES
+        try:
+            ctx = mp.get_context("fork")
+        except ValueError:
+            ctx = None            # no fork on this platform: thread pool below
+        if ctx is not None:
+            _PROC_CODES = codes
+            try:
+                with ProcessPoolExecutor(max_workers=workers,
+                                         mp_context=ctx) as pool:
+                    return list(pool.map(
+                        _radix_chunk_job_proc,
+                        [(cs, k) for cs in chunk_starts_list]))
+            finally:
+                _PROC_CODES = None
+    from concurrent.futures import ThreadPoolExecutor
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        return list(pool.map(lambda cs: _radix_chunk_job(codes, cs, k),
+                             chunk_starts_list))
+
+
+def _radix_rank_stats(codes: np.ndarray, starts: np.ndarray, k: int,
+                      workers: int, partitions=None):
+    """Radix-partitioned grouping with per-group statistics:
+    (gid, order, depth, first_occ) — gid/order exactly as
+    :func:`group_windows_full`, plus per-group occurrence counts and the
+    smallest occurrence index per group, computed bucket-locally (no global
+    O(N) bincount pass)."""
+    from ..utils.timing import substage
+
+    n = len(starts)
+    if partitions is None:
+        partitions = min(256, max(16, workers * 16))
+    with substage("partition"):
+        part, offs = _radix_partition(codes, starts, k, workers,
+                                      max(1, int(partitions)))
+    chunks = [part[offs[c]:offs[c + 1]] for c in range(len(offs) - 1)]
+    with substage("sort"):
+        chunk_starts = [starts[idx] for idx in chunks]
+        results = _chunk_pool_map(codes, chunk_starts, k, workers)
+    with substage("stitch"):
+        order = np.empty(n, np.int64)
+        gid_sorted = np.empty(n, np.int64)
+        depth_parts, first_parts = [], []
+        g_off = 0
+        for c, (idx, (o, g_l, d_l, gs_l)) in enumerate(zip(chunks, results)):
+            lo, hi = offs[c], offs[c + 1]
+            sorted_idx = idx[o]
+            order[lo:hi] = sorted_idx
+            np.add(g_l, g_off, out=gid_sorted[lo:hi])
+            depth_parts.append(d_l)
+            first_parts.append(sorted_idx[gs_l])
+            g_off += len(d_l)
+        depth = np.concatenate(depth_parts) if depth_parts \
+            else np.zeros(0, np.int64)
+        first_occ = np.concatenate(first_parts) if first_parts \
+            else np.zeros(0, np.int64)
+        gid = np.empty(n, np.int64)
+        gid[order] = gid_sorted
+    return gid, order, depth, first_occ
+
+
+def _derive_stats(gid: np.ndarray, order: np.ndarray):
+    """(depth, first_occ) from a (gid, order) pair, for backends that do not
+    produce them bucket-locally."""
+    n = len(order)
+    if n == 0:
+        return np.zeros(0, np.int64), np.zeros(0, np.int64)
+    gid_sorted = gid[order]
+    change = np.empty(n, bool)
+    change[0] = True
+    np.not_equal(gid_sorted[1:], gid_sorted[:-1], out=change[1:])
+    gstart = np.flatnonzero(change)
+    return np.diff(np.append(gstart, n)), order[gstart]
 
 
 def _pack_words_traced(codes_d, starts_d, k: int, real=None):
@@ -330,15 +587,110 @@ def _pack_and_rank_jax_bucketed(codes: np.ndarray, starts: np.ndarray, k: int):
         return np.asarray(order)[:n], np.asarray(gid_sorted)[:n]
 
 
+# floor for the per-row padded bucket of the radix-sharded device path —
+# much smaller than the global _bucket_size floor because each row holds
+# only ~1/P of the windows
+_RADIX_DEVICE_ROW_FLOOR = 1 << 12
+
+
+@functools.lru_cache(maxsize=None)
+def _radix_sharded_rank_fn(rows: int, bucket: int, codes_bucket: int,
+                           kk: int):
+    """One compiled (rows, row-bucket, codes-bucket, k) executable for the
+    radix-sharded device grouping. Each row is one radix bucket, vmapped
+    over the leading axis; when the inputs arrive sharded across the mesh,
+    GSPMD partitions the vmap so every device sorts only its rows. Fixed
+    shapes all around, so the expensive variadic sort compiles once per
+    bucket class into the persistent cache — and each sort operand is
+    ``bucket`` elements instead of the whole window set."""
+    import jax
+    import jax.numpy as jnp
+
+    def run(codes_d, starts_mat, n_real):
+        def one(starts_row, m):
+            real = jnp.arange(bucket) < m
+            return _rank_windows_traced(codes_d, starts_row, kk, real=real)
+
+        return jax.vmap(one)(starts_mat, n_real)
+
+    return jax.jit(run)
+
+
+def _pack_and_rank_jax_radix(codes: np.ndarray, starts: np.ndarray, k: int,
+                             threads=None):
+    """Radix-partitioned device grouping: the same host-side base-5
+    partition as the parallel host path splits windows into equal-count
+    key-aligned buckets; buckets pad to one shared fixed shape, stack to
+    [rows, bucket] and sort per row on device, with the leading axis laid
+    across the mesh (parallel/mesh.shard_leading_axis) when more than one
+    device is attached. Per-bucket (order, gid) results stitch to global
+    lexicographic ranks on the host exactly as in the host radix path."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.mesh import shard_leading_axis
+
+    from ..utils.timing import device_dispatch, substage
+
+    n = len(starts)
+    workers = _effective_workers(_resolve_threads(threads))
+    n_dev = max(1, len(jax.devices()))
+    with substage("partition"):
+        part, offs = _radix_partition(codes, starts, k, workers,
+                                      max(n_dev, 8))
+    C = len(offs) - 1
+    rows = -(-C // n_dev) * n_dev          # pad row count to a device multiple
+    sizes = np.diff(offs)
+    b = _bucket_size(int(sizes.max()) if C else 1,
+                     floor=_RADIX_DEVICE_ROW_FLOOR)
+    cb = _bucket_size(len(codes))
+    starts_mat = np.zeros((rows, b), np.int32)
+    n_real = np.zeros(rows, np.int32)
+    for c in range(C):
+        lo, hi = int(offs[c]), int(offs[c + 1])
+        starts_mat[c, :hi - lo] = starts[part[lo:hi]]
+        n_real[c] = hi - lo
+    pad_codes = np.zeros(cb, codes.dtype)
+    pad_codes[:len(codes)] = codes
+
+    with device_dispatch("k-mer grouping sort (radix-sharded)"), \
+            substage("sort"):
+        codes_d, mat_d, nr_d = shard_leading_axis(
+            jnp.asarray(pad_codes), starts_mat, n_real)
+        orders, gids = _radix_sharded_rank_fn(rows, b, cb, k)(
+            codes_d, mat_d, nr_d)
+        orders = np.asarray(orders)
+        gids = np.asarray(gids)
+
+    with substage("stitch"):
+        order = np.empty(n, np.int64)
+        gid_sorted = np.empty(n, np.int64)
+        g_off = 0
+        for c in range(C):
+            lo, hi = int(offs[c]), int(offs[c + 1])
+            m = hi - lo
+            idx = part[lo:hi]
+            # real windows sort before pad entries, so the first m sorted
+            # positions are exactly the bucket's windows (row-local indices)
+            o_row = orders[c, :m].astype(np.int64)
+            order[lo:hi] = idx[o_row]
+            gid_sorted[lo:hi] = gids[c, :m].astype(np.int64) + g_off
+            g_off += int(gids[c, m - 1]) + 1
+    return order, gid_sorted
+
+
 def group_windows_full(codes: np.ndarray, starts: np.ndarray, k: int,
-                       use_jax: UseJax = None
+                       use_jax: UseJax = None, threads=None,
+                       partitions: Optional[int] = None
                        ) -> Tuple[np.ndarray, np.ndarray]:
     """Group length-k windows of ``codes`` beginning at ``starts``.
 
     Returns (gid, order): ``gid[i]`` is window i's dense group id (group ids
     are lexicographic ranks); ``order`` is the stable permutation grouping
-    windows by gid. Owns ALL backend dispatch: jax opt-in, the fused native
-    kernel, and the numpy lexsort fallback.
+    windows by gid. Owns ALL backend dispatch: jax opt-in, the
+    radix-partitioned parallel host path (``threads`` > 1 on large inputs,
+    or forced via ``partitions`` / AUTOCYCLER_HOST_GROUPING=radix), the
+    fused native kernel, and the numpy lexsort fallback.
     """
     n = len(starts)
     if n == 0:
@@ -354,7 +706,7 @@ def group_windows_full(codes: np.ndarray, starts: np.ndarray, k: int,
     if use_jax == "direct":      # explicit per-shape variadic sort
         use_jax = True
     if isinstance(use_jax, str) and use_jax not in ("bucketed", "lsd",
-                                                    "pallas"):
+                                                    "pallas", "radix"):
         # an explicit unknown mode is a programming error, not an operator
         # typo (those are handled in _resolve_use_jax): falling through to
         # the per-shape variadic sort would silently hit its multi-minute
@@ -368,6 +720,9 @@ def group_windows_full(codes: np.ndarray, starts: np.ndarray, k: int,
                 order, gid_sorted = _pack_and_rank_jax_bucketed(codes, starts, k)
             elif use_jax == "lsd":
                 order, gid_sorted = _pack_and_rank_jax_lsd(codes, starts, k)
+            elif use_jax == "radix":
+                order, gid_sorted = _pack_and_rank_jax_radix(codes, starts, k,
+                                                             threads)
             else:
                 order, gid_sorted = _pack_and_rank_jax(codes, starts, k)
             gid = np.empty(n, np.int64)
@@ -385,9 +740,15 @@ def group_windows_full(codes: np.ndarray, starts: np.ndarray, k: int,
             record_device_failure(what)
             print(f"autocycler: {what}; falling back to host backend",
                   file=sys.stderr)
+    workers = _effective_workers(_resolve_threads(threads))
+    if _host_radix_enabled(n, k, workers, partitions):
+        gid, order, _, _ = _radix_rank_stats(codes, starts, k, workers,
+                                             partitions)
+        return gid, order
     # fused native pack + hash-grouping kernel (O(n) vs the comparison sort)
     from .. import native
-    if native.available():
+    host_mode = os.environ.get("AUTOCYCLER_HOST_GROUPING", "").strip().lower()
+    if host_mode != "numpy" and native.available():
         result = native.group_kmers_full(codes, starts, k)
         if result is not None:
             return result
@@ -398,12 +759,36 @@ def group_windows_full(codes: np.ndarray, starts: np.ndarray, k: int,
 
 
 def group_windows(codes: np.ndarray, starts: np.ndarray, k: int,
-                  use_jax: UseJax = None) -> Tuple[np.ndarray, np.ndarray]:
+                  use_jax: UseJax = None, threads=None,
+                  partitions: Optional[int] = None
+                  ) -> Tuple[np.ndarray, np.ndarray]:
     """(order, gid_sorted) view of :func:`group_windows_full` — ``order`` is
     the stable permutation sorting windows lexicographically and
     ``gid_sorted[i]`` the group id of window ``order[i]``."""
-    gid, order = group_windows_full(codes, starts, k, use_jax)
+    gid, order = group_windows_full(codes, starts, k, use_jax, threads,
+                                    partitions)
     return order, gid[order]
+
+
+def group_windows_stats(codes: np.ndarray, starts: np.ndarray, k: int,
+                        use_jax: UseJax = None, threads=None,
+                        partitions: Optional[int] = None):
+    """:func:`group_windows_full` plus per-group statistics:
+    (gid, order, depth, first_occ) where ``depth[g]`` is group g's
+    occurrence count and ``first_occ[g]`` its smallest window index. The
+    radix path produces the statistics bucket-locally (cache-resident,
+    concurrent); other backends derive them with one O(N) pass — the same
+    cost callers previously paid via a global bincount."""
+    n = len(starts)
+    if n and k > 0:
+        use_jax_r = _resolve_use_jax(use_jax)
+        workers = _effective_workers(_resolve_threads(threads))
+        if not use_jax_r and _host_radix_enabled(n, k, workers, partitions):
+            return _radix_rank_stats(codes, starts, k, workers, partitions)
+    gid, order = group_windows_full(codes, starts, k, use_jax, threads,
+                                    partitions)
+    depth, first_occ = _derive_stats(gid, order)
+    return gid, order, depth, first_occ
 
 
 @dataclass
@@ -551,7 +936,8 @@ def _adjacency(prefix_gid: np.ndarray, suffix_gid: np.ndarray, G: int):
 
 
 def build_kmer_index(sequences, k: int, use_jax: UseJax = None,
-                     use_fused: Optional[bool] = None) -> KmerIndex:
+                     use_fused: Optional[bool] = None,
+                     threads=None) -> KmerIndex:
     """Build the k-mer index from Sequence objects (padded, with bytes).
 
     Parity notes: every k-window of every padded sequence on both strands is
@@ -563,8 +949,10 @@ def build_kmer_index(sequences, k: int, use_jax: UseJax = None,
 
     Backends: the fused native kernel (native/seqkernel.cpp
     sk_occ_index_build, k <= 55) produces every array in one pass and is the
-    default; the numpy/jax grouping pipeline below is the exact fallback and
-    parity oracle (use_fused=False forces it).
+    single-worker default; with ``threads`` > 1 on large inputs the
+    radix-partitioned parallel grouping path takes over (same arrays, built
+    from per-bucket statistics). The numpy/jax grouping pipeline below is
+    the exact fallback and parity oracle (use_fused=False forces it).
     """
     half_k = k // 2
     S = len(sequences)
@@ -599,8 +987,13 @@ def build_kmer_index(sequences, k: int, use_jax: UseJax = None,
     M = int(2 * seq_len.sum())
 
     use_jax = _resolve_use_jax(use_jax)
+    workers = _effective_workers(_resolve_threads(threads))
     if use_fused is None:
-        use_fused = not use_jax
+        # the single fused native pass wins single-threaded; with usable
+        # extra workers on a large input the radix-partitioned grouping
+        # pipeline below beats it (concurrent cache-resident buckets)
+        use_fused = (not use_jax
+                     and not _host_radix_enabled(M, k, workers, None))
     from .. import native
     if use_fused and M and native.available():
         # the kernel translates ASCII -> symbols inline; no encode pass
@@ -625,7 +1018,22 @@ def build_kmer_index(sequences, k: int, use_jax: UseJax = None,
                 out_count=out_count, in_count=in_count, succ=succ,
                 first_pos=first_pos, fwd_gid=fwd_gid)
 
-    codes = encode_bytes(buf)
+    # per-sequence cached both-strand encodings (models.sequence caches the
+    # forward encode + arithmetic code-space revcomp, so repeated index
+    # builds and other consumers never encode the same bytes twice); the
+    # concatenation matches buf's (forward, reverse) per-sequence layout
+    strand_codes = []
+    for s in sequences:
+        enc = getattr(s, "encoded_strands", None)
+        if enc is not None:
+            fwd_c, rev_c = enc()
+        else:               # duck-typed sequence stand-ins in tests
+            fwd_c = encode_bytes(s.forward_seq)
+            rev_c = encode_bytes(s.reverse_seq)
+        strand_codes.append(fwd_c)
+        strand_codes.append(rev_c)
+    codes = np.concatenate(strand_codes) if strand_codes \
+        else encode_bytes(buf)
 
     # byte start of every occurrence window, built per contiguous strand run
     # (avoids materialising seq/strand/pos arrays of size M)
@@ -639,15 +1047,15 @@ def build_kmer_index(sequences, k: int, use_jax: UseJax = None,
     # ---- k-mer grouping ----
     # per-window ids come back in ORIGINAL order (no scatter needed to
     # reconstruct occ_kid); dispatch policy lives in group_windows_full
-    gid, order = group_windows_full(codes, starts, k, use_jax)
+    gid, order, depth, first_occ = group_windows_stats(codes, starts, k,
+                                                       use_jax, threads)
     occ_kid = gid.astype(np.int32)
-    U = int(gid[order[-1]]) + 1 if M else 0
+    U = len(depth)
+    depth = depth.astype(np.int64, copy=False)
     # occurrences grouped by kid; stable grouping keeps occurrence order
     # inside each group ascending
     group_start = np.zeros(U + 1, np.int64)
-    group_start[1:] = np.cumsum(np.bincount(occ_kid, minlength=U))
-    depth = np.diff(group_start).astype(np.int64)
-    first_occ = order[group_start[:-1]] if U else np.zeros(0, np.int64)
+    np.cumsum(depth, out=group_start[1:])
 
     # first-position flag: only the two window-0 occurrences per sequence
     # (forward occ_off[s], reverse occ_off[s] + L) can have pos == 0
@@ -674,14 +1082,17 @@ def build_kmer_index(sequences, k: int, use_jax: UseJax = None,
     # byte offset, the suffix gram one byte later.
     rep_byte = starts[first_occ]
     gram_starts = np.concatenate([rep_byte, rep_byte + 1])
-    gorder, ggid_sorted = group_windows(codes, gram_starts, k - 1, use_jax)
+    gorder, ggid_sorted = group_windows(codes, gram_starts, k - 1, use_jax,
+                                        threads)
     gram_gid = np.zeros(len(gram_starts), np.int64)
     gram_gid[gorder] = ggid_sorted
     G = int(ggid_sorted[-1]) + 1 if len(gram_starts) else 0
     prefix_gid = gram_gid[:U]
     suffix_gid = gram_gid[U:]
 
-    out_count, in_count, succ = _adjacency(prefix_gid, suffix_gid, G)
+    from ..utils.timing import substage
+    with substage("adjacency"):
+        out_count, in_count, succ = _adjacency(prefix_gid, suffix_gid, G)
 
     return KmerIndex(
         k=k, half_k=half_k, buf=buf, seq_ids=seq_ids, seq_len=seq_len,

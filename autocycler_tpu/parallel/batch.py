@@ -139,7 +139,10 @@ def _sharded_step_body(codes, k: int, buckets: int, seq_axis: str):
     import jax.numpy as jnp
     from jax import lax
 
-    n_seq = lax.axis_size(seq_axis)
+    # lax.axis_size is missing on jax 0.4.x; psum of a literal 1 is its
+    # documented equivalent and stays a static Python int
+    n_seq = (lax.axis_size(seq_axis) if hasattr(lax, "axis_size")
+             else lax.psum(1, seq_axis))
     if n_seq > 1:
         # ring halo: shard i receives the first k-1 codes of shard i+1 so
         # windows spanning the shard boundary are complete. The last shard

@@ -98,6 +98,44 @@ def make_mesh(n_devices: Optional[int] = None, seq_parallel: Optional[int] = Non
     return jax.sharding.Mesh(device_array, ("data", "seq"))
 
 
+def shard_leading_axis(replicated, *sharded):
+    """Lay bucket-stacked arrays across the full device mesh.
+
+    ``sharded`` arrays share a leading axis (one row per radix bucket); the
+    leading axis is split over the flattened ('data', 'seq') mesh axes so
+    every device owns rows/devices buckets, and ``replicated`` (the packed
+    codes buffer every bucket gathers from) is copied to all devices. Used
+    by the radix-sharded device grouping (ops.kmers): fixed per-row shapes
+    mean each shard runs the same compiled sort on its own buckets.
+
+    Degrades to a no-op — inputs returned unchanged, jit placing them on
+    the default device — with a single device, a leading axis that does not
+    divide the device count, or any mesh-construction failure (the caller's
+    device path still computes the right answer, just unsharded)."""
+    import jax
+
+    try:
+        devices = _devices_with_deadline()
+        if len(devices) <= 1:
+            return (replicated, *sharded)
+        rows = sharded[0].shape[0]
+        if rows % len(devices):
+            return (replicated, *sharded)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mesh = make_mesh()
+        rep = jax.device_put(replicated, NamedSharding(mesh, PartitionSpec()))
+        out = tuple(
+            jax.device_put(a, NamedSharding(
+                mesh,
+                PartitionSpec(("data", "seq"),
+                              *((None,) * (np.ndim(a) - 1)))))
+            for a in sharded)
+        return (rep, *out)
+    except Exception:  # noqa: BLE001 — sharding is an optimisation only
+        return (replicated, *sharded)
+
+
 def make_multihost_mesh(n_devices: Optional[int] = None,
                         n_hosts: int = 2,
                         seq_parallel: Optional[int] = None):

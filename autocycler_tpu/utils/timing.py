@@ -75,10 +75,61 @@ def device_failures():
         return _device_failures, _device_failure_last
 
 
+# ---- sub-stage accounting ----
+# Hot kernels report where a stage's wall time goes (partition / sort /
+# stitch / adjacency for the k-mer grouping; more as kernels grow). The
+# accumulators are process-wide and cheap enough to run unconditionally, so
+# bench.py can attach a per-stage breakdown to the artifact without env
+# flags, and stage_timer can print the nested split under AUTOCYCLER_TIMINGS.
+_substage_seconds: dict = {}
+_stage_seconds: dict = {}
+
+
+@contextlib.contextmanager
+def substage(name: str):
+    """Times one sub-stage of a hot kernel into the process-wide accumulator
+    (read via :func:`substage_snapshot`); multiple entries accumulate.
+    Thread-safe: concurrent workers each add their own elapsed time."""
+    start = time.perf_counter()
+    try:
+        yield
+    finally:
+        elapsed = time.perf_counter() - start
+        with _device_lock:
+            _substage_seconds[name] = _substage_seconds.get(name, 0.0) + elapsed
+
+
+def substage_snapshot() -> dict:
+    """Copy of the cumulative per-sub-stage seconds so far."""
+    with _device_lock:
+        return dict(_substage_seconds)
+
+
+def substage_deltas(before: dict, digits: int = 3) -> dict:
+    """Non-zero sub-stage seconds accumulated since ``before`` (a snapshot)."""
+    now = substage_snapshot()
+    out = {}
+    for name, total in now.items():
+        delta = total - before.get(name, 0.0)
+        if round(delta, digits) > 0:
+            out[name] = round(delta, digits)
+    return out
+
+
+def stage_seconds() -> dict:
+    """Cumulative wall seconds per stage_timer name (e.g. the bench guard
+    reads 'compress/build_graph' from here after an in-process compress)."""
+    with _device_lock:
+        return dict(_stage_seconds)
+
+
 @contextlib.contextmanager
 def stage_timer(name: str):
     """Times a pipeline stage; reporting is enabled with AUTOCYCLER_TIMINGS=1,
-    device profiling with AUTOCYCLER_PROFILE_DIR."""
+    device profiling with AUTOCYCLER_PROFILE_DIR. Durations (and any
+    sub-stage splits recorded inside the stage) always accumulate into the
+    process-wide tables read by :func:`stage_seconds` /
+    :func:`substage_snapshot`."""
     profile_dir = os.environ.get("AUTOCYCLER_PROFILE_DIR")
     trace = None
     if profile_dir:
@@ -88,6 +139,7 @@ def stage_timer(name: str):
             trace.__enter__()
         except Exception:
             trace = None
+    sub_before = substage_snapshot()
     start = time.perf_counter()
     try:
         yield
@@ -98,5 +150,10 @@ def stage_timer(name: str):
                 trace.__exit__(None, None, None)
             except Exception:
                 pass
+        with _device_lock:
+            _stage_seconds[name] = _stage_seconds.get(name, 0.0) + elapsed
         if os.environ.get("AUTOCYCLER_TIMINGS"):
             log.message(f"[timing] {name}: {format_duration(elapsed)}")
+            for sub, secs in substage_deltas(sub_before).items():
+                log.message(f"[timing] {name} · {sub}: "
+                            f"{format_duration(secs)}")

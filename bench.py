@@ -29,12 +29,25 @@ from pathlib import Path
 TARGET_SECONDS = 60.0
 
 
+def _bench_threads() -> int:
+    """Worker count for the threaded pipeline stages (compress grouping).
+    AUTOCYCLER_BENCH_THREADS overrides; the default 4 matches the ISSUE-3
+    acceptance configuration."""
+    import os
+
+    try:
+        return max(1, int(os.environ.get("AUTOCYCLER_BENCH_THREADS", "4")))
+    except ValueError:
+        return 4
+
+
 def _run_headline_once():
     """One timed pipeline run. Returns (elapsed, stages) where stages maps
-    each pipeline stage to {"seconds", "device_seconds"} — device_seconds is
-    the host-observed time inside device dispatches (utils.timing), so the
-    TPU share of the headline number is part of the artifact (VERDICT r3
-    item 2)."""
+    each pipeline stage to {"seconds", "device_seconds", "substages"} —
+    device_seconds is the host-observed time inside device dispatches
+    (utils.timing), substages the partition/sort/stitch/adjacency/chains
+    split of the stage's hot kernels, so the TPU share AND the hot-loop
+    anatomy of the headline number are part of the artifact."""
     tests_dir = str(Path(__file__).resolve().parent / "tests")
     if tests_dir not in sys.path:
         sys.path.insert(0, tests_dir)
@@ -56,10 +69,15 @@ def _run_headline_once():
     def staged(name, fn, *args, **kwargs):
         t = time.perf_counter()
         d = timing.device_seconds()
+        sub = timing.substage_snapshot()
         result = fn(*args, **kwargs)
-        stages.setdefault(name, {"seconds": 0.0, "device_seconds": 0.0})
+        stages.setdefault(name, {"seconds": 0.0, "device_seconds": 0.0,
+                                 "substages": {}})
         stages[name]["seconds"] += time.perf_counter() - t
         stages[name]["device_seconds"] += timing.device_seconds() - d
+        subs = stages[name]["substages"]
+        for sname, secs in timing.substage_deltas(sub).items():
+            subs[sname] = round(subs.get(sname, 0.0) + secs, 3)
         return result
 
     # The unitig graph is cyclic (next/prev adjacency), so each stage leaves
@@ -72,7 +90,7 @@ def _run_headline_once():
 
     gc.disable()
     t0 = time.perf_counter()
-    staged("compress", compress, asm_dir, out_dir)
+    staged("compress", compress, asm_dir, out_dir, threads=_bench_threads())
     handoff = staged("cluster", cluster, out_dir, collect_handoff=True)
     pass_clusters = sorted(glob.glob(str(out_dir / "clustering/qc_pass/cluster_*")))
     for c in pass_clusters:
@@ -308,6 +326,7 @@ def bench_headline() -> None:
         "value": elapsed,
         "unit": "s",
         "vs_baseline": round(TARGET_SECONDS / elapsed, 3),
+        "threads": _bench_threads(),
         "median_s": elapsed,
         "best_s": runs[0],
         "runs_s": runs,
@@ -420,7 +439,7 @@ def bench_configs() -> None:
         asm = make_assemblies_fast(tmp, n_assemblies=4, chromosome_len=5_000_000,
                                    plasmid_len=100_000, n_snps=100)
         t0 = time.perf_counter()
-        run_compress(asm, tmp / "out")
+        run_compress(asm, tmp / "out", threads=_bench_threads())
         results.append(("compress_4x5Mbp", time.perf_counter() - t0, "s"))
 
         # cluster: pairwise distances on 12 mixed inputs (6 Mbp scale)
@@ -620,6 +639,110 @@ def bench_faultsmoke() -> None:
         sys.exit(1)
 
 
+GUARD_BASELINE_PATH = Path(__file__).resolve().parent / "BENCH_GUARD.json"
+GUARD_TOLERANCE = 1.25
+
+
+def guard_failures(baseline: dict, measured: dict,
+                   tolerance: float = GUARD_TOLERANCE) -> list:
+    """Compare measured wall times against recorded baselines. Returns one
+    human-readable failure string per metric that regressed past
+    ``tolerance`` (or went missing); empty list means the guard passes.
+    Pure function so the comparison math is unit-testable without running
+    the pipeline."""
+    failures = []
+    for metric in sorted(baseline):
+        base = baseline[metric]
+        if not isinstance(base, (int, float)) or base <= 0:
+            continue
+        got = measured.get(metric)
+        if not isinstance(got, (int, float)):
+            failures.append(
+                f"{metric}: no measurement (baseline {base:.2f}s) — "
+                "the guarded stage did not run or did not report")
+            continue
+        if got > base * tolerance:
+            failures.append(
+                f"{metric}: {got:.2f}s vs baseline {base:.2f}s "
+                f"(+{(got / base - 1) * 100:.0f}%, allowed "
+                f"+{(tolerance - 1) * 100:.0f}%)")
+    return failures
+
+
+def _guard_measure() -> dict:
+    """One compress run at the configs scale (4 assemblies x 5 Mbp, k=51),
+    threads from AUTOCYCLER_BENCH_THREADS (default 4). Returns the guarded
+    metrics: total compress wall and the build_graph stage (the k-mer
+    grouping + unitig construction hot path this guard exists to protect)."""
+    import contextlib
+    import gc
+    import os
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent / "tests"))
+    from synthetic import make_assemblies_fast
+
+    from autocycler_tpu.commands.compress import compress as run_compress
+    from autocycler_tpu.utils import timing
+
+    tmp = Path(tempfile.mkdtemp(prefix="autocycler_guard_"))
+    asm = make_assemblies_fast(tmp, n_assemblies=4, chromosome_len=5_000_000,
+                               plasmid_len=100_000, n_snps=100)
+    gc.disable()
+    build0 = timing.stage_seconds().get("compress/build_graph", 0.0)
+    devnull = open(os.devnull, "w")
+    t0 = time.perf_counter()
+    with contextlib.redirect_stderr(devnull):
+        run_compress(asm, tmp / "out", threads=_bench_threads())
+    wall = time.perf_counter() - t0
+    gc.enable()
+    build = timing.stage_seconds().get("compress/build_graph", 0.0) - build0
+    return {
+        "compress_4x5Mbp_s": round(wall, 2),
+        "compress_build_graph_s": round(build, 2),
+    }
+
+
+def bench_guard(argv: list) -> None:
+    """Performance regression guard (`python bench.py guard`): measure the
+    guarded compress metrics and fail non-zero if any regressed more than
+    25% against BENCH_GUARD.json. With `--update` (or when no baseline has
+    been recorded yet) the measurement becomes the new baseline instead."""
+    update = "--update" in argv
+    measured = _guard_measure()
+    if update or not GUARD_BASELINE_PATH.exists():
+        artifact = {
+            "recorded_threads": _bench_threads(),
+            "tolerance": GUARD_TOLERANCE,
+            "metrics": measured,
+        }
+        GUARD_BASELINE_PATH.write_text(json.dumps(artifact, indent=2) + "\n")
+        print(json.dumps({"bench": "guard", "action": "baseline_recorded",
+                          "path": str(GUARD_BASELINE_PATH), **artifact}))
+        return
+    baseline = json.loads(GUARD_BASELINE_PATH.read_text())
+    tolerance = float(baseline.get("tolerance", GUARD_TOLERANCE))
+    failures = guard_failures(baseline.get("metrics", {}), measured,
+                              tolerance)
+    print(json.dumps({
+        "bench": "guard",
+        "passed": not failures,
+        "threads": _bench_threads(),
+        "tolerance": tolerance,
+        "baseline": baseline.get("metrics", {}),
+        "measured": measured,
+        "failures": failures,
+    }))
+    if failures:
+        print("\nPERFORMANCE REGRESSION — `python bench.py guard` failed:",
+              file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        print("If the slowdown is expected (e.g. a deliberate trade-off), "
+              "re-record the baseline with `python bench.py guard --update`.",
+              file=sys.stderr)
+        sys.exit(1)
+
+
 def main() -> None:
     import os
 
@@ -649,6 +772,8 @@ def main() -> None:
         bench_grouping(float(sys.argv[2]) if len(sys.argv) > 2 else 147.0)
     elif len(sys.argv) > 1 and sys.argv[1] == "faultsmoke":
         bench_faultsmoke()
+    elif len(sys.argv) > 1 and sys.argv[1] == "guard":
+        bench_guard(sys.argv[2:])
     else:
         bench_headline()
 

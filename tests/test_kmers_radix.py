@@ -1,0 +1,217 @@
+"""Parity suite for the radix-partitioned parallel k-mer grouping path.
+
+Every variant — numpy lexsort, the radix host path at P=1 and P>1 (thread
+and process executors), the bucketed/lsd device sorts, and the mesh-sharded
+device "radix" mode — must produce bit-identical (gid, order) on random AND
+adversarial inputs, and a threads>1 end-to-end compress must write a
+byte-identical unitig GFA to the single-threaded run.
+"""
+
+import numpy as np
+import pytest
+
+from autocycler_tpu.ops.kmers import (_derive_stats, _radix_partition,
+                                      group_windows, group_windows_full,
+                                      group_windows_stats)
+
+
+def _case(seed, n_codes=3000, n_windows=2500, k=21):
+    rng = np.random.default_rng(seed)
+    codes = rng.integers(0, 5, size=n_codes).astype(np.uint8)
+    starts = rng.integers(0, n_codes - k, size=n_windows).astype(np.int64)
+    return codes, starts, k
+
+
+def _adversarial_cases():
+    """(name, codes, starts, k) triples the radix cut logic has to survive:
+    a single giant equal-key group (uncuttable — all windows share one radix
+    key), a palindromic sequence (every k-mer appears with its mirror), and
+    an input far smaller than the partition count."""
+    cases = []
+    k = 9
+    codes = np.full(500, 3, np.uint8)          # one k-mer, 492 occurrences
+    cases.append(("all_same", codes, np.arange(492, dtype=np.int64), k))
+    half = np.random.default_rng(0).integers(0, 5, size=400).astype(np.uint8)
+    pal = np.concatenate([half, half[::-1]])   # palindrome: mirrored k-mers
+    cases.append(("palindrome", pal, np.arange(len(pal) - k, dtype=np.int64),
+                  k))
+    codes, starts, k = _case(3, n_codes=200, n_windows=11, k=5)
+    cases.append(("tiny_n", codes, starts, k))  # N=11 << partitions
+    return cases
+
+
+def _numpy_oracle(codes, starts, k, monkeypatch):
+    """The pure-numpy lexsort result — the reference every variant must hit
+    bit-for-bit."""
+    monkeypatch.setenv("AUTOCYCLER_HOST_GROUPING", "numpy")
+    try:
+        return group_windows_full(codes, starts, k, use_jax=False)
+    finally:
+        monkeypatch.delenv("AUTOCYCLER_HOST_GROUPING", raising=False)
+
+
+def test_radix_matches_numpy_p1_and_many(monkeypatch):
+    """Radix path at P=1 (degenerate single bucket) and P>1, single worker,
+    against the numpy oracle on random inputs."""
+    for seed in (0, 1, 2):
+        codes, starts, k = _case(seed)
+        exp_gid, exp_order = _numpy_oracle(codes, starts, k, monkeypatch)
+        for partitions in (1, 7, 64):
+            gid, order = group_windows_full(codes, starts, k, use_jax=False,
+                                            threads=1, partitions=partitions)
+            assert (gid == exp_gid).all(), (seed, partitions)
+            assert (order == exp_order).all(), (seed, partitions)
+
+
+def test_radix_matches_numpy_threads(monkeypatch):
+    """threads>1 through the thread pool (executor env bypasses the 1-core
+    clamp so CI with a single CPU still exercises the concurrent path)."""
+    monkeypatch.setenv("AUTOCYCLER_GROUPING_EXECUTOR", "thread")
+    for seed in (4, 5):
+        codes, starts, k = _case(seed)
+        exp_gid, exp_order = _numpy_oracle(codes, starts, k, monkeypatch)
+        monkeypatch.setenv("AUTOCYCLER_GROUPING_EXECUTOR", "thread")
+        gid, order = group_windows_full(codes, starts, k, use_jax=False,
+                                        threads=4, partitions=16)
+        assert (gid == exp_gid).all() and (order == exp_order).all(), seed
+
+
+def test_radix_process_executor(monkeypatch):
+    """The fork-based process pool (AUTOCYCLER_GROUPING_EXECUTOR=process)
+    must return the identical result — codes travel via the pre-fork module
+    global, not pickling."""
+    codes, starts, k = _case(6)
+    exp_gid, exp_order = _numpy_oracle(codes, starts, k, monkeypatch)
+    monkeypatch.setenv("AUTOCYCLER_GROUPING_EXECUTOR", "process")
+    gid, order = group_windows_full(codes, starts, k, use_jax=False,
+                                    threads=2, partitions=8)
+    assert (gid == exp_gid).all() and (order == exp_order).all()
+
+
+def test_radix_adversarial_inputs(monkeypatch):
+    for name, codes, starts, k in _adversarial_cases():
+        exp_gid, exp_order = _numpy_oracle(codes, starts, k, monkeypatch)
+        for partitions, threads in ((1, 1), (32, 1), (32, 3)):
+            if threads > 1:
+                monkeypatch.setenv("AUTOCYCLER_GROUPING_EXECUTOR", "thread")
+            gid, order = group_windows_full(codes, starts, k, use_jax=False,
+                                            threads=threads,
+                                            partitions=partitions)
+            monkeypatch.delenv("AUTOCYCLER_GROUPING_EXECUTOR", raising=False)
+            assert (gid == exp_gid).all(), (name, partitions, threads)
+            assert (order == exp_order).all(), (name, partitions, threads)
+
+
+def test_radix_env_forced(monkeypatch):
+    """AUTOCYCLER_HOST_GROUPING=radix engages the radix path regardless of
+    threads or input size; =native / =numpy disable it."""
+    codes, starts, k = _case(8, n_windows=300)
+    exp_gid, exp_order = _numpy_oracle(codes, starts, k, monkeypatch)
+    monkeypatch.setenv("AUTOCYCLER_HOST_GROUPING", "radix")
+    gid, order = group_windows_full(codes, starts, k, use_jax=False)
+    assert (gid == exp_gid).all() and (order == exp_order).all()
+
+
+def test_radix_vs_device_backends(monkeypatch):
+    """Radix, bucketed and lsd agree bit-for-bit on the same input."""
+    pytest.importorskip("jax")
+    codes, starts, k = _case(9)
+    exp_gid, exp_order = _numpy_oracle(codes, starts, k, monkeypatch)
+    for mode in ("bucketed", "lsd"):
+        gid, order = group_windows_full(codes, starts, k, use_jax=mode)
+        assert (gid == exp_gid).all() and (order == exp_order).all(), mode
+    gid, order = group_windows_full(codes, starts, k, use_jax=False,
+                                    threads=1, partitions=16)
+    assert (gid == exp_gid).all() and (order == exp_order).all()
+
+
+def test_device_radix_mode(monkeypatch, capsys):
+    """use_jax="radix" — host partition, mesh-sharded fixed-shape device
+    sorts, host stitch — must match the oracle and actually RUN on the
+    device path (no fallback note on stderr)."""
+    pytest.importorskip("jax")
+    for seed, n_windows in ((10, 2500), (11, 900)):
+        codes, starts, k = _case(seed, n_windows=n_windows)
+        exp_gid, exp_order = _numpy_oracle(codes, starts, k, monkeypatch)
+        gid, order = group_windows_full(codes, starts, k, use_jax="radix",
+                                        threads=2)
+        assert "falling back" not in capsys.readouterr().err
+        assert (gid == exp_gid).all(), seed
+        assert (order == exp_order).all(), seed
+
+
+def test_group_windows_stats_radix_parity(monkeypatch):
+    """(gid, order, depth, first_occ) from the bucket-local radix statistics
+    must equal the derived-stats oracle, including on adversarial inputs."""
+    cases = [("random", *_case(12))] + _adversarial_cases()
+    for name, codes, starts, k in cases:
+        exp_gid, exp_order = _numpy_oracle(codes, starts, k, monkeypatch)
+        exp_depth, exp_first = _derive_stats(exp_gid, exp_order)
+        # bincount cross-check of the oracle itself
+        assert (exp_depth == np.bincount(exp_gid)).all(), name
+        gid, order, depth, first = group_windows_stats(
+            codes, starts, k, use_jax=False, threads=1, partitions=16)
+        assert (gid == exp_gid).all() and (order == exp_order).all(), name
+        assert (depth == exp_depth).all(), name
+        assert (first == exp_first).all(), name
+
+
+def test_radix_partition_is_exact_partition():
+    """The partition output is a permutation of arange(N) in contiguous
+    chunks, and every chunk's radix-key range precedes the next chunk's
+    (key-aligned cuts — equal k-mers can never straddle a boundary)."""
+    codes, starts, k = _case(13)
+    part, offs = _radix_partition(codes, starts, k, workers=4, n_parts=16)
+    assert (np.sort(part) == np.arange(len(starts))).all()
+    assert offs[0] == 0 and offs[-1] == len(starts)
+    r = min(6, k)
+    key = np.zeros(len(starts), np.int64)
+    for j in range(r):
+        key = key * 5 + codes[starts + j]
+    for lo, hi in zip(offs[:-1], offs[1:]):
+        assert hi > lo                      # no empty chunks emitted
+    chunk_max = [key[part[lo:hi]].max() for lo, hi in zip(offs[:-1], offs[1:])]
+    chunk_min = [key[part[lo:hi]].min() for lo, hi in zip(offs[:-1], offs[1:])]
+    for i in range(len(chunk_max) - 1):
+        assert chunk_max[i] < chunk_min[i + 1]
+
+
+def test_group_windows_view_parity(monkeypatch):
+    """The (order, gid_sorted) view stays consistent between radix and the
+    oracle — callers like end_repair consume this shape."""
+    codes, starts, k = _case(14)
+    monkeypatch.setenv("AUTOCYCLER_HOST_GROUPING", "numpy")
+    exp_order, exp_gid_sorted = group_windows(codes, starts, k, use_jax=False)
+    monkeypatch.setenv("AUTOCYCLER_HOST_GROUPING", "radix")
+    order, gid_sorted = group_windows(codes, starts, k, use_jax=False,
+                                      threads=1)
+    assert (order == exp_order).all() and (gid_sorted == exp_gid_sorted).all()
+
+
+def test_compress_threads_gfa_byte_identical(tmp_path, monkeypatch):
+    """End-to-end: compress with threads>1 (radix path forced onto the tiny
+    input) writes a byte-identical input_assemblies.gfa to threads=1."""
+    import sys
+    from pathlib import Path
+    tests_dir = str(Path(__file__).resolve().parent)
+    if tests_dir not in sys.path:
+        sys.path.insert(0, tests_dir)
+    from synthetic import make_assemblies_fast
+
+    from autocycler_tpu.commands.compress import compress
+
+    gfas = {}
+    for threads in (1, 3):
+        tmp = tmp_path / f"t{threads}"
+        tmp.mkdir()
+        asm = make_assemblies_fast(tmp, n_assemblies=2,
+                                   chromosome_len=30_000, plasmid_len=3_000,
+                                   n_snps=5)
+        if threads > 1:
+            monkeypatch.setenv("AUTOCYCLER_RADIX_MIN_WINDOWS", "0")
+            monkeypatch.setenv("AUTOCYCLER_GROUPING_EXECUTOR", "thread")
+        compress(asm, tmp / "out", threads=threads)
+        monkeypatch.delenv("AUTOCYCLER_RADIX_MIN_WINDOWS", raising=False)
+        monkeypatch.delenv("AUTOCYCLER_GROUPING_EXECUTOR", raising=False)
+        gfas[threads] = (tmp / "out" / "input_assemblies.gfa").read_bytes()
+    assert gfas[1] == gfas[3]

@@ -1,0 +1,102 @@
+"""Unit tests for the performance regression guard (`python bench.py guard`):
+the comparison math is a pure function, so the pass/fail contract is testable
+without running the pipeline. The real measured guard run is the perf-marked
+slow test at the bottom."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from bench import GUARD_TOLERANCE, guard_failures  # noqa: E402
+
+
+def test_guard_passes_within_tolerance():
+    base = {"compress_4x5Mbp_s": 40.0, "compress_build_graph_s": 30.0}
+    ok = {"compress_4x5Mbp_s": 49.9, "compress_build_graph_s": 37.4}
+    assert guard_failures(base, ok) == []
+    # faster is always fine
+    assert guard_failures(base, {"compress_4x5Mbp_s": 1.0,
+                                 "compress_build_graph_s": 1.0}) == []
+
+
+def test_guard_fails_past_tolerance():
+    base = {"compress_4x5Mbp_s": 40.0}
+    fails = guard_failures(base, {"compress_4x5Mbp_s": 50.1})
+    assert len(fails) == 1
+    assert "compress_4x5Mbp_s" in fails[0]
+    assert "50.10s" in fails[0] and "40.00s" in fails[0]
+    # exactly at the boundary passes (strict >)
+    assert guard_failures(base, {"compress_4x5Mbp_s": 40.0 * GUARD_TOLERANCE}
+                          ) == []
+
+
+def test_guard_missing_measurement_fails():
+    base = {"compress_4x5Mbp_s": 40.0}
+    fails = guard_failures(base, {})
+    assert len(fails) == 1 and "no measurement" in fails[0]
+
+
+def test_guard_ignores_non_numeric_baseline_entries():
+    base = {"note": "recorded on ci-host-3", "compress_4x5Mbp_s": 40.0,
+            "zero_metric": 0.0}
+    assert guard_failures(base, {"compress_4x5Mbp_s": 41.0}) == []
+
+
+def test_guard_custom_tolerance():
+    base = {"m": 10.0}
+    assert guard_failures(base, {"m": 14.9}, tolerance=1.5) == []
+    assert len(guard_failures(base, {"m": 15.1}, tolerance=1.5)) == 1
+
+
+def test_guard_reports_all_regressions_sorted():
+    base = {"b_s": 10.0, "a_s": 10.0}
+    fails = guard_failures(base, {"a_s": 20.0, "b_s": 20.0})
+    assert len(fails) == 2
+    assert fails[0].startswith("a_s") and fails[1].startswith("b_s")
+
+
+@pytest.mark.perf
+@pytest.mark.slow
+def test_guard_subcommand_end_to_end(tmp_path, monkeypatch):
+    """`python bench.py guard` records a baseline on first run (exit 0),
+    passes against itself on the second, and fails non-zero with a clear
+    message against a sabotaged baseline."""
+    import os
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", AUTOCYCLER_BENCH_THREADS="2")
+    baseline = REPO / "BENCH_GUARD.json"
+    backup = baseline.read_text() if baseline.exists() else None
+    try:
+        if baseline.exists():
+            baseline.unlink()
+        first = subprocess.run([sys.executable, "bench.py", "guard"],
+                               cwd=REPO, env=env, capture_output=True,
+                               text=True)
+        assert first.returncode == 0, first.stderr
+        assert json.loads(first.stdout.strip().splitlines()[-1])[
+            "action"] == "baseline_recorded"
+        second = subprocess.run([sys.executable, "bench.py", "guard"],
+                                cwd=REPO, env=env, capture_output=True,
+                                text=True)
+        assert second.returncode == 0, second.stderr
+
+        sab = json.loads(baseline.read_text())
+        for m in sab["metrics"]:
+            sab["metrics"][m] = 0.01
+        baseline.write_text(json.dumps(sab))
+        third = subprocess.run([sys.executable, "bench.py", "guard"],
+                               cwd=REPO, env=env, capture_output=True,
+                               text=True)
+        assert third.returncode == 1
+        assert "PERFORMANCE REGRESSION" in third.stderr
+    finally:
+        if backup is not None:
+            baseline.write_text(backup)
+        elif baseline.exists():
+            baseline.unlink()

@@ -13,6 +13,9 @@ residual risk.
 import functools
 
 import jax
+import jax.export  # noqa: F401 — attribute access alone doesn't import the
+                   # submodule on jax 0.4.x, so `jax.export.export` below
+                   # would raise AttributeError without this
 import jax.numpy as jnp
 import pytest
 
