@@ -80,6 +80,8 @@ def batch(assemblies_parent, out_parent, k_size: int = 51,
     isolates = find_isolate_dirs(assemblies_parent)
     out_parent = Path(out_parent)
     os.makedirs(out_parent, exist_ok=True)
+    from ..ops.distance import set_probe_cache_dir
+    set_probe_cache_dir(out_parent / ".cache")
     manifest_path = out_parent / MANIFEST_NAME
     manifest = RunManifest.load(manifest_path) if resume \
         else RunManifest(manifest_path)
@@ -103,8 +105,13 @@ def batch(assemblies_parent, out_parent, k_size: int = 51,
         log.message(f"Compressing isolate {iso.name}")
         with errs.quarantine(iso.name):
             from ..metrics import InputAssemblyMetrics
+            from ..utils.cache import open_cache
+            # warm-start caches live under the isolate's out dir, so a
+            # --resume (or repeat) run skips load+encode+repair for isolates
+            # whose inputs have not changed
             sequences, _ = load_sequences(iso, k_size, InputAssemblyMetrics(),
-                                          max_contigs, threads)
+                                          max_contigs, threads,
+                                          cache=open_cache(out_parent / iso.name))
             graph = build_unitig_graph(sequences, k_size, threads=threads)
             simplify_structure(graph, sequences)
             out_dir = out_parent / iso.name

@@ -12,16 +12,22 @@ from __future__ import annotations
 import os
 import time
 from pathlib import Path
-from typing import List, Tuple
+from typing import List, Optional, Tuple
+
+import numpy as np
 
 from ..metrics import InputAssemblyDetails, InputAssemblyMetrics, InputContigDetails
 from ..models import Sequence, UnitigGraph
+from ..models.sequence import padded_strand
 from ..models.simplify import simplify_structure
 from ..ops.end_repair import sequence_end_repair
 from ..ops.graph_build import build_unitig_graph
 from ..utils import (Spinner, check_threads, find_all_assemblies,
-                     format_duration, load_fasta, log, quit_with_error)
-from ..utils.timing import stage_timer
+                     format_duration, load_fasta, log, quit_with_error,
+                     record_degrade, reverse_complement_bytes)
+from ..utils.cache import EncodeCache, content_hash, open_cache
+from ..utils.pool import pool_map
+from ..utils.timing import stage_timer, substage
 
 MAX_INPUT_SEQUENCES = 32767  # position packing limit (reference compress.rs:112-114)
 
@@ -51,10 +57,13 @@ def compress(assemblies_dir, autocycler_dir, k_size: int = 51,
                     "be used to recover the assemblies (with autocycler decompress) or "
                     "generate a consensus assembly (with autocycler resolve).")
     os.makedirs(autocycler_dir, exist_ok=True)
+    from ..ops.distance import set_probe_cache_dir
+    set_probe_cache_dir(Path(autocycler_dir) / ".cache")
     metrics = InputAssemblyMetrics()
     with stage_timer("compress/load_and_repair"):
-        sequences, assembly_count = load_sequences(assemblies_dir, k_size, metrics,
-                                                   max_contigs, threads)
+        sequences, assembly_count = load_sequences(
+            assemblies_dir, k_size, metrics, max_contigs, threads,
+            cache=open_cache(autocycler_dir))
     log.section_header("Building compacted unitig graph")
     log.explanation("K-mers are grouped with a sort-based device kernel, unitig chains "
                     "are assembled, and all non-branching paths are collapsed to form a "
@@ -87,27 +96,34 @@ def compress(assemblies_dir, autocycler_dir, k_size: int = 51,
 
 
 def load_sequences(assemblies_dir, k_size: int, metrics: InputAssemblyMetrics,
-                   max_contigs: int, threads: int = 1) -> Tuple[List[Sequence], int]:
+                   max_contigs: int, threads: int = 1,
+                   cache: Optional[EncodeCache] = None
+                   ) -> Tuple[List[Sequence], int]:
     """Load all contigs from all assemblies, skipping sub-k contigs and
-    ignored headers, then repair dotted ends (reference compress.rs:98-133)."""
+    ignored headers, then repair dotted ends (reference compress.rs:98-133).
+
+    Files load/parse/encode concurrently (one task per FASTA on the shared
+    pool) and merge in deterministic file order, so sequence ids, log lines
+    and metrics are identical to the serial walk at every thread count. A
+    parse/repair cache (utils.cache) makes repeat runs skip both phases."""
     log.section_header("Loading input assemblies")
     log.explanation("Input assemblies are now loaded and each contig is given a unique ID.")
     assemblies = find_all_assemblies(assemblies_dir)
-    half_k = k_size // 2
+    with substage("load"):
+        per_file, file_hashes = _load_assembly_files(assemblies, k_size,
+                                                     threads, cache)
     seq_id = 0
     sequences: List[Sequence] = []
-    for assembly in assemblies:
+    for assembly, records in zip(assemblies, per_file):
         details = InputAssemblyDetails(filename=str(assembly))
-        for _, header, seq in load_fasta(assembly):
-            if len(seq) < k_size:
-                continue
+        filename = Path(assembly).name
+        for contig_header, forward, reverse, length in records:
             seq_id += 1
             if seq_id > MAX_INPUT_SEQUENCES:
                 quit_with_error(
                     f"no more than {MAX_INPUT_SEQUENCES} input sequences are allowed")
-            contig_header = " ".join(header.split())
-            filename = Path(assembly).name
-            sequence = Sequence.with_seq(seq_id, seq, filename, contig_header, half_k)
+            sequence = Sequence(seq_id, forward, reverse, filename,
+                                contig_header, length)
             log.message(f" {seq_id:>3}: {sequence}")
             details.contigs.append(InputContigDetails(
                 name=sequence.contig_name(), description=sequence.contig_description(),
@@ -117,13 +133,102 @@ def load_sequences(assemblies_dir, k_size: int, metrics: InputAssemblyMetrics,
         metrics.input_assembly_details.append(details)
     log.message()
     check_sequence_count(sequences, len(assemblies), max_contigs)
-    with Spinner("repairing sequence ends..."):
-        sequence_end_repair(sequences, k_size, threads)
+    with Spinner("repairing sequence ends..."), substage("repair"):
+        _repair_with_cache(sequences, k_size, threads, cache, file_hashes)
     n = seq_id
     log.message(f"{n} sequence{'' if n == 1 else 's'} loaded from {len(assemblies)} "
                 f"assembl{'y' if len(assemblies) == 1 else 'ies'}")
     log.message()
     return sequences, len(assemblies)
+
+
+def _load_assembly_files(assemblies, k_size: int, threads: int,
+                         cache: Optional[EncodeCache]):
+    """One load/parse/pad/revcomp task per FASTA file on the shared pool,
+    merged in file order. Returns (per-file record lists, per-file content
+    hashes). Each record is (contig_header, forward, reverse, length) for a
+    contig of at least k bases — sub-k contigs are dropped here exactly as
+    the serial walk dropped them.
+
+    A worker failure with threads > 1 degrades VISIBLY to one serial retry:
+    a bounded fault (e.g. a transient read error) must not corrupt ordering
+    or kill the run, while a persistent error still propagates from the
+    serial pass with its original message."""
+    half_k = k_size // 2
+
+    def load_one(assembly):
+        file_hash = None
+        if cache is not None:
+            try:
+                file_hash = content_hash(Path(assembly).read_bytes())
+            except OSError:
+                file_hash = None
+            if file_hash is not None:
+                hit = cache.load_parsed(file_hash, k_size)
+                if hit is not None:
+                    return [(header, fwd, reverse_complement_bytes(fwd), ln)
+                            for header, fwd, ln in hit], file_hash
+        filename = Path(assembly).name
+        parsed = []
+        for _, header, seq in load_fasta(assembly):
+            if len(seq) < k_size:
+                continue
+            contig_header = " ".join(header.split())
+            parsed.append((contig_header, padded_strand(seq, filename, half_k),
+                           len(seq)))
+        if cache is not None and file_hash is not None:
+            cache.store_parsed(file_hash, k_size, parsed)
+        return [(header, fwd, reverse_complement_bytes(fwd), ln)
+                for header, fwd, ln in parsed], file_hash
+
+    workers = min(max(1, int(threads)), len(assemblies))
+    if workers > 1:
+        try:
+            results = pool_map(load_one, assemblies, workers)
+        except Exception as e:  # noqa: BLE001 — fault isolation: degrade to
+            # the serial walk rather than corrupt ordering or die on a
+            # transient per-file failure; a persistent failure re-raises
+            # below with its original message
+            import sys
+            record_degrade("assembly-load", "parallel", "serial",
+                           f"{type(e).__name__}: {e}")
+            print(f"autocycler: parallel assembly load failed "
+                  f"({type(e).__name__}: {e}); retrying serially",
+                  file=sys.stderr)
+            results = [load_one(a) for a in assemblies]
+    else:
+        results = [load_one(a) for a in assemblies]
+    return [r[0] for r in results], [r[1] for r in results]
+
+
+def _repair_with_cache(sequences: List[Sequence], k_size: int, threads: int,
+                       cache: Optional[EncodeCache], file_hashes) -> None:
+    """Sequence-end repair with a warm-start cache: repair candidates are
+    searched across ALL inputs, so the cache key is the hash over every
+    file's content hash plus k, and only the repaired 2*(k-1) end bytes per
+    sequence are stored — a hit patches the strands in place and skips the
+    whole occurrence scan."""
+    overlap = k_size - 1
+    combined = None
+    if (cache is not None and sequences and overlap > 0
+            and all(h is not None for h in file_hashes)):
+        combined = content_hash("|".join(file_hashes).encode())
+        ends = cache.load_repair_ends(combined, k_size, len(sequences))
+        if ends is not None:
+            for i, s in enumerate(sequences):
+                repaired = s.forward_seq          # fresh per run: own array
+                repaired[:overlap] = ends[i, 0]
+                repaired[len(repaired) - overlap:] = ends[i, 1]
+                s.forward_seq = repaired          # setter invalidates codes
+                s.reverse_seq = reverse_complement_bytes(repaired)
+            return
+    sequence_end_repair(sequences, k_size, threads)
+    if combined is not None:
+        ends = np.stack([
+            np.stack([s.forward_seq[:overlap],
+                      s.forward_seq[len(s.forward_seq) - overlap:]])
+            for s in sequences])
+        cache.store_repair_ends(combined, k_size, ends)
 
 
 def check_sequence_count(sequences: List[Sequence], assembly_count: int,

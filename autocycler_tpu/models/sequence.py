@@ -21,6 +21,18 @@ _IS_ACGT[np.frombuffer(b"ACGT", dtype=np.uint8)] = True
 _ACGT = frozenset(b"ACGT")
 
 
+def padded_strand(seq: str, filename: str, half_k: int) -> np.ndarray:
+    """Validated, dot-padded forward strand bytes for one contig — the
+    sequence-independent half of :meth:`Sequence.with_seq`, shared with the
+    parallel loader (which builds strands in worker tasks before sequence
+    ids exist) and the parse cache."""
+    raw = np.frombuffer(seq.encode(), dtype=np.uint8)
+    if not _IS_ACGT[raw].all():
+        quit_with_error(f"{filename} contains non-ACGT characters")
+    pad = np.full(half_k, ord("."), dtype=np.uint8)
+    return np.concatenate([pad, raw, pad])
+
+
 class Sequence:
     __slots__ = ("id", "_forward_seq", "_reverse_seq", "filename",
                  "contig_header", "length", "cluster", "_strand_codes")
@@ -73,13 +85,18 @@ class Sequence:
                  half_k: int) -> "Sequence":
         """Construct with the actual sequence stored, dot-padded by half_k on
         both ends (reference sequence.rs:31-59)."""
-        raw = np.frombuffer(seq.encode(), dtype=np.uint8)
-        if not _IS_ACGT[raw].all():
-            quit_with_error(f"{filename} contains non-ACGT characters")
-        pad = np.full(half_k, ord("."), dtype=np.uint8)
-        forward = np.concatenate([pad, raw, pad])
-        reverse = reverse_complement_bytes(forward)
-        return cls(id, forward, reverse, filename, contig_header, len(seq))
+        forward = padded_strand(seq, filename, half_k)
+        return cls.from_padded_forward(id, forward, filename, contig_header,
+                                       len(seq))
+
+    @classmethod
+    def from_padded_forward(cls, id: int, forward: np.ndarray, filename: str,
+                            contig_header: str, length: int) -> "Sequence":
+        """Construct from an already-validated padded forward strand (the
+        parallel loader and the parse cache land here); the reverse strand
+        is always re-derived, so cached bytes cannot desynchronise."""
+        return cls(id, forward, reverse_complement_bytes(forward), filename,
+                   contig_header, length)
 
     @classmethod
     def without_seq(cls, id: int, filename: str, contig_header: str, length: int,

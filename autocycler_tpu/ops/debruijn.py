@@ -50,12 +50,15 @@ class Chains:
         return self.members[self.chain_off[c]:self.chain_off[c + 1]]
 
 
-def internal_edges(index: KmerIndex) -> np.ndarray:
-    """next_int[g] = unitig-internal successor of k-mer g, or -1."""
+def internal_edges(index: KmerIndex, workers: int = 1) -> np.ndarray:
+    """next_int[g] = unitig-internal successor of k-mer g, or -1. The
+    U-sized gather chunks over the shared pool above one worker
+    (bit-identical: chunks write disjoint ranges)."""
+    from ..utils.pool import parallel_gather
     U = index.num_kmers
     succ = index.succ
     ok = (index.out_count == 1) & (succ >= 0)
-    ok &= ~index.first_pos[index.rev_kid]
+    ok &= ~parallel_gather(index.first_pos, index.rev_kid, workers)
     src = np.flatnonzero(ok)
     tgt = succ[src]
     keep = (index.in_count[tgt] == 1) & ~index.first_pos[tgt]
@@ -133,14 +136,16 @@ def _chains_numpy(next_int: np.ndarray):
     return members, chain_off, chain_is_cycle
 
 
-def build_chains(index: KmerIndex) -> Chains:
+def build_chains(index: KmerIndex, threads=None) -> Chains:
     U = index.num_kmers
     if U == 0:
         return Chains(np.zeros(0, np.int64), np.zeros(1, np.int64), np.zeros(0, bool))
 
+    from .kmers import _effective_workers, _resolve_threads
+    workers = _effective_workers(_resolve_threads(threads))
     from ..utils.timing import substage
     with substage("chains"):
-        next_int = internal_edges(index)
+        next_int = internal_edges(index, workers)
         from .. import native
         walked = native.chain_walk(next_int) if native.available() else None
         if walked is not None:

@@ -37,6 +37,81 @@ _PROBE_LOCK = _threading.Lock()
 # {"attached", "seconds", "reason", "at" (monotonic), "probes"}
 _probe_state: dict = {"probes": 0}
 
+# on-disk negative-probe cache: one wedged-transport probe costs a full
+# deadline; persisting the failure (short TTL) under the run's autocycler
+# dir stops every SUBSEQUENT process (batch isolates, CLI stage-per-process
+# runs, bench reruns) from re-paying that stall. Only negative kinds that
+# imply a wedged/broken transport ("timeout"/"error") persist — success is
+# always re-verified per process (it is cheap when healthy).
+_probe_cache_dir = None
+_PROBE_CACHE_FILE = "device_probe.json"
+
+
+def set_probe_cache_dir(path) -> None:
+    """Enable the on-disk negative probe cache under ``path`` (compress and
+    batch point it at ``<autocycler_dir>/.cache``; None disables)."""
+    global _probe_cache_dir
+    with _PROBE_LOCK:
+        _probe_cache_dir = None if path is None else str(path)
+
+
+def _probe_neg_ttl() -> float:
+    import os
+    import sys
+    try:
+        return float(os.environ.get("AUTOCYCLER_PROBE_NEG_TTL_S", "300"))
+    except ValueError:
+        print("autocycler: ignoring malformed AUTOCYCLER_PROBE_NEG_TTL_S",
+              file=sys.stderr)
+        return 300.0
+
+
+def _disk_probe_load():
+    """A still-fresh persisted negative probe ({kind, reason, at}), or
+    None."""
+    with _PROBE_LOCK:
+        cache_dir = _probe_cache_dir
+    if not cache_dir:
+        return None
+    import json
+    import os
+    ttl = _probe_neg_ttl()
+    if ttl <= 0:
+        return None
+    try:
+        with open(os.path.join(cache_dir, _PROBE_CACHE_FILE)) as f:
+            entry = json.load(f)
+        if entry.get("kind") not in ("timeout", "error"):
+            return None
+        if _time.time() - float(entry.get("at", 0)) >= ttl:
+            return None
+        return entry
+    except Exception:  # noqa: BLE001 — missing/corrupt cache == no cache
+        return None
+
+
+def _disk_probe_store(attached: bool, reason: str, kind: str) -> None:
+    with _PROBE_LOCK:
+        cache_dir = _probe_cache_dir
+    if not cache_dir:
+        return
+    import json
+    import os
+    path = os.path.join(cache_dir, _PROBE_CACHE_FILE)
+    try:
+        if attached or kind not in ("timeout", "error"):
+            # a healthy (or merely absent) device clears any stale negative
+            if os.path.exists(path):
+                os.unlink(path)
+            return
+        os.makedirs(cache_dir, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"kind": kind, "reason": reason, "at": _time.time()}, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
 
 def _record_probe(attached: bool, seconds: float, reason: str,
                   cache: bool, kind: str) -> None:
@@ -105,9 +180,11 @@ def jax_backend_safe() -> bool:
 
 
 def _probe_reset() -> None:
+    global _probe_cache_dir
     with _PROBE_LOCK:
         _probe_state.clear()
         _probe_state["probes"] = 0
+        _probe_cache_dir = None
 
 
 def _tpu_attached() -> bool:
@@ -145,11 +222,18 @@ def _tpu_attached() -> bool:
                       f"JAX_PLATFORMS={platforms!r} pins a non-TPU backend",
                       cache=False, kind="pinned")
         return False
+    # AUTOCYCLER_PROBE_DEADLINE_S is the operator-facing deadline knob and
+    # takes precedence; AUTOCYCLER_DEVICE_PROBE_TIMEOUT remains as the
+    # original spelling. Same semantics either way (<= 0 disables the
+    # device path outright).
+    raw_deadline = os.environ.get("AUTOCYCLER_PROBE_DEADLINE_S")
+    if raw_deadline is None:
+        raw_deadline = os.environ.get("AUTOCYCLER_DEVICE_PROBE_TIMEOUT", "60")
     try:
-        timeout = float(os.environ.get("AUTOCYCLER_DEVICE_PROBE_TIMEOUT", "60"))
+        timeout = float(raw_deadline)
     except ValueError:
-        print("autocycler: ignoring malformed AUTOCYCLER_DEVICE_PROBE_TIMEOUT",
-              file=sys.stderr)
+        print("autocycler: ignoring malformed probe deadline "
+              f"({raw_deadline!r})", file=sys.stderr)
         timeout = 60.0
     if timeout <= 0:       # explicit kill switch: host backends, no probe
         _record_probe(False, 0.0,
@@ -187,6 +271,17 @@ def _tpu_attached() -> bool:
             return bool(st.get("attached", False))
         _probe_state["probing"] = True
 
+    persisted = _disk_probe_load()
+    if persisted is not None:
+        # a recent process already paid the deadline against this wedged
+        # transport: adopt its negative outcome instead of stalling again
+        _record_probe(False, 0.0,
+                      f"persisted negative probe: {persisted['reason']}",
+                      cache=True, kind=persisted["kind"])
+        with _PROBE_LOCK:
+            _probe_state["probing"] = False
+        return False
+
     result: List[Tuple[bool, str, str]] = []
 
     def probe() -> None:
@@ -221,6 +316,7 @@ def _tpu_attached() -> bool:
                   "backends", file=sys.stderr)
         _record_probe(attached, _time.perf_counter() - t0, reason, cache=True,
                       kind=kind)
+        _disk_probe_store(attached, reason, kind)
     finally:
         with _PROBE_LOCK:
             _probe_state["probing"] = False
@@ -291,6 +387,8 @@ def pairwise_distance_matrix(M: np.ndarray, w: np.ndarray,
         use_jax = False
     if use_jax:
         try:
+            from ..utils.jaxcache import configure_compile_cache
+            configure_compile_cache()
             import jax.numpy as jnp
             # pad to fixed shape buckets (rows to 64, cols to 8192) so the
             # compiled matmul is reused across datasets via the persistent

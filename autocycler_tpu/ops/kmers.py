@@ -223,10 +223,9 @@ def _radix_partition(codes: np.ndarray, starts: np.ndarray, k: int,
     jobs = [(int(lo), int(hi)) for lo, hi in zip(bounds[:-1], bounds[1:])
             if hi > lo]
     if len(jobs) > 1 and workers > 1:
-        from concurrent.futures import ThreadPoolExecutor
-        with ThreadPoolExecutor(max_workers=workers) as pool:
-            slabs = list(pool.map(
-                lambda j: _radix_slab(codes, starts, k, *j), jobs))
+        from ..utils.pool import get_executor
+        slabs = list(get_executor(workers).map(
+            lambda j: _radix_slab(codes, starts, k, *j), jobs))
     else:
         slabs = [_radix_slab(codes, starts, k, *j) for j in jobs]
 
@@ -314,10 +313,9 @@ def _chunk_pool_map(codes: np.ndarray, chunk_starts_list, k: int,
                         [(cs, k) for cs in chunk_starts_list]))
             finally:
                 _PROC_CODES = None
-    from concurrent.futures import ThreadPoolExecutor
-    with ThreadPoolExecutor(max_workers=workers) as pool:
-        return list(pool.map(lambda cs: _radix_chunk_job(codes, cs, k),
-                             chunk_starts_list))
+    from ..utils.pool import get_executor
+    return list(get_executor(workers).map(
+        lambda cs: _radix_chunk_job(codes, cs, k), chunk_starts_list))
 
 
 def _radix_rank_stats(codes: np.ndarray, starts: np.ndarray, k: int,
@@ -713,6 +711,9 @@ def group_windows_full(codes: np.ndarray, starts: np.ndarray, k: int,
         # compile wall
         raise ValueError(f"unknown device grouping mode {use_jax!r}")
     if use_jax:
+        from ..utils.jaxcache import configure_compile_cache
+        configure_compile_cache()   # AUTOCYCLER_COMPILE_CACHE opt-in: the
+        # variadic sorts / Pallas networks persist across processes
         try:
             if use_jax == "pallas":
                 order, gid_sorted = _pack_and_rank_jax_pallas(codes, starts, k)
@@ -921,17 +922,24 @@ class KmerIndex:
         return len(self.depth)
 
 
-def _adjacency(prefix_gid: np.ndarray, suffix_gid: np.ndarray, G: int):
+def _adjacency(prefix_gid: np.ndarray, suffix_gid: np.ndarray, G: int,
+               workers: int = 1):
     """Neighbour counts over UNIQUE k-mers (next_kmers/prev_kmers semantics,
-    kmer_graph.rs:136-166) by (k-1)-gram id equality."""
+    kmer_graph.rs:136-166) by (k-1)-gram id equality. The bincounts and
+    gathers chunk over the shared pool (utils.pool) above one worker —
+    bit-identical by construction (disjoint output ranges; integer count
+    sums are order-independent)."""
+    from ..utils.pool import parallel_bincount, parallel_gather
     U = len(prefix_gid)
-    cnt_prefix = np.bincount(prefix_gid, minlength=G)
-    cnt_suffix = np.bincount(suffix_gid, minlength=G)
-    out_count = cnt_prefix[suffix_gid]
-    in_count = cnt_suffix[prefix_gid]
+    cnt_prefix = parallel_bincount(prefix_gid, G, workers)
+    cnt_suffix = parallel_bincount(suffix_gid, G, workers)
+    out_count = parallel_gather(cnt_prefix, suffix_gid, workers)
+    in_count = parallel_gather(cnt_suffix, prefix_gid, workers)
     succ_by_gram = np.full(G, -1, np.int64)
+    # the scatter stays serial: duplicate gram ids overwrite in index order
+    # (last write wins) and a chunked scatter would race on that order
     succ_by_gram[prefix_gid] = np.arange(U)
-    succ = succ_by_gram[suffix_gid]  # valid only where out_count == 1
+    succ = parallel_gather(succ_by_gram, suffix_gid, workers)
     return out_count, in_count, succ
 
 
@@ -1008,8 +1016,10 @@ def build_kmer_index(sequences, k: int, use_jax: UseJax = None,
             first_pos = np.zeros(U, bool)
             first_pos[fwd_gid[fwd_win_off[:-1]]] = True
             first_pos[rev_kid[fwd_gid[fwd_win_off[1:] - 1]]] = True
-            out_count, in_count, succ = _adjacency(res["prefix_gid"],
-                                                   res["suffix_gid"], G)
+            from ..utils.timing import substage
+            with substage("adjacency"):
+                out_count, in_count, succ = _adjacency(
+                    res["prefix_gid"], res["suffix_gid"], G, workers)
             return KmerIndex(
                 k=k, half_k=half_k, buf=buf, seq_ids=seq_ids, seq_len=seq_len,
                 fwd_byte_off=fwd_off, rev_byte_off=rev_off, occ_off=occ_off,
@@ -1092,7 +1102,8 @@ def build_kmer_index(sequences, k: int, use_jax: UseJax = None,
 
     from ..utils.timing import substage
     with substage("adjacency"):
-        out_count, in_count, succ = _adjacency(prefix_gid, suffix_gid, G)
+        out_count, in_count, succ = _adjacency(prefix_gid, suffix_gid, G,
+                                               workers)
 
     return KmerIndex(
         k=k, half_k=half_k, buf=buf, seq_ids=seq_ids, seq_len=seq_len,

@@ -1,0 +1,163 @@
+"""Warm-start caches for the compress pipeline.
+
+Two content-addressed caches live under ``<autocycler_dir>/.cache``:
+
+- the per-assembly **parse cache**: keyed by sha256 of the FASTA file's raw
+  bytes plus k, storing every >= k contig's dot-padded forward strand,
+  header and length, so a repeat run (or ``batch --resume``) skips
+  decompression, parsing, ACGT validation and padding entirely. Content
+  addressing means an mtime-only touch still hits while any byte change
+  misses — no staleness heuristics.
+- the **repair cache**: sequence-end repair depends on every input file at
+  once (candidates are searched across all sequences), so its key is the
+  sha256 over ALL per-file content hashes plus k. Only the repaired
+  2*(k-1) end bytes per sequence are stored; a hit patches the parsed
+  strands in place and skips the whole repair scan.
+
+Both caches are best-effort: any read/write failure silently degrades to
+the uncached path (the caller re-parses / re-repairs), and every payload
+re-derives the reverse strand from the forward bytes, so a cache hit is
+bit-identical to a cold run by construction. AUTOCYCLER_ENCODE_CACHE=0
+disables both.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import tempfile
+import threading
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+# process-wide hit/miss accounting, inspectable by tests and artifacts
+_stats_lock = threading.Lock()
+_stats = {"parse_hits": 0, "parse_misses": 0,
+          "repair_hits": 0, "repair_misses": 0}
+
+
+def cache_stats() -> dict:
+    with _stats_lock:
+        return dict(_stats)
+
+
+def _count(key: str) -> None:
+    with _stats_lock:
+        _stats[key] += 1
+
+
+def cache_enabled() -> bool:
+    return os.environ.get("AUTOCYCLER_ENCODE_CACHE", "").strip().lower() \
+        not in ("0", "false", "no", "off", "disabled")
+
+
+def content_hash(raw: bytes) -> str:
+    return hashlib.sha256(raw).hexdigest()
+
+
+def _atomic_write(path: Path, payload: bytes) -> None:
+    fd, tmp = tempfile.mkstemp(dir=str(path.parent),
+                               prefix=path.name + ".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+class EncodeCache:
+    """Handle on one autocycler dir's ``.cache`` directory. ``None``-safe
+    construction: :func:`open_cache` returns None when caching is disabled,
+    and every call site guards on that."""
+
+    def __init__(self, cache_dir) -> None:
+        self.dir = Path(cache_dir)
+
+    def _parse_path(self, file_hash: str, k: int) -> Path:
+        return self.dir / f"asm-{file_hash[:24]}-k{k}.npz"
+
+    def _repair_path(self, combined_hash: str, k: int) -> Path:
+        return self.dir / f"repair-{combined_hash[:24]}-k{k}.npz"
+
+    # ---- per-assembly parse cache ----
+
+    def load_parsed(self, file_hash: str, k: int
+                    ) -> Optional[List[Tuple[str, np.ndarray, int]]]:
+        """[(contig_header, padded forward strand, unpadded length), ...] in
+        file order for a previously-cached assembly, or None on a miss."""
+        path = self._parse_path(file_hash, k)
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                payload = z["payload"]
+                offs = z["offs"]
+                meta = json.loads(bytes(z["meta"]).decode())
+        except Exception:  # noqa: BLE001 — missing/corrupt entry == miss
+            _count("parse_misses")
+            return None
+        records = []
+        for i, (header, length) in enumerate(meta):
+            records.append((header, payload[offs[i]:offs[i + 1]], int(length)))
+        _count("parse_hits")
+        return records
+
+    def store_parsed(self, file_hash: str, k: int,
+                     records: List[Tuple[str, np.ndarray, int]]) -> None:
+        try:
+            self.dir.mkdir(parents=True, exist_ok=True)
+            payload = np.concatenate([fwd for _, fwd, _ in records]) \
+                if records else np.zeros(0, np.uint8)
+            offs = np.zeros(len(records) + 1, np.int64)
+            np.cumsum([len(fwd) for _, fwd, _ in records], out=offs[1:])
+            meta = json.dumps([(header, length)
+                               for header, _, length in records]).encode()
+            buf = io.BytesIO()
+            np.savez(buf, payload=payload, offs=offs,
+                     meta=np.frombuffer(meta, np.uint8))
+            _atomic_write(self._parse_path(file_hash, k), buf.getvalue())
+        except Exception:  # noqa: BLE001 — cache writes never fail the run
+            pass
+
+    # ---- whole-input repair cache ----
+
+    def load_repair_ends(self, combined_hash: str, k: int, n_seqs: int
+                         ) -> Optional[np.ndarray]:
+        """[n_seqs, 2, k-1] uint8 repaired end bytes (start window, end
+        window) for this exact input set, or None."""
+        path = self._repair_path(combined_hash, k)
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                ends = z["ends"]
+        except Exception:  # noqa: BLE001
+            _count("repair_misses")
+            return None
+        if ends.shape != (n_seqs, 2, k - 1):
+            _count("repair_misses")
+            return None
+        _count("repair_hits")
+        return ends
+
+    def store_repair_ends(self, combined_hash: str, k: int,
+                          ends: np.ndarray) -> None:
+        try:
+            self.dir.mkdir(parents=True, exist_ok=True)
+            buf = io.BytesIO()
+            np.savez(buf, ends=ends)
+            _atomic_write(self._repair_path(combined_hash, k), buf.getvalue())
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def open_cache(autocycler_dir) -> Optional[EncodeCache]:
+    """The autocycler dir's encode cache, or None when disabled."""
+    if autocycler_dir is None or not cache_enabled():
+        return None
+    return EncodeCache(Path(autocycler_dir) / ".cache")

@@ -1,0 +1,46 @@
+"""JAX persistent compilation cache knob.
+
+XLA's variadic sorts and the Pallas networks cost seconds-to-minutes to
+compile per shape; jax can persist compiled executables to disk so repeat
+processes (batch runs, CLI stage-per-process runs) skip the recompile.
+``AUTOCYCLER_COMPILE_CACHE=<dir>`` opts in; the setting is applied at most
+once per process, lazily, from the device-path entry points — so host-only
+runs never import jax for it.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_lock = threading.Lock()
+_configured = False
+
+
+def configure_compile_cache() -> bool:
+    """Apply AUTOCYCLER_COMPILE_CACHE to jax.config if set. Returns whether
+    a cache dir is active. Safe to call from any device entry point, any
+    number of times; failures (old jax, bad dir) degrade silently — the
+    cache is an optimisation, never a correctness dependency."""
+    global _configured
+    cache_dir = os.environ.get("AUTOCYCLER_COMPILE_CACHE", "").strip()
+    if not cache_dir:
+        return False
+    with _lock:
+        if _configured:
+            return True
+        try:
+            import jax
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              1.0)
+            _configured = True
+        except Exception:  # noqa: BLE001 — optimisation only
+            return False
+    return True
+
+
+def _reset_for_tests() -> None:
+    global _configured
+    with _lock:
+        _configured = False

@@ -155,12 +155,8 @@ def map_threaded(fn, items, threads: int) -> list:
     work in the callers is native ctypes calls / numpy kernels, which release
     the GIL — the analogue of the reference's rayon par_iter pools
     (compress.rs:59-62, trim.rs:122,148). threads<=1 is a plain map."""
-    items = list(items)
-    if threads <= 1 or len(items) <= 1:
-        return [fn(x) for x in items]
-    from concurrent.futures import ThreadPoolExecutor
-    with ThreadPoolExecutor(max_workers=min(threads, len(items))) as pool:
-        return list(pool.map(fn, items))
+    from .pool import pool_map
+    return pool_map(fn, items, threads)
 
 
 import threading as _threading

@@ -1,0 +1,110 @@
+"""Byte-identity contract of the overlapped compress pipeline: parallel
+load/encode, warm-start caches and the vectorised link join must all produce
+output indistinguishable from the serial cold path — GFA and YAML compared
+as raw bytes, L-line order included."""
+
+import hashlib
+import os
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from synthetic import make_assemblies
+
+
+def _compress_into(asm_dir, out_dir, threads):
+    from autocycler_tpu.commands.compress import compress
+
+    compress(str(asm_dir), str(out_dir), k_size=51, threads=threads)
+    return ((Path(out_dir) / "input_assemblies.gfa").read_bytes(),
+            (Path(out_dir) / "input_assemblies.yaml").read_bytes())
+
+
+def test_threads_byte_identity(tmp_path, capsys):
+    """The overlapped loader at 4 threads produces byte-identical GFA and
+    YAML to the serial path (one shared input dir so YAML paths match)."""
+    make_assemblies(tmp_path)
+    asm = tmp_path / "assemblies"
+    g1, y1 = _compress_into(asm, tmp_path / "t1", threads=1)
+    g4, y4 = _compress_into(asm, tmp_path / "t4", threads=4)
+    assert g1 == g4
+    assert y1 == y4
+    assert b"\nL\t" in g1  # links present, so L-line order is exercised
+    capsys.readouterr()
+
+
+def test_warm_cache_byte_identity(tmp_path, capsys):
+    """Rerunning into the same autocycler dir hits the parse + repair
+    caches and still writes identical bytes."""
+    from autocycler_tpu.utils.cache import cache_stats
+
+    make_assemblies(tmp_path)
+    asm = tmp_path / "assemblies"
+    out = tmp_path / "out"
+    g1, y1 = _compress_into(asm, out, threads=4)
+    s0 = cache_stats()
+    g2, y2 = _compress_into(asm, out, threads=4)
+    s1 = cache_stats()
+    assert (g2, y2) == (g1, y1)
+    assert s1["parse_hits"] - s0["parse_hits"] == 4
+    assert s1["repair_hits"] - s0["repair_hits"] == 1
+    capsys.readouterr()
+
+
+@pytest.mark.faultinject
+def test_fault_in_loader_degrades_not_corrupts(tmp_path, monkeypatch, capsys):
+    """A fault injected into ONE parallel loader task degrades the whole
+    load to a serial retry (recorded in the degradation registry) without
+    corrupting sequence ordering — output stays byte-identical to a clean
+    run."""
+    from autocycler_tpu.utils.resilience import (_reset_degrades_for_tests,
+                                                 degrade_events)
+
+    make_assemblies(tmp_path)
+    asm = tmp_path / "assemblies"
+    _reset_degrades_for_tests()
+    monkeypatch.setenv("AUTOCYCLER_FAULTS", "fasta:assembly_2:fail:1")
+    g_fault, y_fault = _compress_into(asm, tmp_path / "faulted", threads=4)
+    monkeypatch.delenv("AUTOCYCLER_FAULTS")
+    events = degrade_events("assembly-load")
+    assert events and events[0]["from"] == "parallel" \
+        and events[0]["to"] == "serial"
+    g_clean, y_clean = _compress_into(asm, tmp_path / "clean", threads=4)
+    assert g_fault == g_clean
+    assert y_fault == y_clean
+    capsys.readouterr()
+
+
+def test_link_pairs_matches_dict_oracle():
+    """The vectorised argsort/searchsorted link join emits (src, tgt, kind)
+    triples in EXACTLY the dict-of-lists order — this is what pins GFA
+    L-line order across the refactor."""
+    from autocycler_tpu.ops.graph_build import _link_pairs, _link_pairs_dict
+
+    rng = np.random.default_rng(11)
+    for C in (0, 1, 2, 7, 64, 513):
+        # small gram universe forces collisions (multiple chains per gram)
+        lo = max(C // 3, 1)
+        fs = rng.integers(0, lo, C).astype(np.int64)
+        rs = rng.integers(0, lo, C).astype(np.int64)
+        fe = rng.integers(0, lo, C).astype(np.int64)
+        re = rng.integers(0, lo, C).astype(np.int64)
+        src, tgt, kind = _link_pairs(fs, rs, fe, re)
+        got = list(zip(src.tolist(), tgt.tolist(), kind.tolist()))
+        assert got == _link_pairs_dict(fs, rs, fe, re), f"C={C}"
+
+
+def test_threads_defaults():
+    """The CLI default (-t 8) and the API default (threads=1) are distinct
+    on purpose: library callers get the deterministic serial path unless
+    they opt in, the CLI opts users into the overlapped path."""
+    import inspect
+
+    from autocycler_tpu import cli
+    from autocycler_tpu.commands.compress import compress
+
+    parser = cli.build_parser()
+    args = parser.parse_args(["compress", "-i", "x", "-a", "y"])
+    assert args.threads == 8
+    assert inspect.signature(compress).parameters["threads"].default == 1

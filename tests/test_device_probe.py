@@ -228,3 +228,107 @@ def test_probe_failure_keeps_host_matmul_exact():
         for b in range(6):
             expect[a, b] = 1.0 - inter[a, b] / inter[a, a]
     assert np.allclose(got, expect)
+
+
+def test_probe_deadline_env_takes_precedence(monkeypatch, capsys):
+    """AUTOCYCLER_PROBE_DEADLINE_S is the operator-facing deadline knob and
+    wins over the original AUTOCYCLER_DEVICE_PROBE_TIMEOUT spelling; <= 0
+    keeps the kill-switch semantics, malformed values warn and default."""
+    from autocycler_tpu.ops import distance
+
+    probe = _fresh_probe()
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    monkeypatch.setenv("AUTOCYCLER_DEVICE_PROBE_TIMEOUT", "60")
+    monkeypatch.setenv("AUTOCYCLER_PROBE_DEADLINE_S", "0")
+    assert probe() is False
+    assert distance.device_probe_report()["kind"] == "disabled"
+
+    import jax.numpy as jnp
+
+    jnp.zeros(1).block_until_ready()  # backend init under pinned cpu
+    probe = _fresh_probe()
+    monkeypatch.setenv("AUTOCYCLER_PROBE_DEADLINE_S", "pear")
+    assert probe() is False
+    assert "malformed probe deadline" in capsys.readouterr().err
+
+
+def test_negative_probe_persists_across_processes(tmp_path, monkeypatch,
+                                                  capsys):
+    """A timed-out probe writes device_probe.json under the configured
+    cache dir; a fresh probe state (simulating the next process) adopts the
+    persisted negative WITHOUT paying another deadline, and the TTL bounds
+    how long the negative sticks."""
+    import json
+    import threading
+    import time
+
+    from autocycler_tpu.ops import distance
+
+    probe = _fresh_probe()
+    distance.set_probe_cache_dir(tmp_path)
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    monkeypatch.setenv("AUTOCYCLER_PROBE_DEADLINE_S", "0.05")
+
+    calls = []
+
+    class HangingThread(threading.Thread):
+        def __init__(self, *a, **kw):
+            calls.append(1)
+            kw["target"] = lambda: threading.Event().wait(5)
+            super().__init__(*a, **kw)
+
+    monkeypatch.setattr(distance._threading, "Thread", HangingThread)
+    assert probe() is False
+    assert len(calls) == 1
+    entry = json.loads((tmp_path / "device_probe.json").read_text())
+    assert entry["kind"] == "timeout"
+
+    # "next process": reset in-memory state, re-point the cache dir
+    probe = _fresh_probe()
+    distance.set_probe_cache_dir(tmp_path)
+    assert probe() is False
+    assert len(calls) == 1          # adopted from disk, no new probe thread
+    report = distance.device_probe_report()
+    assert "persisted negative probe" in report["reason"]
+    assert report["kind"] == "timeout"
+
+    # an expired entry is ignored: the probe runs (and times out) again
+    entry["at"] = time.time() - 10_000
+    (tmp_path / "device_probe.json").write_text(json.dumps(entry))
+    probe = _fresh_probe()
+    distance.set_probe_cache_dir(tmp_path)
+    assert probe() is False
+    assert len(calls) == 2
+    capsys.readouterr()
+
+
+def test_disk_probe_negative_only_and_cleared_on_success(tmp_path,
+                                                         monkeypatch):
+    """Only wedged-transport kinds (timeout/error) persist; a healthy or
+    merely-absent device clears any stale negative so recovery is not
+    masked. AUTOCYCLER_PROBE_NEG_TTL_S <= 0 disables adoption."""
+    import json
+
+    from autocycler_tpu.ops import distance
+
+    _fresh_probe()
+    distance.set_probe_cache_dir(tmp_path)
+    distance._disk_probe_store(False, "wedged", "timeout")
+    assert (tmp_path / "device_probe.json").exists()
+    assert distance._disk_probe_load()["reason"] == "wedged"
+
+    monkeypatch.setenv("AUTOCYCLER_PROBE_NEG_TTL_S", "0")
+    assert distance._disk_probe_load() is None
+    monkeypatch.delenv("AUTOCYCLER_PROBE_NEG_TTL_S")
+
+    # non-negative kinds never persist and clear the stale negative
+    distance._disk_probe_store(False, "no tpu on host", "no-tpu")
+    assert not (tmp_path / "device_probe.json").exists()
+    distance._disk_probe_store(False, "wedged", "timeout")
+    distance._disk_probe_store(True, "tpu verified", "ok")
+    assert not (tmp_path / "device_probe.json").exists()
+
+    # corrupt cache file == no cache
+    (tmp_path / "device_probe.json").write_text("{not json")
+    assert distance._disk_probe_load() is None
+    _fresh_probe()

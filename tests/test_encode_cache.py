@@ -1,0 +1,96 @@
+"""Unit tests for the content-addressed encode cache (utils.cache): the
+cache key is the sha256 of the file BYTES plus k, so a content change is a
+miss and an mtime-only touch is a hit — no staleness heuristics to test
+around."""
+
+import os
+
+import numpy as np
+import pytest
+
+from synthetic import make_assemblies
+
+
+@pytest.mark.perf
+def test_content_hash_change_misses(tmp_path):
+    from autocycler_tpu.utils.cache import EncodeCache, content_hash
+
+    cache = EncodeCache(tmp_path / ".cache")
+    fwd = np.frombuffer(b"." * 25 + b"ACGTACGT" + b"." * 25, np.uint8)
+    h1 = content_hash(b">c\nACGTACGT\n")
+    cache.store_parsed(h1, 51, [("c", fwd, 8)])
+    hit = cache.load_parsed(h1, 51)
+    assert hit is not None and hit[0][0] == "c" and hit[0][2] == 8
+    assert np.array_equal(hit[0][1], fwd)
+    # any byte change changes the key -> miss
+    assert cache.load_parsed(content_hash(b">c\nACGTACGA\n"), 51) is None
+    # a different k misses even for identical bytes (padding depends on k)
+    assert cache.load_parsed(h1, 31) is None
+
+
+@pytest.mark.perf
+def test_mtime_only_change_hits(tmp_path, capsys):
+    """End-to-end: touching every input file's mtime between two compress
+    runs still hits the parse AND repair caches (content addressing)."""
+    from autocycler_tpu.commands.compress import compress
+    from autocycler_tpu.utils.cache import cache_stats
+
+    make_assemblies(tmp_path)
+    asm = tmp_path / "assemblies"
+    out = tmp_path / "out"
+    compress(str(asm), str(out), k_size=51, threads=2)
+    for f in asm.iterdir():
+        os.utime(f)
+    s0 = cache_stats()
+    compress(str(asm), str(out), k_size=51, threads=2)
+    s1 = cache_stats()
+    assert s1["parse_hits"] - s0["parse_hits"] == 4
+    assert s1["parse_misses"] == s0["parse_misses"]
+    assert s1["repair_hits"] - s0["repair_hits"] == 1
+    capsys.readouterr()
+
+
+@pytest.mark.perf
+def test_repair_ends_shape_guard(tmp_path):
+    """The repair cache refuses an entry whose shape does not match the
+    requested (n_seqs, 2, k-1) — e.g. after a contig-count change that
+    somehow kept the combined hash (defence in depth)."""
+    from autocycler_tpu.utils.cache import EncodeCache
+
+    cache = EncodeCache(tmp_path / ".cache")
+    ends = np.ones((3, 2, 50), np.uint8)
+    cache.store_repair_ends("abc123", 51, ends)
+    got = cache.load_repair_ends("abc123", 51, 3)
+    assert got is not None and np.array_equal(got, ends)
+    assert cache.load_repair_ends("abc123", 51, 4) is None
+
+
+@pytest.mark.perf
+def test_cache_disable_env(tmp_path, monkeypatch):
+    from autocycler_tpu.utils.cache import open_cache
+
+    monkeypatch.setenv("AUTOCYCLER_ENCODE_CACHE", "0")
+    assert open_cache(tmp_path) is None
+    monkeypatch.setenv("AUTOCYCLER_ENCODE_CACHE", "1")
+    assert open_cache(tmp_path) is not None
+    assert open_cache(None) is None
+
+
+@pytest.mark.perf
+def test_compile_cache_knob(tmp_path, monkeypatch):
+    """AUTOCYCLER_COMPILE_CACHE points jax's persistent compilation cache
+    at the given directory; unset means untouched (returns False)."""
+    import jax
+
+    from autocycler_tpu.utils import jaxcache
+
+    jaxcache._reset_for_tests()
+    monkeypatch.delenv("AUTOCYCLER_COMPILE_CACHE", raising=False)
+    assert jaxcache.configure_compile_cache() is False
+
+    monkeypatch.setenv("AUTOCYCLER_COMPILE_CACHE", str(tmp_path / "jaxcache"))
+    assert jaxcache.configure_compile_cache() is True
+    assert jax.config.jax_compilation_cache_dir == str(tmp_path / "jaxcache")
+    # idempotent on repeat calls
+    assert jaxcache.configure_compile_cache() is True
+    jaxcache._reset_for_tests()

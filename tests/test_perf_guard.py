@@ -54,6 +54,30 @@ def test_guard_custom_tolerance():
     assert len(guard_failures(base, {"m": 15.1}, tolerance=1.5)) == 1
 
 
+def test_guard_covers_pipeline_substage_metrics():
+    """The guard compares every numeric key in the baseline, so the new
+    load_and_repair (cold/warm) and build-graph substage metrics are
+    guarded by the same pure comparison — a regression in any one of them
+    fails alone."""
+    base = {"compress_4x5Mbp_s": 20.0, "compress_build_graph_s": 18.0,
+            "compress_load_and_repair_s": 1.0,
+            "compress_load_and_repair_warm_s": 0.3,
+            "compress_build_graph_adjacency_s": 2.0,
+            "compress_build_graph_chains_s": 3.0,
+            "compress_build_graph_links_s": 0.05,
+            "compress_build_graph_unitigs_s": 0.4}
+    ok = {m: v for m, v in base.items()}
+    assert guard_failures(base, ok) == []
+    # one substage regressing past tolerance fails by itself
+    bad = dict(ok, compress_build_graph_chains_s=3.0 * 1.3)
+    fails = guard_failures(base, bad)
+    assert len(fails) == 1 and "compress_build_graph_chains_s" in fails[0]
+    # a warm-cache regression (cache stopped hitting) is caught too
+    cold_warm = dict(ok, compress_load_and_repair_warm_s=1.0)
+    fails = guard_failures(base, cold_warm)
+    assert len(fails) == 1 and "warm" in fails[0]
+
+
 def test_guard_reports_all_regressions_sorted():
     base = {"b_s": 10.0, "a_s": 10.0}
     fails = guard_failures(base, {"a_s": 20.0, "b_s": 20.0})
