@@ -115,6 +115,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "backoff (default 0)")
     p.add_argument("--args", dest="extra_args", nargs="+", default=[])
 
+    p = sub.add_parser("report",
+                       help="render a run's telemetry (trace spans, metrics, "
+                            "batch manifest, bench artifacts) as one report")
+    p.add_argument("run_dir",
+                   help="directory holding trace.jsonl / metrics.json "
+                        "(an AUTOCYCLER_TRACE_DIR run dir or an output dir)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the merged report as JSON instead of text")
+
     p = sub.add_parser("resolve", help="resolve repeats in the unitig graph")
     p.add_argument("-c", "--cluster_dir", required=True)
     p.add_argument("--verbose", action="store_true")
@@ -181,6 +190,9 @@ def dispatch(args) -> int:
         helper(args.task, args.reads, args.out_prefix, args.genome_size, args.threads,
                args.dir, args.read_type, args.min_depth_abs, args.min_depth_rel,
                args.extra_args, timeout=args.timeout, retries=args.retries)
+    elif args.command == "report":
+        from .obs.report import report
+        return report(args.run_dir, as_json=args.json)
     elif args.command == "resolve":
         from .commands.resolve import resolve
         resolve(args.cluster_dir, args.verbose)
@@ -225,17 +237,32 @@ def main(argv=None) -> int:
         except Exception:
             pass
 
-    print(BANNER, file=sys.stderr)
+    from .utils import log
+    if not log._json_mode():   # the banner would corrupt the JSONL stream
+        print(BANNER, file=sys.stderr)
     parser = build_parser()
     args = parser.parse_args(argv)
     if args.command in GC_DISABLED_COMMANDS:
         import gc
         gc.disable()
+    from .obs import trace
+    # `report` reads a previous run's telemetry — tracing it would clutter
+    # (or append to) the very artifacts it renders.
+    owns_run = (args.command != "report"
+                and trace.maybe_start_run(name=args.command))
     try:
-        rc = dispatch(args)
+        with trace.span(args.command, cat="command",
+                        **({"argv": list(argv)} if argv else {})):
+            rc = dispatch(args)
     except AutocyclerError as e:
         print(f"\nError: {e}", file=sys.stderr)
         return 1
+    finally:
+        if owns_run:
+            trace.finish_run()
+        metrics_path = os.environ.get("AUTOCYCLER_METRICS")
+        if metrics_path:
+            trace.write_metrics_file(metrics_path)
     return int(rc) if rc else 0
 
 

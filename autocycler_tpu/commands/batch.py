@@ -34,12 +34,14 @@ from pathlib import Path
 from typing import List
 
 from ..models.simplify import simplify_structure
+from ..obs import trace
 from ..ops.distance import intersections_to_distances, membership_matrix
 from ..ops.graph_build import build_unitig_graph
 from ..parallel.batch import batched_membership_intersections
 from ..parallel.mesh import make_mesh
 from ..utils import AutocyclerError, log, quit_with_error
 from ..utils.resilience import RunManifest, collect_errors
+from ..utils.timing import stage_timer
 from .cluster import cluster as run_cluster
 from .combine import combine
 from .compress import load_sequences
@@ -100,35 +102,37 @@ def batch(assemblies_parent, out_parent, k_size: int = 51,
 
     # ---- per-isolate compress (quarantined) ----
     compressed = []   # (iso, (sequences, ids), M, w)
-    for iso in todo:
-        manifest.start(iso.name)
-        log.message(f"Compressing isolate {iso.name}")
-        with errs.quarantine(iso.name):
-            from ..metrics import InputAssemblyMetrics
-            from ..utils.cache import open_cache
-            # warm-start caches live under the isolate's out dir, so a
-            # --resume (or repeat) run skips load+encode+repair for isolates
-            # whose inputs have not changed
-            sequences, _ = load_sequences(iso, k_size, InputAssemblyMetrics(),
-                                          max_contigs, threads,
-                                          cache=open_cache(out_parent / iso.name))
-            graph = build_unitig_graph(sequences, k_size, threads=threads)
-            simplify_structure(graph, sequences)
-            out_dir = out_parent / iso.name
-            os.makedirs(out_dir, exist_ok=True)
-            graph.save_gfa(out_dir / "input_assemblies.gfa", sequences)
-            M, w, ids = membership_matrix(graph, sequences)
-            compressed.append((iso, (sequences, ids), M, w))
-            del graph
-            # the CLI disables the cycle collector; each isolate's graph is
-            # reference-cyclic, so reclaim it explicitly or RSS grows by one
-            # full graph per isolate
-            gc.collect()
-        if errs.failed(iso.name):
-            manifest.fail(iso.name, str(errs.errors[iso.name].cause),
-                          stage="compress")
-        else:
-            manifest.advance(iso.name, "compress")
+    with stage_timer("batch/compress"):
+        for iso in todo:
+            manifest.start(iso.name)
+            log.message(f"Compressing isolate {iso.name}")
+            with trace.span(f"isolate/{iso.name}", cat="isolate",
+                            stage="compress"), errs.quarantine(iso.name):
+                from ..metrics import InputAssemblyMetrics
+                from ..utils.cache import open_cache
+                # warm-start caches live under the isolate's out dir, so a
+                # --resume (or repeat) run skips load+encode+repair for
+                # isolates whose inputs have not changed
+                sequences, _ = load_sequences(
+                    iso, k_size, InputAssemblyMetrics(), max_contigs, threads,
+                    cache=open_cache(out_parent / iso.name))
+                graph = build_unitig_graph(sequences, k_size, threads=threads)
+                simplify_structure(graph, sequences)
+                out_dir = out_parent / iso.name
+                os.makedirs(out_dir, exist_ok=True)
+                graph.save_gfa(out_dir / "input_assemblies.gfa", sequences)
+                M, w, ids = membership_matrix(graph, sequences)
+                compressed.append((iso, (sequences, ids), M, w))
+                del graph
+                # the CLI disables the cycle collector; each isolate's graph
+                # is reference-cyclic, so reclaim it explicitly or RSS grows
+                # by one full graph per isolate
+                gc.collect()
+            if errs.failed(iso.name):
+                manifest.fail(iso.name, str(errs.errors[iso.name].cause),
+                              stage="compress")
+            else:
+                manifest.advance(iso.name, "compress")
     log.message()
     if not compressed:
         raise AutocyclerError(
@@ -139,24 +143,27 @@ def batch(assemblies_parent, out_parent, k_size: int = 51,
     log.explanation("Isolates ride the mesh 'data' axis; the unitig axis is sharded over "
                     "'seq' and contracted with an integer matmul + psum, so every "
                     "isolate's matrix is exactly the single-isolate computation.")
-    mesh = make_mesh()
-    inters = batched_membership_intersections(
-        mesh, [c[2] for c in compressed], [c[3] for c in compressed])
+    with stage_timer("batch/distances"):
+        mesh = make_mesh()
+        inters = batched_membership_intersections(
+            mesh, [c[2] for c in compressed], [c[3] for c in compressed])
 
     # ---- per-isolate clustering (quarantined) ----
     clustered = []
-    for (iso, (sequences, ids), _, _), inter in zip(compressed, inters):
-        with errs.quarantine(iso.name):
-            distances = intersections_to_distances(inter, ids)
-            run_cluster(out_parent / iso.name, max_contigs=max_contigs,
-                        precomputed_distances=distances)
-            log.message(f"{iso.name}: {len(sequences)} contigs clustered")
-            clustered.append(iso)
-        if errs.failed(iso.name):
-            manifest.fail(iso.name, str(errs.errors[iso.name].cause),
-                          stage="cluster")
-        else:
-            manifest.advance(iso.name, "cluster")
+    with stage_timer("batch/cluster"):
+        for (iso, (sequences, ids), _, _), inter in zip(compressed, inters):
+            with trace.span(f"isolate/{iso.name}", cat="isolate",
+                            stage="cluster"), errs.quarantine(iso.name):
+                distances = intersections_to_distances(inter, ids)
+                run_cluster(out_parent / iso.name, max_contigs=max_contigs,
+                            precomputed_distances=distances)
+                log.message(f"{iso.name}: {len(sequences)} contigs clustered")
+                clustered.append(iso)
+            if errs.failed(iso.name):
+                manifest.fail(iso.name, str(errs.errors[iso.name].cause),
+                              stage="cluster")
+            else:
+                manifest.advance(iso.name, "cluster")
 
     log.section_header("Batched trim screen")
     log.explanation("Every isolate's trim overlap DPs (start-end + both hairpin "
@@ -171,22 +178,24 @@ def batch(assemblies_parent, out_parent, k_size: int = 51,
     from ..models import UnitigGraph
     iso_cluster_dirs = {}
     graphs = {}
-    for iso in clustered:
-        qc_pass = out_parent / iso.name / "clustering" / "qc_pass"
-        dirs = sorted(d for d in qc_pass.iterdir() if d.is_dir()) \
-            if qc_pass.is_dir() else []
-        with errs.quarantine(iso.name):
-            for cdir in dirs:
-                graphs[cdir] = UnitigGraph.from_gfa_file(cdir / "1_untrimmed.gfa")
-        if errs.failed(iso.name):
-            manifest.fail(iso.name, str(errs.errors[iso.name].cause),
-                          stage="trim")
-            for cdir in dirs:
-                graphs.pop(cdir, None)
-        else:
-            iso_cluster_dirs[iso.name] = dirs
-    cluster_dirs = [d for dirs in iso_cluster_dirs.values() for d in dirs]
-    screens = _batched_trim_screens(cluster_dirs, graphs, mesh=mesh)
+    with stage_timer("batch/trim_screen"):
+        for iso in clustered:
+            qc_pass = out_parent / iso.name / "clustering" / "qc_pass"
+            dirs = sorted(d for d in qc_pass.iterdir() if d.is_dir()) \
+                if qc_pass.is_dir() else []
+            with errs.quarantine(iso.name):
+                for cdir in dirs:
+                    graphs[cdir] = UnitigGraph.from_gfa_file(
+                        cdir / "1_untrimmed.gfa")
+            if errs.failed(iso.name):
+                manifest.fail(iso.name, str(errs.errors[iso.name].cause),
+                              stage="trim")
+                for cdir in dirs:
+                    graphs.pop(cdir, None)
+            else:
+                iso_cluster_dirs[iso.name] = dirs
+        cluster_dirs = [d for dirs in iso_cluster_dirs.values() for d in dirs]
+        screens = _batched_trim_screens(cluster_dirs, graphs, mesh=mesh)
     n_all = sum(len(s) for s in screens.values())
     n_dev = sum(isinstance(v, list) for s in screens.values()
                 for v in s.values())
@@ -197,27 +206,29 @@ def batch(assemblies_parent, out_parent, k_size: int = 51,
 
     # ---- per-isolate trim + resolve + combine (quarantined) ----
     completed = []
-    for iso in clustered:
-        if iso.name not in iso_cluster_dirs:
-            continue
-        with errs.quarantine(iso.name):
-            for cdir in iso_cluster_dirs[iso.name]:
-                trimmed = trim(cdir, dp_screen=screens[cdir],
-                               preloaded=graphs.pop(cdir))
-                resolve(cdir, preloaded=trimmed)
-                del trimmed   # reference-cyclic; drop before collecting
-                gc.collect()
-            qc_pass = out_parent / iso.name / "clustering" / "qc_pass"
-            finals = sorted(qc_pass.glob("cluster_*/5_final.gfa")) \
-                if qc_pass.is_dir() else []
-            if finals:
-                combine(out_parent / iso.name, finals)
-        if errs.failed(iso.name):
-            manifest.fail(iso.name, str(errs.errors[iso.name].cause),
-                          stage="finalise")
-        else:
-            manifest.done(iso.name)
-            completed.append(iso.name)
+    with stage_timer("batch/finalise"):
+        for iso in clustered:
+            if iso.name not in iso_cluster_dirs:
+                continue
+            with trace.span(f"isolate/{iso.name}", cat="isolate",
+                            stage="finalise"), errs.quarantine(iso.name):
+                for cdir in iso_cluster_dirs[iso.name]:
+                    trimmed = trim(cdir, dp_screen=screens[cdir],
+                                   preloaded=graphs.pop(cdir))
+                    resolve(cdir, preloaded=trimmed)
+                    del trimmed   # reference-cyclic; drop before collecting
+                    gc.collect()
+                qc_pass = out_parent / iso.name / "clustering" / "qc_pass"
+                finals = sorted(qc_pass.glob("cluster_*/5_final.gfa")) \
+                    if qc_pass.is_dir() else []
+                if finals:
+                    combine(out_parent / iso.name, finals)
+            if errs.failed(iso.name):
+                manifest.fail(iso.name, str(errs.errors[iso.name].cause),
+                              stage="finalise")
+            else:
+                manifest.done(iso.name)
+                completed.append(iso.name)
 
     log.section_header("Finished!")
     n_failed = len(errs)

@@ -24,6 +24,7 @@ from ..models.simplify import merge_linear_paths
 from ..ops.distance import pairwise_contig_distances
 from ..utils import (format_float, load_file_lines, log, median, quit_with_error,
                      usize_division_rounded)
+from ..utils.timing import stage_timer
 
 
 # ---------------- tree ----------------
@@ -723,8 +724,9 @@ def cluster(autocycler_dir, cutoff: float = 0.2, min_assemblies: Optional[int] =
                     "clusters the sequences based on their similarity. Ideally, each "
                     "cluster will then contain sequences which can be combined into a "
                     "consensus.")
-    gfa_lines = load_file_lines(gfa)
-    graph, sequences = UnitigGraph.from_gfa_lines(gfa_lines)
+    with stage_timer("cluster/load"):
+        gfa_lines = load_file_lines(gfa)
+        graph, sequences = UnitigGraph.from_gfa_lines(gfa_lines)
     min_asm = set_min_assemblies(min_assemblies, sequences)
     manual_clusters = parse_manual_clusters(manual)
 
@@ -740,25 +742,29 @@ def cluster(autocycler_dir, cutoff: float = 0.2, min_assemblies: Optional[int] =
     log.section_header("Pairwise distances")
     log.explanation("Every pairwise distance between contigs is calculated based on the "
                     "similarity of their paths through the graph.")
-    asym = precomputed_distances if precomputed_distances is not None else \
-        pairwise_contig_distances(graph, sequences, use_jax=use_jax)
-    save_distance_matrix(asym, sequences, clustering_dir / "pairwise_distances.phylip")
+    with stage_timer("cluster/distances"):
+        asym = precomputed_distances if precomputed_distances is not None else \
+            pairwise_contig_distances(graph, sequences, use_jax=use_jax)
+        save_distance_matrix(asym, sequences,
+                             clustering_dir / "pairwise_distances.phylip")
 
     log.section_header("Clustering sequences")
     log.explanation("Contigs are organised into a tree using UPGMA. Then clusters are "
                     "defined from the tree using the distance cutoff.")
-    sym = make_symmetrical_distances(asym, sequences)
-    tree = upgma(sym, sequences)
-    normalise_tree(tree)
-    save_tree_to_newick(tree, sequences, clustering_dir / "clustering.newick")
+    with stage_timer("cluster/tree"):
+        sym = make_symmetrical_distances(asym, sequences)
+        tree = upgma(sym, sequences)
+        normalise_tree(tree)
+        save_tree_to_newick(tree, sequences, clustering_dir / "clustering.newick")
 
-    qc_results = generate_clusters(tree, sequences, asym, cutoff, min_asm,
-                                   manual_clusters)
-    handoff = save_clusters(sequences, qc_results, clustering_dir, graph,
-                            collect_handoff=collect_handoff)
-    save_data_to_tsv(sequences, qc_results, clustering_dir / "clustering.tsv")
-    clustering_metrics(sequences, qc_results).save_to_yaml(
-        clustering_dir / "clustering.yaml")
+        qc_results = generate_clusters(tree, sequences, asym, cutoff, min_asm,
+                                       manual_clusters)
+    with stage_timer("cluster/outputs"):
+        handoff = save_clusters(sequences, qc_results, clustering_dir, graph,
+                                collect_handoff=collect_handoff)
+        save_data_to_tsv(sequences, qc_results, clustering_dir / "clustering.tsv")
+        clustering_metrics(sequences, qc_results).save_to_yaml(
+            clustering_dir / "clustering.yaml")
 
     log.section_header("Finished!")
     log.explanation("You can now run autocycler trim on each cluster.")

@@ -23,6 +23,7 @@ from ..ops.align import (global_alignment_distance,
                          global_alignment_distance_batch)
 from ..utils import (load_file_lines, log, quit_with_error, reverse_signed_path,
                      sign_at_end, sign_at_end_vec)
+from ..utils.timing import stage_timer
 
 
 class Bridge:
@@ -311,56 +312,60 @@ def resolve(cluster_dir, verbose: bool = False, preloaded=None) -> None:
 
     log.section_header("Starting autocycler resolve")
     log.explanation("This command resolves repeats in the unitig graph.")
-    if preloaded is not None:
-        graph, sequences = preloaded
-        gfa_lines = None
-        graph.check_links()   # the file path validates at parse; match it
-    else:
-        gfa_lines = load_file_lines(trimmed_gfa)
-        graph, sequences = UnitigGraph.from_gfa_lines(gfa_lines)
+    with stage_timer("resolve/load"):
+        if preloaded is not None:
+            graph, sequences = preloaded
+            gfa_lines = None
+            graph.check_links()   # the file path validates at parse; match it
+        else:
+            gfa_lines = load_file_lines(trimmed_gfa)
+            graph, sequences = UnitigGraph.from_gfa_lines(gfa_lines)
     graph.print_basic_graph_info()
 
     log.section_header("Finding anchor unitigs")
     log.explanation("Anchor unitigs are those that occur once and only once in each "
                     "sequence. They will definitely be present in the final sequence and "
                     "will serve as the connection points for bridges.")
-    anchors = find_anchor_unitigs(graph, sequences)
+    with stage_timer("resolve/anchors"):
+        anchors = find_anchor_unitigs(graph, sequences)
 
     log.section_header("Building bridges")
     log.explanation("Bridges connect one anchor unitig to the next.")
-    bridges = create_bridges(graph, sequences, anchors, verbose)
-    bridge_count = len(bridges)
-    bridge_depth = float(len(sequences))
-    determine_ambiguity(bridges)
+    with stage_timer("resolve/bridges"):
+        bridges = create_bridges(graph, sequences, anchors, verbose)
+        bridge_count = len(bridges)
+        bridge_depth = float(len(sequences))
+        determine_ambiguity(bridges)
     print_bridges(bridges, verbose)
 
     log.section_header("Applying unique bridges")
     log.explanation("All unique bridges (those that do not conflict with other bridges) "
                     "are now applied to the graph, with linear paths merged to create "
                     "consentigs.")
-    apply_bridges(graph, bridges, bridge_depth)
-    graph.save_gfa(cluster_dir / "3_bridged.gfa", [])
-    merge_after_bridging(graph)
-    graph.save_gfa(cluster_dir / "4_merged.gfa", [])
-
-    cull_count = cull_ambiguity(bridges, verbose)
-    if cull_count > 0:
-        if gfa_lines is None:   # preloaded graph was mutated; re-read the file
-            gfa_lines = load_file_lines(trimmed_gfa)
-        graph, _ = UnitigGraph.from_gfa_lines(gfa_lines)
-        for num in anchors:
-            graph.index[num].unitig_type = UnitigType.ANCHOR
-        log.section_header("Applying final bridges")
-        log.explanation("Now that conflicting bridges have been removed, bridges are "
-                        "applied one more time to create the final graph.")
+    with stage_timer("resolve/apply"):
         apply_bridges(graph, bridges, bridge_depth)
+        graph.save_gfa(cluster_dir / "3_bridged.gfa", [])
         merge_after_bridging(graph)
-    elif bridge_count > 0:
-        log.message("All bridges were unique, no culling necessary.")
-        log.message()
+        graph.save_gfa(cluster_dir / "4_merged.gfa", [])
 
-    final_gfa = cluster_dir / "5_final.gfa"
-    graph.save_gfa(final_gfa, [], use_other_colour=True)
+        cull_count = cull_ambiguity(bridges, verbose)
+        if cull_count > 0:
+            if gfa_lines is None:  # preloaded graph was mutated; re-read
+                gfa_lines = load_file_lines(trimmed_gfa)
+            graph, _ = UnitigGraph.from_gfa_lines(gfa_lines)
+            for num in anchors:
+                graph.index[num].unitig_type = UnitigType.ANCHOR
+            log.section_header("Applying final bridges")
+            log.explanation("Now that conflicting bridges have been removed, bridges are "
+                            "applied one more time to create the final graph.")
+            apply_bridges(graph, bridges, bridge_depth)
+            merge_after_bridging(graph)
+        elif bridge_count > 0:
+            log.message("All bridges were unique, no culling necessary.")
+            log.message()
+
+        final_gfa = cluster_dir / "5_final.gfa"
+        graph.save_gfa(final_gfa, [], use_other_colour=True)
     log.section_header("Finished!")
     log.message(f"Final consensus graph: {final_gfa}")
     log.message()

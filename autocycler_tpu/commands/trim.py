@@ -22,6 +22,7 @@ from ..models.simplify import merge_linear_paths
 from ..ops.align import GAP, Weights, find_midpoint, overlap_alignment
 from ..utils import (check_threads, log, mad as mad_fn, map_threaded, median,
                      quit_with_error, reverse_signed_path)
+from ..utils.timing import stage_timer
 
 TrimResult = Optional[Tuple[List[int], int]]
 
@@ -72,30 +73,35 @@ def trim(cluster_dir, min_identity: float = 0.75, max_unitigs: int = 5000,
                     "cluster) and trims any overlaps. It looks for both start-end overlaps "
                     "(can occur with circular sequences) and hairpin overlaps (can occur "
                     "with linear sequences).")
-    graph, sequences = preloaded if preloaded is not None else \
-        UnitigGraph.from_gfa_file(untrimmed_gfa)
-    graph.print_basic_graph_info()
-    # dense number -> length array: scalar indexing works like the dict and
-    # the alignment kernels can gather whole paths in one vector op
-    max_num = max((u.number for u in graph.unitigs), default=0)
-    weights = np.zeros(max_num + 1, dtype=np.int64)
-    for u in graph.unitigs:
-        weights[u.number] = u.length()
+    with stage_timer("trim/load"):
+        graph, sequences = preloaded if preloaded is not None else \
+            UnitigGraph.from_gfa_file(untrimmed_gfa)
+        graph.print_basic_graph_info()
+        # dense number -> length array: scalar indexing works like the dict
+        # and the alignment kernels can gather whole paths in one vector op
+        max_num = max((u.number for u in graph.unitigs), default=0)
+        weights = np.zeros(max_num + 1, dtype=np.int64)
+        for u in graph.unitigs:
+            weights[u.number] = u.length()
 
-    # one path query serves both trimming passes (the graph is unchanged
-    # until choose_trim_type applies the results)
-    all_paths = graph.get_unitig_paths_for_sequences([s.id for s in sequences]) \
-        if max_unitigs else {}
-    start_end = trim_start_end_overlap(graph, sequences, weights, min_identity,
+        # one path query serves both trimming passes (the graph is unchanged
+        # until choose_trim_type applies the results)
+        all_paths = graph.get_unitig_paths_for_sequences(
+            [s.id for s in sequences]) if max_unitigs else {}
+    with stage_timer("trim/overlaps"):
+        start_end = trim_start_end_overlap(graph, sequences, weights,
+                                           min_identity, max_unitigs,
+                                           all_paths, threads, dp_screen)
+        hairpin = trim_hairpin_overlap(graph, sequences, weights, min_identity,
                                        max_unitigs, all_paths, threads,
                                        dp_screen)
-    hairpin = trim_hairpin_overlap(graph, sequences, weights, min_identity,
-                                   max_unitigs, all_paths, threads, dp_screen)
-    sequences = choose_trim_type(start_end, hairpin, graph, sequences)
-    sequences = exclude_outliers_in_length(graph, sequences, mad)
-    clean_up_graph(graph, sequences)
-    graph.save_gfa(trimmed_gfa, sequences)
-    TrimmedClusterMetrics.new([s.length for s in sequences]).save_to_yaml(trimmed_yaml)
+        sequences = choose_trim_type(start_end, hairpin, graph, sequences)
+    with stage_timer("trim/outputs"):
+        sequences = exclude_outliers_in_length(graph, sequences, mad)
+        clean_up_graph(graph, sequences)
+        graph.save_gfa(trimmed_gfa, sequences)
+        TrimmedClusterMetrics.new(
+            [s.length for s in sequences]).save_to_yaml(trimmed_yaml)
     log.section_header("Finished!")
     log.message(f"Unitig graph of trimmed sequences: {trimmed_gfa}")
     log.message()

@@ -1,0 +1,17 @@
+"""Run telemetry: span tracing, the metrics registry, memory sampling and
+the `autocycler report` renderer.
+
+The pipeline's observability fragments (utils.timing accumulators,
+utils.cache hit counters, utils.resilience degrade events, bench
+artifacts) all write through this package, so one run directory — driven
+by ``AUTOCYCLER_TRACE_DIR`` — answers "what did this run spend its time
+and memory on, and what degraded?". See docs/observability.md.
+"""
+
+from . import metrics_registry, trace
+from .memory import memory_sample
+from .metrics_registry import (MetricsRegistry, counter_inc, gauge_set,
+                               info_set, observe, registry, snapshot,
+                               to_prometheus)
+from .trace import (current_span, finish_run, maybe_start_run, span,
+                    start_run, tracing_active)

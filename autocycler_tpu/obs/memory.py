@@ -1,0 +1,72 @@
+"""Lightweight memory sampling for top-level trace spans.
+
+Three sources, each best-effort (a missing source is simply absent from the
+sample — telemetry never fails the pipeline):
+
+- peak RSS from ``resource.getrusage`` (ru_maxrss is KiB on Linux);
+- current RSS from ``/proc/self/statm`` (page count x page size);
+- JAX device/live-buffer bytes — only when jax is ALREADY imported
+  (``sys.modules`` check: sampling must never be the thing that pays jax
+  startup), preferring per-device ``memory_stats()`` (real TPU allocator
+  numbers) and falling back to summing ``jax.live_arrays()``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def memory_sample() -> dict:
+    out: dict = {}
+    try:
+        import resource
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        scale = 1024 if sys.platform != "darwin" else 1
+        out["peak_rss_bytes"] = int(ru.ru_maxrss) * scale
+    except Exception:  # noqa: BLE001 — absent source == absent field
+        pass
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        out["rss_bytes"] = pages * os.sysconf("SC_PAGE_SIZE")
+    except Exception:  # noqa: BLE001
+        pass
+    out.update(jax_memory_sample())
+    return out
+
+
+def jax_memory_sample() -> dict:
+    """Device-side memory evidence, only when jax is already live."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return {}
+    try:
+        # jax.local_devices() would INITIALIZE the backend on first call —
+        # a sampling probe must never pay (or hang on) device bring-up, so
+        # only read stats when a backend already exists.
+        from jax._src import xla_bridge
+        if not xla_bridge._backends:
+            return {}
+    except Exception:  # noqa: BLE001 — private API moved: skip device stats
+        return {}
+    out: dict = {}
+    try:
+        stats = {}
+        for dev in jax.local_devices():
+            s = getattr(dev, "memory_stats", lambda: None)()
+            if s and "bytes_in_use" in s:
+                stats[str(dev.id)] = int(s["bytes_in_use"])
+        if stats:
+            out["device_bytes_in_use"] = sum(stats.values())
+    except Exception:  # noqa: BLE001 — backends without allocator stats
+        pass
+    if "device_bytes_in_use" not in out:
+        try:
+            live = jax.live_arrays()
+            out["jax_live_buffer_bytes"] = int(sum(
+                getattr(a, "nbytes", 0) for a in live))
+            out["jax_live_buffers"] = len(live)
+        except Exception:  # noqa: BLE001
+            pass
+    return out

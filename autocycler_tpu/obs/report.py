@@ -1,0 +1,337 @@
+"""`autocycler report <dir>`: merge one run's telemetry into a readable
+report.
+
+Inputs, all optional except that at least one must exist in the directory:
+
+- ``trace.jsonl`` (obs.trace) — the span stream: rendered as a nested stage
+  tree with durations, call counts, share-of-parent percentages and the
+  memory samples attached to top-level spans;
+- ``metrics.json`` (metrics-registry snapshot) — rendered as the
+  device-vs-host split, cache hit/miss summary, degradation/fault/retry
+  summary and pool counters;
+- ``batch_manifest.json`` (utils.resilience.RunManifest) — per-isolate
+  status lines;
+- ``BENCH*.json`` bench artifacts — one summary line each.
+
+``--json`` emits the merged structure as one JSON document instead.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from .trace import METRICS_JSON, TRACE_JSONL
+
+# report total vs recorded wall-clock agreement gate (the acceptance bar:
+# a stage tree that disagrees with the wall by more than this is reported
+# loudly — it means spans are missing or double-counted)
+WALL_AGREEMENT = 0.05
+
+
+def _fmt_s(seconds: float) -> str:
+    if seconds >= 60:
+        m, s = divmod(seconds, 60.0)
+        return f"{int(m)}m{s:04.1f}s"
+    if seconds >= 0.9995:
+        return f"{seconds:.2f}s"
+    return f"{seconds * 1000:.1f}ms"
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:.1f} TiB"
+
+
+def load_trace(path) -> Optional[dict]:
+    """Parse a trace.jsonl into {"run": header, "spans": [...], "finish":
+    footer-or-None}. Unparseable lines are skipped (a killed run can leave
+    a torn final line)."""
+    path = Path(path)
+    if not path.is_file():
+        return None
+    run = finish = None
+    spans: List[dict] = []
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        kind = rec.get("type")
+        if kind == "run":
+            run = rec
+        elif kind == "finish":
+            finish = rec
+        elif kind == "span":
+            spans.append(rec)
+    return {"run": run or {}, "spans": spans, "finish": finish}
+
+
+def span_tree(spans: List[dict]) -> List[dict]:
+    """Aggregate the flat span stream into a nested tree: siblings with the
+    same (name, cat) merge into one node carrying summed duration, call
+    count, earliest start and the last memory sample seen. Children order
+    is earliest-start first (pipeline order)."""
+    by_parent: Dict[Optional[int], List[dict]] = {}
+    for s in spans:
+        by_parent.setdefault(s.get("parent"), []).append(s)
+
+    def build(parent_ids: List[Optional[int]]) -> List[dict]:
+        kids = [s for pid in parent_ids for s in by_parent.get(pid, [])]
+        groups: Dict[tuple, dict] = {}
+        for s in kids:
+            g = groups.setdefault((s["name"], s.get("cat", "")), {
+                "name": s["name"], "cat": s.get("cat", ""),
+                "seconds": 0.0, "count": 0, "first_ts": s.get("ts", 0.0),
+                "mem": None, "ids": []})
+            g["seconds"] += s.get("dur", 0.0)
+            g["count"] += 1
+            g["first_ts"] = min(g["first_ts"], s.get("ts", 0.0))
+            g["ids"].append(s.get("id"))
+            if "mem" in s:
+                g["mem"] = s["mem"]
+        nodes = sorted(groups.values(), key=lambda g: g["first_ts"])
+        for node in nodes:
+            node["children"] = build(node.pop("ids"))
+            node["seconds"] = round(node["seconds"], 6)
+            del node["first_ts"]
+        return nodes
+
+    return build([None])
+
+
+def _render_tree(nodes: List[dict], lines: List[str], depth: int = 0,
+                 parent_seconds: Optional[float] = None) -> None:
+    for node in nodes:
+        pct = ""
+        if parent_seconds and parent_seconds > 0:
+            pct = f"  {100.0 * node['seconds'] / parent_seconds:5.1f}%"
+        count = f"  x{node['count']}" if node["count"] > 1 else ""
+        label = f"{'  ' * depth}{node['name']}"
+        lines.append(f"  {label:<44} {_fmt_s(node['seconds']):>9}"
+                     f"{pct}{count}")
+        if node.get("mem"):
+            mem = node["mem"]
+            bits = []
+            if "peak_rss_bytes" in mem:
+                bits.append(f"peak RSS {_fmt_bytes(mem['peak_rss_bytes'])}")
+            if "device_bytes_in_use" in mem:
+                bits.append(
+                    f"device {_fmt_bytes(mem['device_bytes_in_use'])}")
+            elif "jax_live_buffer_bytes" in mem:
+                bits.append(f"jax live "
+                            f"{_fmt_bytes(mem['jax_live_buffer_bytes'])}")
+            if bits:
+                lines.append(f"  {'  ' * depth}  [{'; '.join(bits)}]")
+        _render_tree(node["children"], lines, depth + 1, node["seconds"])
+
+
+def _metric_values(snapshot: dict, name: str) -> List[dict]:
+    return snapshot.get(name, {}).get("values", [])
+
+
+def _metric_total(snapshot: dict, name: str) -> float:
+    return sum(v.get("value", 0) for v in _metric_values(snapshot, name)
+               if isinstance(v.get("value"), (int, float)))
+
+
+def _metric_by_label(snapshot: dict, name: str, label: str) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for v in _metric_values(snapshot, name):
+        key = v.get("labels", {}).get(label)
+        if key is not None and isinstance(v.get("value"), (int, float)):
+            out[key] = out.get(key, 0) + v["value"]
+    return out
+
+
+def build_report(run_dir) -> Optional[dict]:
+    """The merged report structure, or None when the directory holds no
+    telemetry at all."""
+    run_dir = Path(run_dir)
+    trace = load_trace(run_dir / TRACE_JSONL)
+    metrics = None
+    metrics_path = run_dir / METRICS_JSON
+    if metrics_path.is_file():
+        try:
+            metrics = json.loads(metrics_path.read_text())
+        except (OSError, json.JSONDecodeError):
+            metrics = None
+    manifest = None
+    manifest_path = run_dir / "batch_manifest.json"
+    if manifest_path.is_file():
+        try:
+            manifest = json.loads(manifest_path.read_text())
+        except (OSError, json.JSONDecodeError):
+            manifest = None
+    bench: List[dict] = []
+    for path in sorted(run_dir.glob("BENCH*.json")) + \
+            sorted(run_dir.glob("bench*.json")):
+        try:
+            data = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError):
+            continue
+        if isinstance(data, dict):
+            bench.append({"file": path.name, **data})
+    if trace is None and metrics is None and manifest is None and not bench:
+        return None
+    report: dict = {"dir": str(run_dir)}
+    if trace is not None:
+        tree = span_tree(trace["spans"])
+        total = round(sum(n["seconds"] for n in tree), 6)
+        report["trace"] = {
+            "run": trace["run"], "finish": trace["finish"],
+            "span_count": len(trace["spans"]),
+            "tree": tree, "tree_total_s": total,
+        }
+        wall = (trace["finish"] or {}).get("wall")
+        if isinstance(wall, (int, float)) and wall > 0:
+            report["trace"]["wall_s"] = wall
+            report["trace"]["wall_agreement"] = round(total / wall, 4)
+    if metrics is not None:
+        report["metrics"] = metrics
+    if manifest is not None:
+        report["manifest"] = manifest
+    if bench:
+        report["bench"] = bench
+    return report
+
+
+def render_report(report: dict) -> str:
+    lines: List[str] = []
+    run_dir = report.get("dir", "")
+    lines.append(f"Autocycler run report — {run_dir}")
+    trace = report.get("trace")
+    if trace:
+        header = trace.get("run") or {}
+        name = header.get("name", "?")
+        argv = header.get("argv")
+        lines.append(f"Command: {name}" +
+                     (f"  ({' '.join(argv)})" if argv else ""))
+        wall = trace.get("wall_s")
+        total = trace.get("tree_total_s", 0.0)
+        summary = (f"Spans: {trace.get('span_count', 0)}"
+                   f"  stage-tree total {_fmt_s(total)}")
+        if wall:
+            summary += f"  wall {_fmt_s(wall)}"
+            agreement = trace.get("wall_agreement", 0.0)
+            if abs(agreement - 1.0) > WALL_AGREEMENT:
+                summary += (f"  [WARNING: tree covers {agreement * 100:.1f}%"
+                            " of wall — spans missing or double-counted]")
+        lines.append(summary)
+        lines.append("")
+        lines.append("Stage tree:")
+        _render_tree(trace.get("tree", []), lines,
+                     parent_seconds=wall or total)
+        finish = trace.get("finish") or {}
+        mem = finish.get("mem") or {}
+        if mem.get("peak_rss_bytes"):
+            lines.append(f"  peak RSS at finish: "
+                         f"{_fmt_bytes(mem['peak_rss_bytes'])}")
+        lines.append("")
+    metrics = report.get("metrics")
+    if metrics:
+        dev_s = _metric_total(metrics, "autocycler_device_seconds_total")
+        dispatches = _metric_total(metrics,
+                                   "autocycler_device_dispatches_total")
+        failures = _metric_total(metrics, "autocycler_device_failures_total")
+        wall = (trace or {}).get("wall_s")
+        split = (f"Device vs host: {_fmt_s(dev_s)} on device across "
+                 f"{int(dispatches)} dispatch"
+                 f"{'es' if dispatches != 1 else ''}")
+        if wall:
+            split += f" ({100.0 * dev_s / wall:.1f}% of wall)"
+        split += f"; {int(failures)} failure{'s' if failures != 1 else ''}"
+        lines.append(split)
+        for v in _metric_values(metrics, "autocycler_device_failure_last"):
+            if v.get("value"):
+                lines.append(f"  last device failure: {v['value']}")
+        cache = _metric_by_label(metrics, "autocycler_cache_events_total",
+                                 "cache")
+        if cache:
+            bits = []
+            for which in sorted(cache):
+                hits = misses = 0
+                for v in _metric_values(metrics,
+                                        "autocycler_cache_events_total"):
+                    labels = v.get("labels", {})
+                    if labels.get("cache") == which:
+                        if labels.get("event") == "hit":
+                            hits = int(v.get("value", 0))
+                        elif labels.get("event") == "miss":
+                            misses = int(v.get("value", 0))
+                bits.append(f"{which} {hits} hit{'s' if hits != 1 else ''}"
+                            f" / {misses} miss"
+                            f"{'es' if misses != 1 else ''}")
+            lines.append("Caches: " + " · ".join(bits))
+        degrades = _metric_by_label(metrics, "autocycler_degrades_total",
+                                    "chain")
+        if degrades:
+            lines.append("Degradations: " + ", ".join(
+                f"{chain} x{int(n)}" for chain, n in sorted(degrades.items())))
+        else:
+            lines.append("Degradations: none recorded")
+        faults = _metric_by_label(metrics, "autocycler_fault_injections_total",
+                                  "site")
+        if faults:
+            lines.append("Fault injections: " + ", ".join(
+                f"{site} x{int(n)}" for site, n in sorted(faults.items())))
+        retries = _metric_by_label(
+            metrics, "autocycler_subprocess_retries_total", "command")
+        if retries:
+            lines.append("Subprocess retries: " + ", ".join(
+                f"{cmd} x{int(n)}" for cmd, n in sorted(retries.items())))
+        pool = _metric_total(metrics, "autocycler_pool_tasks_total")
+        if pool:
+            lines.append(f"Pool tasks: {int(pool)}")
+        lines.append("")
+    manifest = report.get("manifest")
+    if manifest:
+        items = manifest.get("items", {})
+        counts: Dict[str, int] = {}
+        for entry in items.values():
+            counts[entry.get("status", "?")] = \
+                counts.get(entry.get("status", "?"), 0) + 1
+        summary = ", ".join(f"{n} {status}"
+                            for status, n in sorted(counts.items()))
+        lines.append(f"Isolates ({len(items)}): {summary}")
+        for name in sorted(items):
+            entry = items[name]
+            if entry.get("status") == "failed":
+                stage = entry.get("stage") or "?"
+                lines.append(f"  FAILED {name} (stage {stage}): "
+                             f"{entry.get('error')}")
+        lines.append("")
+    for artifact in report.get("bench", []):
+        if "metric" in artifact:
+            line = (f"Bench {artifact['file']}: {artifact['metric']} = "
+                    f"{artifact.get('value')} {artifact.get('unit', '')}")
+            if artifact.get("vs_baseline"):
+                line += f" (vs_baseline {artifact['vs_baseline']})"
+            lines.append(line.rstrip())
+        elif "bench" in artifact:
+            lines.append(f"Bench {artifact['file']}: {artifact['bench']} "
+                         f"passed={artifact.get('passed')}")
+    return "\n".join(lines).rstrip() + "\n"
+
+
+def report(run_dir, as_json: bool = False) -> int:
+    """CLI entry point for `autocycler report`."""
+    built = build_report(run_dir)
+    if built is None:
+        print(f"Error: no telemetry found in {run_dir} (expected "
+              f"{TRACE_JSONL}, {METRICS_JSON}, batch_manifest.json or "
+              "BENCH*.json)", file=sys.stderr)
+        return 1
+    if as_json:
+        print(json.dumps(built, indent=2, sort_keys=True))
+    else:
+        print(render_report(built))
+    return 0

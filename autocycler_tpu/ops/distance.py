@@ -414,7 +414,7 @@ def pairwise_distance_matrix(M: np.ndarray, w: np.ndarray,
             from ..utils.timing import record_device_failure
             what = (f"device distance matmul failed "
                     f"({type(e).__name__}: {e})")
-            record_device_failure(what)
+            record_device_failure(what, exc=e)
             print(f"autocycler: {what}; falling back to host matmul",
                   file=sys.stderr)
             inter = Mw @ M.astype(np.int64).T

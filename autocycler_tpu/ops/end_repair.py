@@ -178,7 +178,7 @@ def sequence_end_repair(sequences: List[Sequence], k_size: int,
             from ..utils.timing import record_device_failure
             what = (f"device end-repair grouping failed "
                     f"({type(e).__name__}: {e})")
-            record_device_failure(what)
+            record_device_failure(what, exc=e)
             print(f"autocycler: {what}; falling back to host backend",
                   file=sys.stderr)
     if by_query is None:

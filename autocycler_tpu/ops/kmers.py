@@ -738,7 +738,7 @@ def group_windows_full(codes: np.ndarray, starts: np.ndarray, k: int,
             from ..utils.timing import record_device_failure
             what = (f"device k-mer grouping failed "
                     f"({type(e).__name__}: {e})")
-            record_device_failure(what)
+            record_device_failure(what, exc=e)
             print(f"autocycler: {what}; falling back to host backend",
                   file=sys.stderr)
     workers = _effective_workers(_resolve_threads(threads))

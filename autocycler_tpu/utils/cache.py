@@ -28,26 +28,37 @@ import io
 import json
 import os
 import tempfile
-import threading
 from pathlib import Path
 from typing import List, Optional, Tuple
 
 import numpy as np
 
-# process-wide hit/miss accounting, inspectable by tests and artifacts
-_stats_lock = threading.Lock()
-_stats = {"parse_hits": 0, "parse_misses": 0,
-          "repair_hits": 0, "repair_misses": 0}
+from ..obs import metrics_registry
+
+# process-wide hit/miss accounting lives in the metrics registry
+# (obs.metrics_registry), inspectable by tests, artifacts and
+# `autocycler report` alike
+CACHE_EVENTS = "autocycler_cache_events_total"
 
 
 def cache_stats() -> dict:
-    with _stats_lock:
-        return dict(_stats)
+    """{"parse_hits": n, "parse_misses": n, "repair_hits": n,
+    "repair_misses": n} — the legacy view over the registry's
+    cache-event counters."""
+    reg = metrics_registry.registry()
+    out = {}
+    for which in ("parse", "repair"):
+        for event, suffix in (("hit", "hits"), ("miss", "misses")):
+            out[f"{which}_{suffix}"] = int(
+                reg.value(CACHE_EVENTS, cache=which, event=event))
+    return out
 
 
 def _count(key: str) -> None:
-    with _stats_lock:
-        _stats[key] += 1
+    which, event = key.rsplit("_", 1)
+    metrics_registry.counter_inc(
+        CACHE_EVENTS, 1, help="warm-start cache hits/misses",
+        cache=which, event={"hits": "hit", "misses": "miss"}[event])
 
 
 def cache_enabled() -> bool:

@@ -1,13 +1,23 @@
 """stderr logging: timestamped section headers and dimmed explanations.
 
 Parity target: reference log.rs:18-44 (bold/underline headers with timestamp,
-wrapped dim explanation text). Colour is suppressed when stderr is not a TTY.
+wrapped dim explanation text). Colour control follows the informal standard:
+suppressed when stderr is not a TTY, force-disabled by a non-empty
+``NO_COLOR`` (https://no-color.org/), force-enabled by a non-empty
+``FORCE_COLOR`` (NO_COLOR wins when both are set).
+
+``AUTOCYCLER_LOG_JSON=1`` switches every record (section headers,
+explanations, messages) to one JSONL object per line on stderr —
+``{"ts": <iso8601>, "type": "section"|"explanation"|"message",
+"text": ...}`` — so log scrapers parse runs without regexing ANSI codes.
 """
 
 from __future__ import annotations
 
 import contextlib
 import datetime
+import json
+import os
 import sys
 import textwrap
 
@@ -18,7 +28,23 @@ RESET = "\033[0m"
 
 
 def _colour_enabled() -> bool:
+    if os.environ.get("NO_COLOR"):       # the no-color.org contract: any
+        return False                     # non-empty value disables colour
+    if os.environ.get("FORCE_COLOR"):
+        return True
     return sys.stderr.isatty()
+
+
+def _json_mode() -> bool:
+    return os.environ.get("AUTOCYCLER_LOG_JSON", "").strip().lower() \
+        in ("1", "true", "yes", "on")
+
+
+def _emit_json(record_type: str, text: str) -> None:
+    record = {"ts": datetime.datetime.now().isoformat(timespec="seconds"),
+              "type": record_type, "text": text}
+    with _spinner_guard():
+        print(json.dumps(record), file=sys.stderr)
 
 
 @contextlib.contextmanager
@@ -33,6 +59,9 @@ def _spinner_guard():
 
 
 def section_header(text: str) -> None:
+    if _json_mode():
+        _emit_json("section", text)
+        return
     timestamp = datetime.datetime.now().strftime("%Y-%m-%d %H:%M:%S")
     with _spinner_guard():
         if _colour_enabled():
@@ -43,6 +72,9 @@ def section_header(text: str) -> None:
 
 
 def explanation(text: str) -> None:
+    if _json_mode():
+        _emit_json("explanation", " ".join(text.split()))
+        return
     wrapped = textwrap.fill(" ".join(text.split()), width=80)
     with _spinner_guard():
         if _colour_enabled():
@@ -53,5 +85,9 @@ def explanation(text: str) -> None:
 
 
 def message(text: str = "") -> None:
+    if _json_mode():
+        if text:                 # blank spacer lines are formatting, not
+            _emit_json("message", text)   # records — skip them in JSONL
+        return
     with _spinner_guard():
         print(text, file=sys.stderr)

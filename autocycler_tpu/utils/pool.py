@@ -21,9 +21,19 @@ from typing import Callable, Iterable, List, Optional, Sequence
 
 import numpy as np
 
+from ..obs import metrics_registry
+
+POOL_TASKS = "autocycler_pool_tasks_total"
+
 _lock = threading.Lock()
 _executor = None
 _executor_width = 0
+
+
+def _count_tasks(n: int, kind: str) -> None:
+    metrics_registry.counter_inc(
+        POOL_TASKS, n, help="tasks submitted to the shared worker pool",
+        kind=kind)
 
 
 def get_executor(workers: int):
@@ -53,6 +63,7 @@ def pool_map(fn: Callable, items: Iterable, workers: int) -> List:
     items = list(items)
     if workers <= 1 or len(items) <= 1:
         return [fn(x) for x in items]
+    _count_tasks(len(items), "map")
     return list(get_executor(workers).map(fn, items))
 
 
@@ -82,6 +93,7 @@ def parallel_gather(src: np.ndarray, idx: np.ndarray, workers: int,
         lo, hi = bounds
         np.take(src, idx[lo:hi], out=out[lo:hi])
 
+    _count_tasks(len(jobs), "gather")
     list(get_executor(workers).map(one, jobs))
     return out
 
@@ -94,6 +106,7 @@ def parallel_bincount(arr: np.ndarray, minlength: int,
     jobs = _chunk_bounds(n, workers)
     if workers <= 1 or len(jobs) <= 1:
         return np.bincount(arr, minlength=minlength)
+    _count_tasks(len(jobs), "bincount")
     parts = get_executor(workers).map(
         lambda b: np.bincount(arr[b[0]:b[1]], minlength=minlength), jobs)
     total = np.zeros(minlength, np.int64)

@@ -43,7 +43,18 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..obs import metrics_registry
 from .misc import AutocyclerError
+
+# registry metric names (obs.metrics_registry): resilience events are
+# counted process-wide so bench artifacts and `autocycler report` can
+# answer "what degraded / retried / was injected?" without scraping stderr
+DEGRADES_TOTAL = "autocycler_degrades_total"
+FAULT_INJECTIONS_TOTAL = "autocycler_fault_injections_total"
+SUBPROCESS_RUNS_TOTAL = "autocycler_subprocess_runs_total"
+SUBPROCESS_RETRIES_TOTAL = "autocycler_subprocess_retries_total"
+SUBPROCESS_FAILURES_TOTAL = "autocycler_subprocess_failures_total"
+QUARANTINED_TOTAL = "autocycler_quarantined_items_total"
 
 # ---------------------------------------------------------------------------
 # Error taxonomy
@@ -117,6 +128,9 @@ class ErrorCollector:
             err = IsolateError(item, e)
             log.message(f"WARNING: {err} — skipping")
             self.errors[item] = err
+            metrics_registry.counter_inc(
+                QUARANTINED_TOTAL, 1,
+                help="per-item failures quarantined instead of aborting")
 
     def failed(self, item: str) -> bool:
         return item in self.errors
@@ -198,6 +212,10 @@ class FaultPlan:
             if rule.site == site and not rule.exhausted() \
                     and rule.match in str(key):
                 rule.fired += 1
+                metrics_registry.counter_inc(
+                    FAULT_INJECTIONS_TOTAL, 1,
+                    help="deterministic fault-injection rule firings",
+                    site=site, mode=rule.mode)
                 return rule
         return None
 
@@ -335,7 +353,19 @@ def run_command(cmd: List[str], stdout_file=None, cwd=None,
     cmd = [str(c) for c in cmd]
     attempts = retries + 1
     last_error: Optional[SubprocessError] = None
+    metrics_registry.counter_inc(
+        SUBPROCESS_RUNS_TOTAL, 1, help="run_command invocations",
+        command=os.path.basename(cmd[0]))
+    from ..obs import trace
+    with trace.span(f"subprocess {os.path.basename(cmd[0])}",
+                    cat="subprocess", command=cmd[0]):
+        return _run_command_attempts(cmd, stdout_file, cwd, timeout,
+                                     retries, backoff, sleep, attempts,
+                                     last_error)
 
+
+def _run_command_attempts(cmd, stdout_file, cwd, timeout, retries, backoff,
+                          sleep, attempts, last_error) -> int:
     for attempt in range(1, attempts + 1):
         run_cmd = cmd
         rule = fault_fire("subprocess", cmd[0])
@@ -390,12 +420,20 @@ def run_command(cmd: List[str], stdout_file=None, cwd=None,
                 pass
         last_error = SubprocessError(cmd, returncode, attempt, tail, reason)
         if attempt < attempts:
+            metrics_registry.counter_inc(
+                SUBPROCESS_RETRIES_TOTAL, 1,
+                help="failed subprocess attempts that were retried",
+                command=os.path.basename(cmd[0]))
             delay = backoff_delay(attempt, backoff, key=cmd[0])
             from . import log
             log.message(f"{cmd[0]} attempt {attempt}/{attempts} failed "
                         f"({reason}); retrying in {delay:.2f}s")
             sleep(delay)
 
+    metrics_registry.counter_inc(
+        SUBPROCESS_FAILURES_TOTAL, 1,
+        help="subprocess runs that failed after all attempts",
+        command=os.path.basename(cmd[0]))
     raise last_error
 
 
@@ -422,6 +460,9 @@ def record_degrade(chain: str, from_tier: str, to_tier: str,
         _degrade_seen.add(key)
         _degrade_events.append({"chain": chain, "from": from_tier,
                                 "to": to_tier, "reason": reason})
+    metrics_registry.counter_inc(
+        DEGRADES_TOTAL, 1, help="backend degradation transitions",
+        chain=chain, **{"from": from_tier, "to": to_tier})
     print(f"autocycler backend degrade: {chain}: {from_tier} -> {to_tier} "
           f"({reason})", file=sys.stderr)
     return True

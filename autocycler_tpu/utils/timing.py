@@ -1,10 +1,16 @@
-"""Per-stage timing and optional device profiling.
+"""Per-stage timing and optional device profiling — views over the obs
+telemetry stream.
 
 The reference only reports total wall-clock at the end of a run
-(compress.rs:34,197). Here every pipeline stage can report its duration
-(AUTOCYCLER_TIMINGS=1) and optionally capture a JAX profiler trace
-(AUTOCYCLER_PROFILE_DIR=<dir>) for inspection with TensorBoard/XProf —
-the SURVEY §5 observability upgrade.
+(compress.rs:34,197). Here every pipeline stage reports its duration
+(AUTOCYCLER_TIMINGS=1), can capture a JAX profiler trace
+(AUTOCYCLER_PROFILE_DIR=<dir>) for TensorBoard/XProf, and — since the obs
+subsystem — every stage/substage/device-dispatch ALSO opens a span in the
+process-wide tracer (obs.trace, written when AUTOCYCLER_TRACE_DIR is set)
+and accumulates into the metrics registry (obs.metrics_registry). The
+legacy accessors in this module (`device_seconds()`, `stage_seconds()`,
+`substage_snapshot()`, ...) are now thin reads of that registry, so bench
+artifacts, `autocycler report` and these functions can never disagree.
 """
 
 from __future__ import annotations
@@ -14,95 +20,131 @@ import os
 import threading
 import time
 
+from ..obs import metrics_registry, trace
 from . import log
 from .misc import format_duration
 
-# process-wide device-dispatch accounting: every site that hands work to the
-# device (jit dispatch + result transfer) runs under device_dispatch(), so
-# "how much of this wall-clock was device work?" is answerable from the
-# artifacts (VERDICT r3 item 2). The accumulator measures host-observed
-# dispatch-to-materialisation time — through a tunnelled TPU that includes
-# transfer, which is the honest cost of using the device.
-_device_lock = threading.Lock()
-_device_seconds = 0.0
-_device_calls = 0
-_device_failures = 0
+# metric names (the single source of truth for every accessor below and
+# for obs.report's device/stage summaries)
+DEVICE_SECONDS = "autocycler_device_seconds_total"
+DEVICE_DISPATCHES = "autocycler_device_dispatches_total"
+DEVICE_FAILURES = "autocycler_device_failures_total"
+DEVICE_FAILURE_LAST = "autocycler_device_failure_last"
+DEVICE_DISPATCH_HIST = "autocycler_device_dispatch_seconds"
+STAGE_SECONDS = "autocycler_stage_seconds_total"
+SUBSTAGE_SECONDS = "autocycler_substage_seconds_total"
+
+_last_lock = threading.Lock()
 _device_failure_last = ""
+
+# an exception that already passed through device_dispatch's accounting is
+# tagged with this attribute, so the fallback site that eventually catches
+# it can add its richer description without double-counting the failure
+_RECORDED_ATTR = "_autocycler_device_failure_recorded"
 
 
 @contextlib.contextmanager
 def device_dispatch(what: str = ""):
-    """Times one device dispatch (including result materialisation) into the
-    process-wide accumulator read by :func:`device_seconds`."""
+    """Times one device dispatch (including result materialisation) into
+    the process-wide accumulators read by :func:`device_seconds`, opens a
+    "device" span in the tracer, and — on an exception unwinding out of the
+    dispatch — records the device failure before re-raising (the dispatch
+    IS the device boundary, so a raise here is by definition a device-path
+    failure)."""
     start = time.perf_counter()
     try:
-        yield
+        with trace.span(what or "device dispatch", cat="device"):
+            yield
+    except Exception as e:
+        record_device_failure(
+            f"{what or 'device dispatch'} raised {type(e).__name__}: {e}",
+            exc=e)
+        raise
     finally:
         elapsed = time.perf_counter() - start
-        global _device_seconds, _device_calls
-        with _device_lock:
-            _device_seconds += elapsed
-            _device_calls += 1
+        reg = metrics_registry.registry()
+        reg.counter_inc(DEVICE_SECONDS, elapsed,
+                        help="host-observed seconds inside device dispatches")
+        reg.counter_inc(DEVICE_DISPATCHES, 1,
+                        help="device dispatch count")
+        reg.observe(DEVICE_DISPATCH_HIST, elapsed,
+                    help="per-dispatch host-observed latency",
+                    what=what or "device dispatch")
         if os.environ.get("AUTOCYCLER_TIMINGS") and what:
             log.message(f"[timing] device {what}: {format_duration(elapsed)}")
 
 
 def device_seconds() -> float:
     """Total host-observed seconds spent in device dispatches so far."""
-    with _device_lock:
-        return _device_seconds
+    return metrics_registry.registry().value(DEVICE_SECONDS)
 
 
 def device_calls() -> int:
-    with _device_lock:
-        return _device_calls
+    return int(metrics_registry.registry().value(DEVICE_DISPATCHES))
 
 
-def record_device_failure(what: str) -> None:
+def record_device_failure(what: str, exc: BaseException = None) -> None:
     """Counts a device-path failure that fell back to host. The fallback
     sites print to stderr, which benchmark artifacts truncate; this counter
     makes 'did anything silently degrade?' answerable from the artifact
-    itself (VERDICT r4 item 1)."""
-    global _device_failures, _device_failure_last
-    with _device_lock:
-        _device_failures += 1
+    itself (VERDICT r4 item 1). When ``exc`` is the exception that already
+    unwound through :func:`device_dispatch` (which records the failure at
+    the device boundary), only the description is refreshed — the count
+    stays exact."""
+    global _device_failure_last
+    already = exc is not None and getattr(exc, _RECORDED_ATTR, False)
+    if exc is not None:
+        try:
+            setattr(exc, _RECORDED_ATTR, True)
+        except AttributeError:
+            pass
+    reg = metrics_registry.registry()
+    if not already:
+        reg.counter_inc(DEVICE_FAILURES, 1,
+                        help="device-path failures that fell back to host")
+    reg.info_set(DEVICE_FAILURE_LAST, what,
+                 help="description of the most recent device-path failure")
+    with _last_lock:
         _device_failure_last = what
 
 
 def device_failures():
     """(count, last failure description)."""
-    with _device_lock:
-        return _device_failures, _device_failure_last
+    with _last_lock:
+        last = _device_failure_last
+    return int(metrics_registry.registry().value(DEVICE_FAILURES)), last
 
 
 # ---- sub-stage accounting ----
 # Hot kernels report where a stage's wall time goes (partition / sort /
 # stitch / adjacency for the k-mer grouping; more as kernels grow). The
-# accumulators are process-wide and cheap enough to run unconditionally, so
-# bench.py can attach a per-stage breakdown to the artifact without env
-# flags, and stage_timer can print the nested split under AUTOCYCLER_TIMINGS.
-_substage_seconds: dict = {}
-_stage_seconds: dict = {}
+# accumulators live in the metrics registry (process-wide, cheap enough to
+# run unconditionally), so bench.py can attach a per-stage breakdown to the
+# artifact without env flags, and stage_timer can print the nested split
+# under AUTOCYCLER_TIMINGS.
 
 
 @contextlib.contextmanager
 def substage(name: str):
-    """Times one sub-stage of a hot kernel into the process-wide accumulator
-    (read via :func:`substage_snapshot`); multiple entries accumulate.
-    Thread-safe: concurrent workers each add their own elapsed time."""
+    """Times one sub-stage of a hot kernel into the process-wide registry
+    (read via :func:`substage_snapshot`) and opens a "substage" span;
+    multiple entries accumulate. Thread-safe: concurrent workers each add
+    their own elapsed time."""
     start = time.perf_counter()
     try:
-        yield
+        with trace.span(name, cat="substage"):
+            yield
     finally:
         elapsed = time.perf_counter() - start
-        with _device_lock:
-            _substage_seconds[name] = _substage_seconds.get(name, 0.0) + elapsed
+        metrics_registry.registry().counter_inc(
+            SUBSTAGE_SECONDS, elapsed,
+            help="cumulative seconds per hot-kernel sub-stage",
+            substage=name)
 
 
 def substage_snapshot() -> dict:
     """Copy of the cumulative per-sub-stage seconds so far."""
-    with _device_lock:
-        return dict(_substage_seconds)
+    return metrics_registry.registry().labeled(SUBSTAGE_SECONDS, "substage")
 
 
 def substage_deltas(before: dict, digits: int = 3) -> dict:
@@ -119,8 +161,7 @@ def substage_deltas(before: dict, digits: int = 3) -> dict:
 def stage_seconds() -> dict:
     """Cumulative wall seconds per stage_timer name (e.g. the bench guard
     reads 'compress/build_graph' from here after an in-process compress)."""
-    with _device_lock:
-        return dict(_stage_seconds)
+    return metrics_registry.registry().labeled(STAGE_SECONDS, "stage")
 
 
 @contextlib.contextmanager
@@ -128,30 +169,32 @@ def stage_timer(name: str):
     """Times a pipeline stage; reporting is enabled with AUTOCYCLER_TIMINGS=1,
     device profiling with AUTOCYCLER_PROFILE_DIR. Durations (and any
     sub-stage splits recorded inside the stage) always accumulate into the
-    process-wide tables read by :func:`stage_seconds` /
-    :func:`substage_snapshot`."""
+    registry read by :func:`stage_seconds` / :func:`substage_snapshot`, and
+    the stage opens a "stage" span in the tracer."""
     profile_dir = os.environ.get("AUTOCYCLER_PROFILE_DIR")
-    trace = None
+    jax_trace = None
     if profile_dir:
         try:
             import jax
-            trace = jax.profiler.trace(os.path.join(profile_dir, name))
-            trace.__enter__()
+            jax_trace = jax.profiler.trace(os.path.join(profile_dir, name))
+            jax_trace.__enter__()
         except Exception:
-            trace = None
+            jax_trace = None
     sub_before = substage_snapshot()
     start = time.perf_counter()
     try:
-        yield
+        with trace.span(name, cat="stage"):
+            yield
     finally:
         elapsed = time.perf_counter() - start
-        if trace is not None:
+        if jax_trace is not None:
             try:
-                trace.__exit__(None, None, None)
+                jax_trace.__exit__(None, None, None)
             except Exception:
                 pass
-        with _device_lock:
-            _stage_seconds[name] = _stage_seconds.get(name, 0.0) + elapsed
+        metrics_registry.registry().counter_inc(
+            STAGE_SECONDS, elapsed,
+            help="cumulative wall seconds per pipeline stage", stage=name)
         if os.environ.get("AUTOCYCLER_TIMINGS"):
             log.message(f"[timing] {name}: {format_duration(elapsed)}")
             for sub, secs in substage_deltas(sub_before).items():

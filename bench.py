@@ -321,6 +321,12 @@ def bench_headline() -> None:
         if bench_failures > failures:
             device_kernels["failure_last"] = bench_failure_last
 
+    # the unified telemetry view of the same run: aggregate stage seconds
+    # (top-level span durations) and the full metrics-registry snapshot, so
+    # the artifact carries the cache/pool/degradation accounting alongside
+    # the wall numbers above
+    from autocycler_tpu.obs import metrics_registry
+
     print(json.dumps({
         "metric": "headline_pipeline_24x6Mbp",
         "value": elapsed,
@@ -342,6 +348,9 @@ def bench_headline() -> None:
         "device_failures": failures,
         "device_failure_last": failure_last,
         "device_kernels": device_kernels,
+        "stage_seconds": {name: round(secs, 3) for name, secs
+                          in sorted(timing.stage_seconds().items())},
+        "metrics": metrics_registry.snapshot(),
     }))
 
 
@@ -669,6 +678,34 @@ def guard_failures(baseline: dict, measured: dict,
     return failures
 
 
+def guard_report(baseline: dict, measured: dict) -> list:
+    """Span-tree diff of the guarded stage metrics: one line per metric,
+    indented by the stage/substage name-prefix hierarchy (the guard metric
+    names mirror the span tree: compress_* > compress_build_graph_* >
+    compress_build_graph_adjacency_*...), with measured vs baseline and the
+    percent change. Pure function so the rendering is unit-testable."""
+    def stem(name: str) -> str:
+        return name[:-2] if name.endswith("_s") else name
+
+    names = sorted(set(baseline) | set(measured), key=stem)
+    lines = []
+    for name in names:
+        depth = sum(1 for other in names
+                    if other != name and stem(name).startswith(stem(other)))
+        base, got = baseline.get(name), measured.get(name)
+
+        def fmt(v):
+            return f"{v:.3f}s" if isinstance(v, (int, float)) else "absent"
+
+        delta = ""
+        if isinstance(base, (int, float)) and isinstance(got, (int, float)) \
+                and base > 0:
+            delta = f"  ({(got / base - 1) * 100:+.0f}%)"
+        lines.append(f"{'  ' * depth}{stem(name)}: "
+                     f"{fmt(got)} vs baseline {fmt(base)}{delta}")
+    return lines
+
+
 def _guard_measure() -> dict:
     """One cold compress run at the configs scale (4 assemblies x 5 Mbp,
     k=51, threads from AUTOCYCLER_BENCH_THREADS, default 4) plus a warm
@@ -729,8 +766,11 @@ def bench_guard(argv: list) -> None:
     """Performance regression guard (`python bench.py guard`): measure the
     guarded compress metrics and fail non-zero if any regressed more than
     25% against BENCH_GUARD.json. With `--update` (or when no baseline has
-    been recorded yet) the measurement becomes the new baseline instead."""
+    been recorded yet) the measurement becomes the new baseline instead.
+    With `--report`, also print the per-stage span-tree diff against the
+    baseline to stderr (stdout stays one JSON line)."""
     update = "--update" in argv
+    want_report = "--report" in argv
     measured = _guard_measure()
     if update or not GUARD_BASELINE_PATH.exists():
         artifact = {
@@ -746,6 +786,10 @@ def bench_guard(argv: list) -> None:
     tolerance = float(baseline.get("tolerance", GUARD_TOLERANCE))
     failures = guard_failures(baseline.get("metrics", {}), measured,
                               tolerance)
+    if want_report:
+        print("guard span-tree diff (measured vs baseline):", file=sys.stderr)
+        for line in guard_report(baseline.get("metrics", {}), measured):
+            print(f"  {line}", file=sys.stderr)
     print(json.dumps({
         "bench": "guard",
         "passed": not failures,

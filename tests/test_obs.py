@@ -1,0 +1,407 @@
+"""Observability subsystem (autocycler_tpu/obs): span tracer nesting and
+thread lanes, the Chrome trace export schema, the metrics registry's
+Prometheus/JSON exports, memory sampling, the device-failure accounting
+contract, the NO_COLOR/FORCE_COLOR/AUTOCYCLER_LOG_JSON log satellites, and
+the compress end-to-end trace + `autocycler report` agreement gate."""
+
+import json
+import re
+import sys
+import threading
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from synthetic import make_assemblies  # noqa: E402
+
+from autocycler_tpu.obs import metrics_registry, report as obs_report, trace
+from autocycler_tpu.obs.memory import memory_sample
+from autocycler_tpu.obs.metrics_registry import MetricsRegistry
+from autocycler_tpu.utils import pool, timing
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    """Every test starts with no active run; the process-wide registry is
+    left alone (other suites read deltas from it) except where a test
+    resets it explicitly."""
+    trace._abort_run_for_tests()
+    yield
+    trace._abort_run_for_tests()
+
+
+def _load_jsonl(path):
+    return [json.loads(line) for line in
+            Path(path).read_text().splitlines() if line.strip()]
+
+
+# ---------------- the disabled (no-op) path ----------------
+
+def test_noop_span_is_shared_singleton_with_no_io(tmp_path):
+    assert not trace.tracing_active()
+    spans = [trace.span(f"s{i}", cat="stage", k=i) for i in range(100)]
+    # O(1) allocation: every disabled call returns the SAME object
+    assert all(s is trace.NOOP_SPAN for s in spans)
+    with trace.span("anything") as s:
+        assert s is None
+    assert list(tmp_path.iterdir()) == []   # and nothing touched disk
+
+
+# ---------------- nesting / parent-child integrity ----------------
+
+def test_nested_spans_record_parent_child_chain(tmp_path):
+    trace.start_run(tmp_path, name="nesting")
+    with trace.span("outer", cat="stage"):
+        with trace.span("mid", cat="substage"):
+            with trace.span("inner", cat="device"):
+                pass
+        with trace.span("sibling", cat="substage"):
+            pass
+    out = trace.finish_run()
+    assert out == tmp_path
+
+    records = _load_jsonl(tmp_path / trace.TRACE_JSONL)
+    assert records[0]["type"] == "run" and records[0]["name"] == "nesting"
+    assert records[-1]["type"] == "finish"
+    spans = {r["name"]: r for r in records if r["type"] == "span"}
+    assert set(spans) == {"outer", "mid", "inner", "sibling"}
+    outer, mid = spans["outer"], spans["mid"]
+    assert outer["parent"] is None
+    assert mid["parent"] == outer["id"]
+    assert spans["inner"]["parent"] == mid["id"]
+    assert spans["sibling"]["parent"] == outer["id"]
+    # children close before parents and fit inside the parent window
+    assert mid["ts"] >= outer["ts"]
+    assert mid["ts"] + mid["dur"] <= outer["ts"] + outer["dur"] + 1e-6
+    # top-level spans carry the memory sample; nested ones don't
+    assert "mem" in outer and outer["mem"]["peak_rss_bytes"] > 0
+    assert "mem" not in mid
+
+
+def test_span_records_error_and_attrs(tmp_path):
+    trace.start_run(tmp_path, name="err")
+    with pytest.raises(ValueError):
+        with trace.span("boom", cat="stage", path="x.gfa"):
+            raise ValueError("nope")
+    trace.finish_run()
+    rec = [r for r in _load_jsonl(tmp_path / trace.TRACE_JSONL)
+           if r["type"] == "span"][0]
+    assert rec["error"] == "ValueError"
+    assert rec["attrs"] == {"path": "x.gfa"}
+
+
+def test_spans_are_thread_safe_under_the_shared_pool(tmp_path):
+    trace.start_run(tmp_path, name="pool")
+
+    def work(i):
+        with trace.span(f"task{i}", cat="substage"):
+            with trace.span(f"task{i}/inner", cat="device"):
+                return i * i
+
+    results = pool.pool_map(work, range(64), workers=8)
+    assert results == [i * i for i in range(64)]
+    trace.finish_run()
+    spans = [r for r in _load_jsonl(tmp_path / trace.TRACE_JSONL)
+             if r["type"] == "span"]
+    assert len(spans) == 128
+    ids = [s["id"] for s in spans]
+    assert len(set(ids)) == 128          # unique under concurrency
+    by_name = {s["name"]: s for s in spans}
+    for i in range(64):
+        inner, outer = by_name[f"task{i}/inner"], by_name[f"task{i}"]
+        # nesting held WITHIN each worker thread
+        assert inner["parent"] == outer["id"]
+        assert inner["tid"] == outer["tid"]
+        # pool workers root their own lanes (no cross-thread parent guess)
+        assert outer["parent"] is None
+
+
+# ---------------- Chrome trace export ----------------
+
+def test_chrome_trace_schema(tmp_path):
+    trace.start_run(tmp_path, name="chrome")
+    with trace.span("stage_a", cat="stage", foo="bar"):
+        with trace.span("sub_b", cat="substage"):
+            pass
+    trace.finish_run()
+    data = json.loads((tmp_path / trace.TRACE_CHROME).read_text())
+    assert data["displayTimeUnit"] == "ms"
+    events = data["traceEvents"]
+    meta, rest = events[0], events[1:]
+    assert meta["ph"] == "M" and meta["name"] == "process_name"
+    assert "autocycler chrome" in meta["args"]["name"]
+    assert {e["name"] for e in rest} == {"stage_a", "sub_b"}
+    for e in rest:
+        assert e["ph"] == "X"            # complete events
+        assert e["ts"] >= 0 and e["dur"] >= 0   # microseconds
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        assert e["cat"] in ("stage", "substage")
+    a = next(e for e in rest if e["name"] == "stage_a")
+    assert a["args"]["foo"] == "bar"
+    assert "mem" in a["args"]            # top-level span memory rides along
+
+
+# ---------------- metrics registry ----------------
+
+def test_prometheus_text_format():
+    reg = MetricsRegistry()
+    reg.counter_inc("demo_total", 2, help="a demo counter", kind="x")
+    reg.counter_inc("demo_total", 3, kind="x")
+    reg.gauge_set("demo_gauge", 1.5)
+    reg.info_set("demo_info", 'weird "value"\nwith newline')
+    reg.observe("demo_seconds", 0.004, buckets=(0.001, 0.01, 1.0))
+    reg.observe("demo_seconds", 5.0, buckets=(0.001, 0.01, 1.0))
+    text = reg.to_prometheus()
+    lines = text.splitlines()
+    assert "# HELP demo_total a demo counter" in lines
+    assert "# TYPE demo_total counter" in lines
+    assert 'demo_total{kind="x"} 5.0' in lines
+    assert "# TYPE demo_gauge gauge" in lines
+    assert "demo_gauge 1.5" in lines
+    # info text rides in a value label, escaped
+    assert ('demo_info{value="weird \\"value\\"\\nwith newline"} 1'
+            in lines)
+    # histogram: cumulative le buckets + _sum/_count
+    assert 'demo_seconds_bucket{le="0.001"} 0' in lines
+    assert 'demo_seconds_bucket{le="0.01"} 1' in lines
+    assert 'demo_seconds_bucket{le="1.0"} 1' in lines
+    assert 'demo_seconds_bucket{le="+Inf"} 2' in lines
+    assert "demo_seconds_sum 5.004" in lines
+    assert "demo_seconds_count 2" in lines
+    assert text.endswith("\n")
+    # every non-comment line is a valid exposition sample
+    sample = re.compile(r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? \S+$')
+    for line in lines:
+        if not line.startswith("#"):
+            assert sample.match(line), line
+
+
+def test_registry_snapshot_and_views():
+    reg = MetricsRegistry()
+    reg.counter_inc("c_total", 1, stage="a")
+    reg.counter_inc("c_total", 2, stage="b")
+    snap = reg.snapshot()
+    json.dumps(snap)                    # JSON-able by contract
+    assert snap["c_total"]["type"] == "counter"
+    assert reg.labeled("c_total", "stage") == {"a": 1.0, "b": 2.0}
+    assert reg.value("c_total", stage="b") == 2.0
+    assert reg.value("missing") == 0.0
+    with pytest.raises(ValueError):
+        reg.counter_inc("c_total", -1, stage="a")
+    with pytest.raises(ValueError):
+        reg.gauge_set("c_total", 1.0)   # kind mismatch
+
+
+def test_registry_thread_safety():
+    reg = MetricsRegistry()
+
+    def hammer():
+        for _ in range(1000):
+            reg.counter_inc("hits_total", 1, who="t")
+            reg.observe("lat_seconds", 0.01)
+
+    threads = [threading.Thread(target=hammer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert reg.value("hits_total", who="t") == 8000
+    snap = reg.snapshot()["lat_seconds"]["values"][0]
+    assert snap["count"] == 8000
+
+
+def test_memory_sample_has_rss():
+    sample = memory_sample()
+    assert sample["peak_rss_bytes"] > 0
+    assert sample["rss_bytes"] > 0
+
+
+# ---------------- device-failure accounting (satellite fix) ----------------
+
+def test_device_dispatch_failure_counted_exactly_once():
+    before, _ = timing.device_failures()
+    with pytest.raises(RuntimeError):
+        with timing.device_dispatch("unit test dispatch"):
+            raise RuntimeError("device exploded")
+    count, last = timing.device_failures()
+    assert count == before + 1
+    assert "unit test dispatch" in last
+    # the fallback site catches the SAME exception and records its richer
+    # description — the count must not move again
+    try:
+        with timing.device_dispatch("unit test dispatch"):
+            raise RuntimeError("device exploded again")
+    except RuntimeError as e:
+        timing.record_device_failure("site-level description", exc=e)
+    count2, last2 = timing.device_failures()
+    assert count2 == before + 2
+    assert last2 == "site-level description"
+    # a failure that never went through device_dispatch still counts
+    timing.record_device_failure("plain failure")
+    assert timing.device_failures()[0] == before + 3
+
+
+# ---------------- log satellites ----------------
+
+def test_colour_env_contract(monkeypatch):
+    from autocycler_tpu.utils import log
+    monkeypatch.delenv("NO_COLOR", raising=False)
+    monkeypatch.setenv("FORCE_COLOR", "1")
+    assert log._colour_enabled() is True
+    monkeypatch.setenv("NO_COLOR", "1")     # NO_COLOR wins over FORCE_COLOR
+    assert log._colour_enabled() is False
+    monkeypatch.delenv("FORCE_COLOR")
+    monkeypatch.setenv("NO_COLOR", "")      # empty value = unset per spec
+    monkeypatch.setattr(sys.stderr, "isatty", lambda: False, raising=False)
+    assert log._colour_enabled() is False
+
+
+def test_json_log_mode(monkeypatch, capsys):
+    from autocycler_tpu.utils import log
+    monkeypatch.setenv("AUTOCYCLER_LOG_JSON", "1")
+    log.section_header("Starting section")
+    log.explanation("Some  wrapped\n explanation")
+    log.message("a message")
+    log.message()                       # blank spacer: skipped in JSONL
+    err = capsys.readouterr().err
+    records = [json.loads(line) for line in err.splitlines() if line.strip()]
+    assert [r["type"] for r in records] == ["section", "explanation",
+                                            "message"]
+    assert records[0]["text"] == "Starting section"
+    assert records[1]["text"] == "Some wrapped explanation"
+    assert all("ts" in r for r in records)
+
+
+# ---------------- report ----------------
+
+def test_report_on_empty_dir_fails(tmp_path, capsys):
+    assert obs_report.report(tmp_path) == 1
+    assert "no telemetry" in capsys.readouterr().err
+
+
+def test_report_merges_manifest_metrics_and_bench(tmp_path, capsys):
+    (tmp_path / "batch_manifest.json").write_text(json.dumps({
+        "version": 1,
+        "items": {"iso_a": {"status": "done", "stage": "finalise",
+                            "error": None, "attempts": 1},
+                  "iso_b": {"status": "failed", "stage": "compress",
+                            "error": "corrupt FASTA", "attempts": 2}}}))
+    reg = MetricsRegistry()
+    reg.counter_inc("autocycler_device_seconds_total", 1.25)
+    reg.counter_inc("autocycler_device_dispatches_total", 3)
+    reg.counter_inc("autocycler_cache_events_total", 5,
+                    cache="parse", event="hit")
+    reg.counter_inc("autocycler_cache_events_total", 2,
+                    cache="parse", event="miss")
+    reg.counter_inc("autocycler_degrades_total", 1, chain="native",
+                    **{"from": "ctypes", "to": "numpy"})
+    (tmp_path / trace.METRICS_JSON).write_text(reg.to_json())
+    (tmp_path / "BENCH_RESULT.json").write_text(json.dumps(
+        {"metric": "headline", "value": 42.0, "unit": "s",
+         "vs_baseline": 1.4}))
+
+    assert obs_report.report(tmp_path) == 0
+    out = capsys.readouterr().out
+    assert "FAILED iso_b (stage compress): corrupt FASTA" in out
+    assert "1 done" in out and "1 failed" in out
+    assert "1.25s on device across 3 dispatches" in out
+    assert "parse 5 hits / 2 misses" in out
+    assert "native x1" in out
+    assert "headline = 42.0 s (vs_baseline 1.4)" in out
+
+
+def test_report_json_mode_roundtrips(tmp_path, capsys):
+    trace.start_run(tmp_path, name="jsonmode")
+    with trace.span("stage_x", cat="stage"):
+        pass
+    trace.finish_run()
+    assert obs_report.report(tmp_path, as_json=True) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["trace"]["tree"][0]["name"] == "stage_x"
+    assert data["trace"]["span_count"] == 1
+
+
+def test_span_tree_merges_siblings_and_orders_by_start():
+    spans = [
+        {"type": "span", "name": "s", "cat": "stage", "id": 1,
+         "parent": None, "ts": 0.0, "dur": 1.0},
+        {"type": "span", "name": "sub", "cat": "substage", "id": 2,
+         "parent": 1, "ts": 0.1, "dur": 0.2},
+        {"type": "span", "name": "sub", "cat": "substage", "id": 3,
+         "parent": 1, "ts": 0.5, "dur": 0.3},
+        {"type": "span", "name": "late", "cat": "stage", "id": 4,
+         "parent": None, "ts": 2.0, "dur": 0.5},
+    ]
+    tree = obs_report.span_tree(spans)
+    assert [n["name"] for n in tree] == ["s", "late"]
+    sub = tree[0]["children"][0]
+    assert sub["count"] == 2
+    assert sub["seconds"] == pytest.approx(0.5)
+
+
+def test_guard_report_renders_span_tree_diff():
+    import bench
+    lines = bench.guard_report(
+        {"compress_s": 10.0, "compress_build_graph_s": 6.0,
+         "compress_build_graph_adjacency_s": 2.0, "gone_s": 1.0},
+        {"compress_s": 12.0, "compress_build_graph_s": 6.3,
+         "compress_build_graph_adjacency_s": 2.0})
+    assert lines == [
+        "compress: 12.000s vs baseline 10.000s  (+20%)",
+        "  compress_build_graph: 6.300s vs baseline 6.000s  (+5%)",
+        "    compress_build_graph_adjacency: 2.000s vs baseline 2.000s"
+        "  (+0%)",
+        "gone: absent vs baseline 1.000s",
+    ]
+
+
+# ---------------- end to end through the CLI ----------------
+
+def test_compress_e2e_trace_and_report_agreement(tmp_path, monkeypatch,
+                                                 capsys):
+    import gc
+
+    from autocycler_tpu import cli
+
+    asm_dir = make_assemblies(tmp_path, n_assemblies=3, chromosome_len=2000,
+                              plasmid_len=500)
+    out_dir = tmp_path / "out"
+    run_dir = tmp_path / "telemetry"
+    monkeypatch.setenv("AUTOCYCLER_TRACE_DIR", str(run_dir))
+    monkeypatch.setenv("AUTOCYCLER_METRICS", str(tmp_path / "m.prom"))
+    try:
+        rc = cli.main(["compress", "-i", str(asm_dir), "-a", str(out_dir),
+                       "-t", "1"])
+    finally:
+        gc.enable()                     # the CLI disables gc for compress
+    assert rc == 0
+    for artifact in (trace.TRACE_JSONL, trace.TRACE_CHROME,
+                     trace.METRICS_JSON, trace.METRICS_PROM):
+        assert (run_dir / artifact).is_file(), artifact
+    assert "autocycler_stage_seconds_total" in \
+        (tmp_path / "m.prom").read_text()
+
+    records = _load_jsonl(run_dir / trace.TRACE_JSONL)
+    spans = [r for r in records if r["type"] == "span"]
+    command = next(s for s in spans if s["cat"] == "command")
+    assert command["name"] == "compress" and command["parent"] is None
+    stage_names = {s["name"] for s in spans
+                   if s["cat"] == "stage" and s["parent"] == command["id"]}
+    assert {"compress/load_and_repair", "compress/build_graph",
+            "compress/simplify"} <= stage_names
+
+    # the report's stage tree total agrees with the recorded wall within 5%
+    built = obs_report.build_report(run_dir)
+    agreement = built["trace"]["wall_agreement"]
+    assert abs(agreement - 1.0) <= obs_report.WALL_AGREEMENT, agreement
+
+    capsys.readouterr()
+    assert cli.main(["report", str(run_dir)]) == 0
+    out = capsys.readouterr().out
+    assert "Stage tree:" in out
+    assert "compress/build_graph" in out
+    assert "WARNING" not in out
