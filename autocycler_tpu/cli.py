@@ -79,6 +79,27 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-o", "--out_dir")
     p.add_argument("-f", "--out_file")
 
+    p = sub.add_parser("doctor",
+                       help="device forensics: probe history, negative-cache "
+                            "state, environment snapshot and recommended "
+                            "actions (reads state only — no device bring-up)")
+    p.add_argument("-d", "--dir", default=".",
+                   help="run directory holding probe_log.jsonl / "
+                        "device_probe.json (default: cwd)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the structured report as JSON")
+    p.add_argument("--probe", action="store_true",
+                   help="run one live subprocess probe (killable, captures "
+                        "init stderr) before reporting")
+    p.add_argument("--watch", action="store_true",
+                   help="run the probe sentinel in the foreground, printing "
+                        "one JSON outcome per cycle")
+    p.add_argument("--interval", type=float,
+                   help="--watch probe interval in seconds (default: "
+                        "AUTOCYCLER_PROBE_WATCH or 30)")
+    p.add_argument("--cycles", type=int,
+                   help="--watch: stop after this many probe cycles")
+
     p = sub.add_parser("dotplot",
                        help="generate an all-vs-all dotplot from a unitig graph")
     p.add_argument("-i", "--input", required=True)
@@ -179,6 +200,11 @@ def dispatch(args) -> int:
     elif args.command == "decompress":
         from .commands.decompress import decompress
         decompress(args.in_gfa, args.out_dir, args.out_file)
+    elif args.command == "doctor":
+        from .commands.doctor import doctor
+        return doctor(args.dir, as_json=args.json, watch=args.watch,
+                      probe=args.probe, interval=args.interval,
+                      cycles=args.cycles)
     elif args.command == "dotplot":
         from .commands.dotplot import dotplot
         dotplot(args.input, args.out_png, args.res, args.kmer, args.grid_mode)
@@ -247,9 +273,13 @@ def main(argv=None) -> int:
         gc.disable()
     from .obs import trace
     # `report` reads a previous run's telemetry — tracing it would clutter
-    # (or append to) the very artifacts it renders.
-    owns_run = (args.command != "report"
+    # (or append to) the very artifacts it renders. `doctor` likewise only
+    # inspects state (and must stay side-effect-free on a wedged host).
+    owns_run = (args.command not in ("report", "doctor")
                 and trace.maybe_start_run(name=args.command))
+    if args.command not in ("report", "doctor"):
+        from .obs import sentinel
+        sentinel.maybe_start_watcher()
     try:
         with trace.span(args.command, cat="command",
                         **({"argv": list(argv)} if argv else {})):

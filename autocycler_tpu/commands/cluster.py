@@ -430,16 +430,19 @@ def cluster_is_trusted(sequences: List[Sequence], c: int) -> bool:
 # containment counting: the refinement hill-climb scores many candidate
 # clusterings against the same distance dict, and rebuilding the dense
 # [S, S] matrix per score evaluation would reintroduce the O(S²)-per-call
-# Python constant this module just removed (advisor r5 finding). Keyed on
-# the dict's identity + cutoff + the clustered id tuple; holding a strong
-# reference to the keyed dict keeps its id from being recycled.
+# Python constant this module just removed (advisor r5 finding). A hit
+# requires the SAME dict object (`is` against the held strong reference —
+# id() alone can alias two distinct dicts once the first is garbage
+# collected and its id recycled) plus equal cutoff and id tuple;
+# generate_clusters() clears the slot when clustering finishes.
 _contain_cache: Dict[str, object] = {}
 
 
 def _contain_ab_cached(distances: Dict[Tuple[int, int], float],
                        cutoff: float, ids: Tuple[int, ...]) -> np.ndarray:
-    key = (id(distances), cutoff, len(distances), ids)
-    if _contain_cache.get("key") != key:
+    key = (cutoff, ids)
+    if _contain_cache.get("distances_ref") is not distances \
+            or _contain_cache.get("key") != key:
         pos = {a: i for i, a in enumerate(ids)}
         D = _distances_to_matrix(distances, pos, len(ids))
         _contain_cache.update(key=key, distances_ref=distances,

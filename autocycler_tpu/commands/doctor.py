@@ -1,0 +1,227 @@
+"""`autocycler doctor`: device forensics for humans and machines.
+
+Renders what the framework knows about the device path — environment
+snapshot, last in-process probe state, the persisted negative-probe cache,
+and the probe history (``probe_log.jsonl``) — plus a rule-driven list of
+recommended actions. The default invocation initiates NO device bring-up:
+it only reads state, so it is safe on a host whose transport is wedged
+(the exact situation it exists to diagnose). ``--probe`` runs one live
+subprocess probe; ``--watch`` runs the sentinel in the foreground.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+from ..obs import sentinel
+
+
+def negative_cache_state(run_dir: str = ".") -> dict:
+    """The persisted negative-probe cache (``device_probe.json``) as doctor
+    evidence: looks in ``run_dir`` and ``run_dir/.cache`` (where compress/
+    batch put it). Reports freshness against the active TTL so the reader
+    knows whether the cache is still suppressing probes."""
+    try:
+        ttl = float(os.environ.get("AUTOCYCLER_PROBE_NEG_TTL_S", "300"))
+    except ValueError:
+        ttl = 300.0
+    for cand in (Path(run_dir) / "device_probe.json",
+                 Path(run_dir) / ".cache" / "device_probe.json"):
+        try:
+            entry = json.loads(cand.read_text())
+        except (OSError, ValueError):
+            continue
+        age = time.time() - float(entry.get("at", 0) or 0)
+        return {"present": True, "path": str(cand),
+                "kind": entry.get("kind"), "reason": entry.get("reason"),
+                "age_s": round(age, 1), "ttl_s": ttl,
+                "fresh": bool(ttl > 0 and age < ttl)}
+    return {"present": False, "ttl_s": ttl, "fresh": False}
+
+
+def recommended_actions(probe_state: dict, neg_cache: dict, env: dict,
+                        history: list) -> list:
+    """Rule engine mapping the gathered evidence to next steps. Pure — unit
+    tested directly; keep side-effect free."""
+    actions = []
+    kind = probe_state.get("kind")
+    fresh_neg = neg_cache.get("fresh")
+    last_real = next((e for e in reversed(history)
+                      if "attached" in e and "type" not in e), None)
+    if kind is None and last_real is not None:
+        kind = last_real.get("kind")
+
+    if kind == "timeout" or (fresh_neg and neg_cache.get("kind") == "timeout"):
+        actions.append(
+            "wedged transport: the probe never answered. Inspect "
+            "`stderr_tail` in probe_log.jsonl for the PJRT/libtpu init "
+            "chatter, then restart the device tunnel/plugin. Device paths "
+            "are disabled until a probe succeeds.")
+        if fresh_neg:
+            actions.append(
+                f"a fresh negative cache ({neg_cache.get('path')}, age "
+                f"{neg_cache.get('age_s')}s / ttl {neg_cache.get('ttl_s')}s) "
+                "is suppressing re-probes; delete it or set "
+                "AUTOCYCLER_PROBE_NEG_TTL_S=0 to force an immediate retry.")
+        actions.append(
+            "set AUTOCYCLER_PROBE_WATCH=<seconds> so the sentinel re-probes "
+            "in the background and auto-captures device evidence the moment "
+            "the transport recovers.")
+    elif kind == "error" or (fresh_neg and neg_cache.get("kind") == "error"):
+        actions.append(
+            "device init failed outright (kind=error): check the probe "
+            "reason and `plugin_versions` above for a jax <-> TPU plugin "
+            "mismatch, and `accel_devices` for missing /dev/accel* nodes.")
+    elif kind == "pinned":
+        actions.append(
+            f"JAX_PLATFORMS={env.get('jax_platforms')!r} pins a non-TPU "
+            "backend, so device paths are intentionally off; unset it to "
+            "let the probe try the device.")
+    elif kind == "no-tpu":
+        if env.get("accel_devices"):
+            actions.append(
+                "jax initialised without a TPU backend although accelerator "
+                "device files exist — check that the TPU PJRT plugin "
+                "(plugin_versions above) is installed into THIS interpreter.")
+        else:
+            actions.append(
+                "host-only machine (no TPU backend, no /dev/accel*): "
+                "nothing to fix; host fallbacks are the expected path here.")
+    elif kind == "ok":
+        actions.append("device probe healthy — no action needed.")
+    else:
+        actions.append(
+            "no probe has run in this process and no probe history was "
+            "found; run `autocycler doctor --probe` for a live diagnosis "
+            "(subprocess probe, killable, captures init stderr).")
+
+    if not any("AUTOCYCLER_PROBE_WATCH" in a for a in actions) \
+            and kind not in ("ok", "pinned") \
+            and not env.get("env", {}).get("AUTOCYCLER_PROBE_WATCH"):
+        actions.append(
+            "tip: AUTOCYCLER_PROBE_WATCH=<seconds> keeps a background "
+            "sentinel watching for device recovery during long runs.")
+    return actions
+
+
+def gather(run_dir: str = ".") -> dict:
+    """Everything doctor knows, as one dict (the ``--json`` payload)."""
+    from ..ops.distance import device_probe_report
+    env = sentinel.environment_snapshot()
+    probe_state = device_probe_report()
+    neg_cache = negative_cache_state(run_dir)
+    log_path = Path(run_dir) / sentinel.PROBE_LOG
+    if not log_path.exists():
+        fallback = sentinel.probe_log_path()
+        log_path = fallback if fallback is not None else log_path
+    history = sentinel.read_probe_log(log_path, limit=50)
+    return {
+        "env": env,
+        "probe_state": probe_state,
+        "negative_cache": neg_cache,
+        "probe_log": {"path": str(log_path), "entries": history},
+        "actions": recommended_actions(probe_state, neg_cache, env, history),
+    }
+
+
+def _render_text(report: dict) -> None:
+    env = report["env"]
+    print("autocycler doctor")
+    print("=================")
+    print(f"python {env['python']} on {env['platform']}  "
+          f"(cpus: {env['cpu_count']})")
+    print(f"JAX_PLATFORMS: {env['jax_platforms']!r}")
+    if env["plugin_versions"]:
+        print("plugins: " + ", ".join(f"{k}=={v}" for k, v
+                                      in env["plugin_versions"].items()))
+    else:
+        print("plugins: none (no jax/tpu/pjrt packages found)")
+    print("accel devices: "
+          + (", ".join(env["accel_devices"]) or "none"))
+    if env["env"]:
+        print("knobs: " + ", ".join(f"{k}={v}" for k, v
+                                    in sorted(env["env"].items())))
+
+    ps = report["probe_state"]
+    print("\nlast in-process probe")
+    print("---------------------")
+    if ps.get("attached") is None:
+        print("no probe has run in this process (doctor does not initiate "
+              "device bring-up; use --probe)")
+    else:
+        print(f"attached={ps['attached']} kind={ps.get('kind')} "
+              f"seconds={ps.get('seconds')} probes={ps.get('probes')}")
+        print(f"reason: {ps.get('reason')}")
+
+    nc = report["negative_cache"]
+    print("\nnegative cache")
+    print("--------------")
+    if nc.get("present"):
+        state = "FRESH (suppressing probes)" if nc["fresh"] else "stale"
+        print(f"{nc['path']}: kind={nc['kind']} age={nc['age_s']}s "
+              f"ttl={nc['ttl_s']}s [{state}]")
+        print(f"reason: {nc.get('reason')}")
+    else:
+        print("none persisted")
+
+    entries = report["probe_log"]["entries"]
+    print(f"\nprobe history ({report['probe_log']['path']})")
+    print("-------------")
+    if not entries:
+        print("no probe log found")
+    for e in entries[-10:]:
+        if e.get("type"):
+            print(f"  [{e.get('ts')}] {e['type']}: "
+                  f"{e.get('note') or ''}".rstrip())
+        else:
+            print(f"  [{e.get('ts')}] {e.get('source')}: "
+                  f"attached={e.get('attached')} kind={e.get('kind')} "
+                  f"seconds={e.get('seconds')} — {e.get('reason')}")
+
+    print("\nrecommended actions")
+    print("-------------------")
+    for i, action in enumerate(report["actions"], 1):
+        print(f"{i}. {action}")
+
+
+def doctor(run_dir: str = ".", as_json: bool = False, watch: bool = False,
+           probe: bool = False, interval: float = None,
+           cycles: int = None) -> int:
+    """Entry point for the subcommand. ``probe`` runs ONE live subprocess
+    probe (recorded to the probe log) before reporting; ``watch`` runs the
+    sentinel loop in the foreground (``cycles`` bounds it, else Ctrl-C),
+    with the recovery auto-capture hook armed."""
+    sentinel.set_probe_log_dir(run_dir, fallback=True)
+    if watch:
+        if os.environ.get("AUTOCYCLER_RECOVERY_CAPTURE", "1") != "0":
+            sentinel.on_recovery(sentinel.recovery_capture)
+        iv = interval if interval is not None else (
+            sentinel.watch_interval() or 30.0)
+        watcher = sentinel.ProbeWatcher(iv, source="doctor-watch")
+        print(f"watching: probing every {iv:g}s "
+              f"(deadline {watcher.deadline:g}s); Ctrl-C to stop",
+              file=sys.stderr)
+        try:
+            while not watcher.stop_event.is_set():
+                entry = watcher.cycle()
+                print(json.dumps(entry, default=str), flush=True)
+                if cycles is not None and watcher.cycles >= cycles:
+                    break
+                if watcher.stop_event.wait(iv):
+                    break
+        except KeyboardInterrupt:
+            pass
+        return 0
+    if probe:
+        outcome = sentinel.subprocess_probe(sentinel.probe_deadline())
+        sentinel.record_outcome(outcome, source="doctor")
+    report = gather(run_dir)
+    if as_json:
+        print(json.dumps(report, indent=2, default=str))
+    else:
+        _render_text(report)
+    return 0

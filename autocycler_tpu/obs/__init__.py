@@ -8,7 +8,7 @@ by ``AUTOCYCLER_TRACE_DIR`` — answers "what did this run spend its time
 and memory on, and what degraded?". See docs/observability.md.
 """
 
-from . import metrics_registry, trace
+from . import metrics_registry, sentinel, trace
 from .memory import memory_sample
 from .metrics_registry import (MetricsRegistry, counter_inc, gauge_set,
                                info_set, observe, registry, snapshot,

@@ -1,0 +1,503 @@
+"""Probe sentinel: device forensics for the TPU probe.
+
+Three consecutive bench rounds reported ``device_fraction: 0.0`` with a
+one-line guess ("probe did not respond within 60s (wedged transport?)").
+This module turns that guess into a diagnosis and keeps watching for the
+transport to come back:
+
+- :func:`subprocess_probe` runs the device probe in a *subprocess* so a
+  wedged PJRT/libtpu init can be killed (a wedged in-process thread can
+  only be abandoned, and keeps the jax init lock held), and so the init
+  stderr — the PJRT plugin chatter that explains *why* bring-up stalled —
+  is captured into the outcome instead of lost on the terminal.
+- :func:`environment_snapshot` records what the probe ran against:
+  ``JAX_PLATFORMS``, the ``AUTOCYCLER_*`` knobs, installed jax/TPU plugin
+  versions, and ``/dev/accel*`` device files.
+- every real outcome is appended to ``probe_log.jsonl`` (one JSON object
+  per line) so `autocycler doctor` can render the probe history of a run
+  directory, not just the last answer.
+- :class:`ProbeWatcher` re-probes on an interval (``AUTOCYCLER_PROBE_WATCH``
+  seconds) in a daemon thread; on the first ``false -> true`` transition it
+  clears the negative probe caches (ops.distance) and fires the registered
+  recovery hooks exactly once — by default :func:`recovery_capture`, a
+  bounded micro-bench (grouping shootout + dotplot rates) so a transient
+  tunnel recovery produces device evidence even if nobody was watching.
+
+The sentinel never raises into the pipeline: telemetry must not fail the
+run it is diagnosing.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import platform
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+from typing import Callable, List, Optional
+
+PROBE_LOG = "probe_log.jsonl"
+RECOVERY_CAPTURE_FILE = "recovery_capture.json"
+_MARKER = "AUTOCYCLER_PROBE:"
+_STDERR_TAIL = 4000
+
+_lock = threading.Lock()
+_log_dir: Optional[str] = None          # explicit (set_probe_log_dir)
+_fallback_dir: Optional[str] = None     # from distance.set_probe_cache_dir
+_hooks: List[Callable] = []
+_last_attached: Optional[bool] = None
+_recovery_fired = False
+_watcher_thread: Optional[threading.Thread] = None
+
+
+# ---- environment forensics ----
+
+def environment_snapshot() -> dict:
+    """What a probe on this host runs against: the platform pin, every
+    AUTOCYCLER knob, installed jax/TPU-adjacent package versions and the
+    accelerator device files. Pure inspection — never imports jax, never
+    initialises a backend (``autocycler doctor`` must be safe to run on a
+    wedged host)."""
+    env_vars = {k: os.environ[k] for k in sorted(os.environ)
+                if k == "JAX_PLATFORMS" or k.startswith("AUTOCYCLER_")
+                or k in ("XLA_FLAGS", "LIBTPU_INIT_ARGS", "TPU_NAME",
+                         "PJRT_DEVICE", "TPU_LIBRARY_PATH")}
+    versions = {}
+    try:
+        from importlib import metadata
+        for dist in metadata.distributions():
+            name = (dist.metadata.get("Name") or "").lower()
+            if any(tag in name for tag in ("jax", "tpu", "pjrt", "axon")):
+                versions[name] = dist.version
+    except Exception:  # noqa: BLE001 — forensics must not fail the caller
+        pass
+    accel = sorted(glob.glob("/dev/accel*")) + sorted(glob.glob("/dev/vfio/*"))
+    return {
+        "jax_platforms": os.environ.get("JAX_PLATFORMS"),
+        "env": env_vars,
+        "plugin_versions": dict(sorted(versions.items())),
+        "accel_devices": accel,
+        "python": platform.python_version(),
+        "platform": platform.platform(),
+        "cpu_count": os.cpu_count(),
+        "pid": os.getpid(),
+    }
+
+
+# ---- the subprocess probe ----
+
+# The child replicates the CLI's platform pinning (the installed PJRT
+# plugin overrides JAX_PLATFORMS from the environment, so the pin must go
+# through jax.config), then initialises a backend and round-trips one tiny
+# op — backend init alone can succeed while execution stalls. The outcome
+# rides a marker line on stdout; everything the PJRT/libtpu init prints
+# lands on stderr, which the parent captures as the diagnosis.
+_PROBE_SNIPPET = """\
+import json, os, time
+t0 = time.perf_counter()
+out = {}
+try:
+    import jax
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+    backend = jax.default_backend()
+    out["backend"] = backend
+    out["device_count"] = jax.device_count()
+    if backend != "tpu":
+        out.update(attached=False, kind="no-tpu",
+                   reason="jax default backend is %r" % backend)
+    else:
+        import jax.numpy as jnp
+        float(jnp.asarray(1.0) + 1.0)
+        out.update(attached=True, kind="ok",
+                   reason="tpu backend verified (tiny op round-tripped "
+                          "in probe subprocess)")
+except Exception as e:
+    out.update(attached=False, kind="error",
+               reason="device init failed: %s: %s" % (type(e).__name__, e))
+out["seconds"] = round(time.perf_counter() - t0, 3)
+print("AUTOCYCLER_PROBE:" + json.dumps(out), flush=True)
+"""
+
+
+def _probe_argv() -> List[str]:
+    """The probe child's argv — a seam so tests can substitute a stub that
+    answers canned outcomes (or wedges) without importing jax."""
+    return [sys.executable, "-c", _PROBE_SNIPPET]
+
+
+def subprocess_probe(deadline: float) -> dict:
+    """One device probe in a killable subprocess. Returns the outcome dict:
+    ``{kind, attached, reason, seconds, stderr_tail, backend?,
+    device_count?}`` where ``kind`` follows the ops.distance taxonomy
+    ("ok" / "no-tpu" / "error" / "timeout"). A child that exceeds
+    ``deadline`` is killed (whole session, so a wedged libtpu helper dies
+    with it) and reported as a diagnosed timeout — with whatever init
+    stderr it produced before wedging."""
+    t0 = time.perf_counter()
+    outcome: dict = {"mode": "subprocess"}
+    err = ""
+    try:
+        proc = subprocess.Popen(_probe_argv(), stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True,
+                                start_new_session=True)
+    except OSError as e:
+        return {"mode": "subprocess", "attached": False, "kind": "error",
+                "reason": f"probe subprocess failed to start: {e}",
+                "seconds": round(time.perf_counter() - t0, 3)}
+    try:
+        out, err = proc.communicate(timeout=deadline)
+        parsed = None
+        for line in (out or "").splitlines():
+            if line.startswith(_MARKER):
+                try:
+                    parsed = json.loads(line[len(_MARKER):])
+                except ValueError:
+                    parsed = None
+        if parsed is not None:
+            outcome.update(parsed)
+        elif proc.returncode != 0:
+            outcome.update(attached=False, kind="error",
+                           reason=f"probe subprocess exited "
+                                  f"{proc.returncode} without an outcome")
+        else:
+            outcome.update(attached=False, kind="error",
+                           reason="probe subprocess produced no outcome")
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, 9)
+        except (OSError, ProcessLookupError):
+            proc.kill()
+        try:
+            _, err = proc.communicate(timeout=2)
+        except Exception:  # noqa: BLE001 — the tail is best-effort
+            err = ""
+        outcome.update(
+            attached=False, kind="timeout",
+            reason=(f"probe subprocess did not respond within "
+                    f"{deadline:.0f}s (wedged transport) — killed; init "
+                    "stderr captured"))
+    outcome["seconds"] = round(time.perf_counter() - t0, 3)
+    if err:
+        outcome["stderr_tail"] = err[-_STDERR_TAIL:]
+    return outcome
+
+
+# ---- probe_log.jsonl ----
+
+def set_probe_log_dir(path, fallback: bool = False) -> None:
+    """Point ``probe_log.jsonl`` at ``path`` (None clears). With
+    ``fallback=True`` the directory only applies when nothing explicit and
+    no ``AUTOCYCLER_TRACE_DIR`` is set — ops.distance routes the probe
+    cache dir here so batch/compress runs log next to device_probe.json."""
+    global _log_dir, _fallback_dir
+    with _lock:
+        if fallback:
+            _fallback_dir = None if path is None else str(path)
+        else:
+            _log_dir = None if path is None else str(path)
+
+
+def probe_log_path() -> Optional[Path]:
+    with _lock:
+        explicit, fallback = _log_dir, _fallback_dir
+    if explicit:
+        return Path(explicit) / PROBE_LOG
+    env = os.environ.get("AUTOCYCLER_TRACE_DIR", "").strip()
+    if env:
+        return Path(env) / PROBE_LOG
+    if fallback:
+        return Path(fallback) / PROBE_LOG
+    return None
+
+
+def append_probe_log(entry: dict) -> None:
+    """Append one JSON line to the configured probe log (no-op without a
+    configured directory; never raises)."""
+    path = probe_log_path()
+    if path is None:
+        return
+    try:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        with open(path, "a") as f:
+            f.write(json.dumps(entry, default=str) + "\n")
+    except OSError:
+        pass
+
+
+def read_probe_log(path=None, limit: Optional[int] = None) -> List[dict]:
+    """Entries of a probe log (most recent last); ``limit`` keeps the tail.
+    Malformed lines are skipped, a missing file is an empty history."""
+    path = Path(path) if path is not None else probe_log_path()
+    if path is None or not path.exists():
+        return []
+    entries = []
+    try:
+        for line in path.read_text().splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                entries.append(json.loads(line))
+            except ValueError:
+                continue
+    except OSError:
+        return []
+    return entries[-limit:] if limit else entries
+
+
+# ---- recovery hooks & transition tracking ----
+
+def on_recovery(hook: Callable[[dict], None]) -> None:
+    """Register a callable fired (once, with the recovering outcome) on the
+    first ``false -> true`` probe transition this process observes."""
+    with _lock:
+        _hooks.append(hook)
+
+
+def clear_recovery_hooks() -> None:
+    with _lock:
+        _hooks.clear()
+
+
+def record_outcome(outcome: dict, source: str = "watcher") -> dict:
+    """Log one probe outcome and run the transition bookkeeping: appends to
+    ``probe_log.jsonl``, and on the first ``false -> true`` transition
+    clears the negative probe caches (so the pipeline's gate re-probes
+    immediately) and fires the recovery hooks exactly once."""
+    global _last_attached, _recovery_fired
+    entry = {"ts": round(time.time(), 3), "source": source}
+    entry.update(outcome)
+    tail = entry.get("stderr_tail")
+    if isinstance(tail, str) and len(tail) > 2000:
+        entry["stderr_tail"] = tail[-2000:]
+    append_probe_log(entry)
+    attached = bool(outcome.get("attached"))
+    with _lock:
+        prev = _last_attached
+        _last_attached = attached
+        fire = (prev is False and attached and not _recovery_fired)
+        if fire:
+            _recovery_fired = True
+        hooks = list(_hooks)
+    if attached:
+        _clear_negative_caches()
+    if fire:
+        append_probe_log({"ts": round(time.time(), 3), "source": source,
+                          "type": "recovery",
+                          "note": "probe recovered (false -> true); firing "
+                                  f"{len(hooks)} recovery hook(s)"})
+        from . import metrics_registry
+        metrics_registry.counter_inc(
+            "autocycler_probe_recoveries_total", 1,
+            help="false->true probe transitions observed by the sentinel")
+        for hook in hooks:
+            try:
+                hook(entry)
+            except Exception as e:  # noqa: BLE001 — a hook must not kill the watcher
+                print(f"autocycler: probe recovery hook failed: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
+    return entry
+
+
+def _clear_negative_caches() -> None:
+    """A healthy probe invalidates every cached negative: the in-memory
+    failure state and the persisted device_probe.json (ops.distance owns
+    both)."""
+    try:
+        from ..ops import distance
+        distance.notify_probe_recovered()
+    except Exception:  # noqa: BLE001 — cache clearing is best-effort
+        pass
+
+
+def _reset_for_tests() -> None:
+    global _last_attached, _recovery_fired, _log_dir, _fallback_dir
+    with _lock:
+        _last_attached = None
+        _recovery_fired = False
+        _log_dir = None
+        _fallback_dir = None
+        _hooks.clear()
+
+
+# ---- the recovery micro-bench capture ----
+
+def recovery_capture(outcome: Optional[dict] = None,
+                     out_dir=None) -> dict:
+    """Bounded device-evidence capture run the moment the transport
+    recovers: dotplot match-grid rate (VPU kernel, MFU-anchored) plus a
+    small grouping shootout (native hash vs the LSD device sort, exactness
+    checked). Results are written to ``recovery_capture.json`` next to the
+    probe log and returned. Sizes are small (64k² grid, ~2 Mbp of windows)
+    so the capture finishes in seconds — its job is evidence that the chip
+    worked at recovery time, not a headline number."""
+    result: dict = {"ts": round(time.time(), 3)}
+    if outcome is not None:
+        result["trigger"] = {k: outcome.get(k)
+                             for k in ("ts", "kind", "reason", "source")}
+    t0 = time.perf_counter()
+    try:
+        import jax
+        backend = jax.default_backend()
+        result["backend"] = backend
+        if backend == "tpu":
+            from ..ops.dotplot_pallas import benchmark_gcells
+            from ..ops.mfu import vpu_grid_mfu
+            n = _env_int("AUTOCYCLER_RECOVERY_DOTPLOT_N", 65536)
+            k = 32
+            _, rate = benchmark_gcells(n_a=n, n_b=n, k=k, repeats=1,
+                                       kernel="vpu")
+            result["dotplot"] = {"kernel": "vpu", "grid": f"{n}x{n}", "k": k,
+                                 "gcells_per_s": round(rate, 2),
+                                 **vpu_grid_mfu(rate, k)}
+        else:
+            result["dotplot"] = {"skipped":
+                                 f"backend {backend!r} is not a TPU"}
+    except Exception as e:  # noqa: BLE001 — partial evidence beats none
+        result["dotplot"] = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        import numpy as np
+
+        from ..ops.kmers import group_windows_full
+        n = int(_env_float("AUTOCYCLER_RECOVERY_GROUPING_MBP", 2.0) * 1e6)
+        k = 51
+        rng = np.random.default_rng(7)
+        codes = rng.integers(1, 5, size=max(n, k + 2)).astype(np.uint8)
+        starts = np.arange(0, len(codes) - k, dtype=np.int64)
+        t = time.perf_counter()
+        gid_n, order_n = group_windows_full(codes, starts, k, use_jax=False)
+        native_s = time.perf_counter() - t
+        t = time.perf_counter()
+        gid, order = group_windows_full(codes, starts, k, use_jax="lsd")
+        lsd_s = time.perf_counter() - t
+        result["grouping"] = {
+            "windows": len(starts), "k": k,
+            "native_s": round(native_s, 3), "lsd_s": round(lsd_s, 3),
+            "lsd_exact": bool((gid == gid_n).all()
+                              and (order == order_n).all()),
+        }
+    except Exception as e:  # noqa: BLE001
+        result["grouping"] = {"error": f"{type(e).__name__}: {e}"}
+    result["seconds"] = round(time.perf_counter() - t0, 3)
+    target = Path(out_dir) if out_dir is not None else (
+        probe_log_path().parent if probe_log_path() else None)
+    if target is not None:
+        try:
+            target.mkdir(parents=True, exist_ok=True)
+            (target / RECOVERY_CAPTURE_FILE).write_text(
+                json.dumps(result, indent=2, default=str) + "\n")
+        except OSError:
+            pass
+    append_probe_log({"ts": round(time.time(), 3), "type": "capture",
+                      "source": "recovery", "capture": result})
+    return result
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def probe_deadline() -> float:
+    """The probe deadline the sentinel shares with ops.distance:
+    AUTOCYCLER_PROBE_DEADLINE_S wins, AUTOCYCLER_DEVICE_PROBE_TIMEOUT is
+    the original spelling, default 60 s."""
+    raw = os.environ.get("AUTOCYCLER_PROBE_DEADLINE_S")
+    if raw is None:
+        raw = os.environ.get("AUTOCYCLER_DEVICE_PROBE_TIMEOUT", "60")
+    try:
+        return float(raw)
+    except ValueError:
+        return 60.0
+
+
+# ---- the watcher ----
+
+class ProbeWatcher:
+    """Interval re-probing with transition bookkeeping. ``cycle()`` is the
+    unit of work (probe once, record, return the logged entry) so tests and
+    ``doctor --watch`` drive it synchronously; :func:`maybe_start_watcher`
+    wraps it in a daemon thread for pipeline runs."""
+
+    def __init__(self, interval: float, deadline: Optional[float] = None,
+                 probe_fn: Optional[Callable[[float], dict]] = None,
+                 source: str = "watcher"):
+        self.interval = max(float(interval), 0.01)
+        self.deadline = probe_deadline() if deadline is None else deadline
+        self.probe_fn = probe_fn or subprocess_probe
+        self.source = source
+        self.stop_event = threading.Event()
+        self.cycles = 0
+
+    def cycle(self) -> dict:
+        outcome = self.probe_fn(self.deadline)
+        self.cycles += 1
+        return record_outcome(outcome, source=self.source)
+
+    def run(self) -> None:
+        while not self.stop_event.is_set():
+            try:
+                self.cycle()
+            except Exception as e:  # noqa: BLE001 — the watcher must survive
+                print(f"autocycler: probe watcher cycle failed: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
+            if self.stop_event.wait(self.interval):
+                break
+
+    def stop(self) -> None:
+        self.stop_event.set()
+
+
+def watch_interval() -> Optional[float]:
+    """AUTOCYCLER_PROBE_WATCH as seconds; unset/<= 0/malformed disables."""
+    raw = os.environ.get("AUTOCYCLER_PROBE_WATCH", "").strip()
+    if not raw:
+        return None
+    try:
+        interval = float(raw)
+    except ValueError:
+        print("autocycler: ignoring malformed AUTOCYCLER_PROBE_WATCH "
+              f"({raw!r})", file=sys.stderr)
+        return None
+    return interval if interval > 0 else None
+
+
+def maybe_start_watcher() -> Optional[threading.Thread]:
+    """Start the background watcher thread when AUTOCYCLER_PROBE_WATCH is
+    set (idempotent; returns the thread or None). The default recovery
+    hook — the micro-bench capture — is registered unless
+    AUTOCYCLER_RECOVERY_CAPTURE=0."""
+    global _watcher_thread
+    interval = watch_interval()
+    if interval is None:
+        return None
+    with _lock:
+        if _watcher_thread is not None and _watcher_thread.is_alive():
+            return _watcher_thread
+    if os.environ.get("AUTOCYCLER_RECOVERY_CAPTURE", "1") != "0":
+        with _lock:
+            if recovery_capture not in _hooks:
+                _hooks.append(recovery_capture)
+    watcher = ProbeWatcher(interval)
+    t = threading.Thread(target=watcher.run, daemon=True,
+                         name="autocycler-probe-sentinel")
+    t.watcher = watcher  # type: ignore[attr-defined] — reachable for stop()
+    t.start()
+    with _lock:
+        _watcher_thread = t
+    return t

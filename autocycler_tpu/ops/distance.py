@@ -49,10 +49,35 @@ _PROBE_CACHE_FILE = "device_probe.json"
 
 def set_probe_cache_dir(path) -> None:
     """Enable the on-disk negative probe cache under ``path`` (compress and
-    batch point it at ``<autocycler_dir>/.cache``; None disables)."""
+    batch point it at ``<autocycler_dir>/.cache``; None disables). The
+    probe sentinel's ``probe_log.jsonl`` follows the same directory as a
+    fallback, so a run's probe history lands next to its negative cache."""
     global _probe_cache_dir
     with _PROBE_LOCK:
         _probe_cache_dir = None if path is None else str(path)
+    try:
+        from ..obs import sentinel
+        sentinel.set_probe_log_dir(path, fallback=True)
+    except Exception:  # noqa: BLE001 — forensics must not break the gate
+        pass
+
+
+def notify_probe_recovered() -> None:
+    """Sentinel hand-back on a ``false -> true`` probe transition: drop the
+    in-memory failed-probe cache and the persisted negative, so the next
+    :func:`_tpu_attached` call re-probes immediately instead of waiting out
+    a TTL/backoff window that no longer reflects reality."""
+    with _PROBE_LOCK:
+        cache_dir = _probe_cache_dir
+        if not _probe_state.get("attached"):
+            _probe_state["cached"] = False
+            _probe_state["fails"] = 0
+    if cache_dir:
+        import os
+        try:
+            os.unlink(os.path.join(cache_dir, _PROBE_CACHE_FILE))
+        except OSError:
+            pass
 
 
 def _probe_neg_ttl() -> float:
@@ -114,7 +139,7 @@ def _disk_probe_store(attached: bool, reason: str, kind: str) -> None:
 
 
 def _record_probe(attached: bool, seconds: float, reason: str,
-                  cache: bool, kind: str) -> None:
+                  cache: bool, kind: str, detail: dict = None) -> None:
     with _PROBE_LOCK:
         fails = _probe_state.get("fails", 0)
         if cache:
@@ -122,6 +147,7 @@ def _record_probe(attached: bool, seconds: float, reason: str,
         _probe_state.update(attached=attached, seconds=round(seconds, 3),
                             reason=reason, cached=cache, fails=fails,
                             kind=kind, at=_time.monotonic(),
+                            detail=detail or {},
                             probes=_probe_state.get("probes", 0) + (1 if cache else 0))
 
 
@@ -140,7 +166,8 @@ def device_probe_report() -> dict:
                 "seconds": _probe_state.get("seconds"),
                 "reason": _probe_state.get("reason"),
                 "kind": _probe_state.get("kind"),
-                "probes": _probe_state.get("probes", 0)}
+                "probes": _probe_state.get("probes", 0),
+                "detail": dict(_probe_state.get("detail") or {})}
 
 
 _WARNED_UNSAFE: set = set()
@@ -282,41 +309,73 @@ def _tpu_attached() -> bool:
             _probe_state["probing"] = False
         return False
 
-    result: List[Tuple[bool, str, str]] = []
+    result: List[Tuple[bool, str, str, dict]] = []
+    # "subprocess" (default): the probe runs in a killable child that
+    # captures PJRT/libtpu init stderr into the diagnosis (obs.sentinel) —
+    # a wedged transport becomes kind="timeout" WITH the init chatter that
+    # explains it. "inline" keeps the in-process thread probe (tests pin
+    # it; also the mode for hosts where fork/exec is unwelcome).
+    mode = os.environ.get("AUTOCYCLER_PROBE_MODE", "subprocess").strip().lower()
 
     def probe() -> None:
+        if mode != "inline":
+            try:
+                from ..obs import sentinel
+                outcome = sentinel.subprocess_probe(timeout)
+            except Exception as e:  # noqa: BLE001 — fall back like any failure
+                result.append((False, "probe subprocess machinery failed: "
+                               f"{type(e).__name__}: {e}", "error", {}))
+                return
+            result.append((bool(outcome.get("attached")),
+                           str(outcome.get("reason", "no reason recorded")),
+                           str(outcome.get("kind", "error")), outcome))
+            return
         try:
             import jax
             import jax.numpy as jnp
             backend = jax.default_backend()
             if backend != "tpu":
                 result.append((False, f"jax default backend is {backend!r}",
-                               "no-tpu"))
+                               "no-tpu", {}))
                 return
             float(jnp.asarray(1.0) + 1.0)  # end-to-end transport check
             result.append((True, "tpu backend verified (tiny op round-tripped)",
-                           "ok"))
+                           "ok", {}))
         except Exception as e:  # noqa: BLE001 — no jax / no device: host matmul
             result.append((False, f"device init failed: {type(e).__name__}: {e}",
-                           "error"))
+                           "error", {}))
 
     t0 = _time.perf_counter()
     try:
         t = _threading.Thread(target=probe, daemon=True, name="tpu-probe")
         t.start()
-        t.join(timeout)
+        # the subprocess probe enforces the deadline itself (kill + stderr
+        # capture), so its thread gets a small grace on top; the inline
+        # probe can truly wedge and gets exactly the deadline
+        grace = 0.0 if mode == "inline" else min(5.0, 0.5 + 0.1 * timeout)
+        t.join(timeout + grace)
         if result:
-            attached, reason, kind = result[0]
+            attached, reason, kind, detail = result[0]
         else:
             attached = False
             kind = "timeout"
+            detail = {}
             reason = (f"probe did not respond within {timeout:.0f}s "
                       "(wedged transport?)")
             print(f"autocycler: device {reason}; falling back to host "
                   "backends", file=sys.stderr)
-        _record_probe(attached, _time.perf_counter() - t0, reason, cache=True,
-                      kind=kind)
+        elapsed = _time.perf_counter() - t0
+        _record_probe(attached, elapsed, reason, cache=True, kind=kind,
+                      detail=detail)
         _disk_probe_store(attached, reason, kind)
+        try:
+            from ..obs import sentinel
+            sentinel.record_outcome(
+                dict(detail or {}, attached=attached, kind=kind,
+                     reason=reason, seconds=round(elapsed, 3)),
+                source="gate")
+        except Exception:  # noqa: BLE001 — forensics must not break the gate
+            pass
     finally:
         with _PROBE_LOCK:
             _probe_state["probing"] = False
@@ -403,7 +462,8 @@ def pairwise_distance_matrix(M: np.ndarray, w: np.ndarray,
             Mt_p = np.zeros((Up, Sp), np.int32)
             Mt_p[:U, :S] = M.T
             from ..utils.timing import device_dispatch
-            with device_dispatch("cluster distance matmul"):
+            with device_dispatch("cluster distance matmul",
+                                 flops=2.0 * Sp * Up * Sp):
                 inter = np.asarray(
                     jnp.matmul(jnp.asarray(Mw_p), jnp.asarray(Mt_p)),
                 )[:S, :S].astype(np.int64)

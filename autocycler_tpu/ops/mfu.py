@@ -51,6 +51,38 @@ def vpu_grid_mfu(rate_gcells: float, k: int) -> dict:
             "pct_peak": round(100.0 * ops / V5E_VPU_INT_OPS, 1)}
 
 
+def kernel_rates(kernels: dict) -> dict:
+    """Anchor per-kernel dispatch telemetry (utils.timing.device_kernel_snapshot:
+    ``{kernel: {phase: {count, total_s, flops?, bytes?}}}``) against hardware
+    peaks. Prefers the steady phase (first-call includes XLA compile, so its
+    rate says nothing about the hardware); falls back to first when a kernel
+    only ever dispatched once. Returns ``{kernel: {phase, count, total_s,
+    mean_s, tflops?, pct_peak_bf16?, gb_per_s?, pct_peak_hbm?}}`` — rate keys
+    appear only where the dispatch site declared useful work."""
+    out: dict = {}
+    for kernel, phases in kernels.items():
+        stats = phases.get("steady") or phases.get("first")
+        phase = "steady" if "steady" in phases else "first"
+        if not stats or not stats.get("count"):
+            continue
+        total = stats.get("total_s", 0.0)
+        row = {"phase": phase, "count": stats["count"],
+               "total_s": round(total, 6),
+               "mean_s": round(total / stats["count"], 6)}
+        if total > 0 and stats.get("flops"):
+            flops_rate = stats["flops"] / total
+            row["tflops"] = round(flops_rate / 1e12, 3)
+            row["pct_peak_bf16"] = round(
+                100.0 * flops_rate / V5E_MXU_BF16_FLOPS, 2)
+        if total > 0 and stats.get("bytes"):
+            byte_rate = stats["bytes"] / total
+            row["gb_per_s"] = round(byte_rate / 1e9, 2)
+            row["pct_peak_hbm"] = round(
+                100.0 * byte_rate / V5E_HBM_BYTES, 2)
+        out[kernel] = row
+    return out
+
+
 def sort_bandwidth(n_elements: int, n_passes: int, seconds: float,
                    n_arrays: int = 2) -> dict:
     """Multi-pass device sort: effective HBM traffic -> {GB/s, pct of HBM

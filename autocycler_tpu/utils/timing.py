@@ -31,11 +31,20 @@ DEVICE_DISPATCHES = "autocycler_device_dispatches_total"
 DEVICE_FAILURES = "autocycler_device_failures_total"
 DEVICE_FAILURE_LAST = "autocycler_device_failure_last"
 DEVICE_DISPATCH_HIST = "autocycler_device_dispatch_seconds"
+DEVICE_KERNEL_HIST = "autocycler_device_kernel_seconds"
+DEVICE_KERNEL_FLOPS = "autocycler_device_kernel_flops_total"
+DEVICE_KERNEL_BYTES = "autocycler_device_kernel_bytes_total"
 STAGE_SECONDS = "autocycler_stage_seconds_total"
 SUBSTAGE_SECONDS = "autocycler_substage_seconds_total"
 
 _last_lock = threading.Lock()
 _device_failure_last = ""
+# kernels that completed at least one dispatch: the first dispatch of a
+# jitted kernel pays its XLA compile, so per-kernel latency histograms are
+# split phase="first" (compile included) vs phase="steady" — mixing them
+# makes every histogram bimodal and both numbers useless
+_first_seen: set = set()
+_xprof_counts: dict = {}
 
 # an exception that already passed through device_dispatch's accounting is
 # tagged with this attribute, so the fallback site that eventually catches
@@ -43,24 +52,76 @@ _device_failure_last = ""
 _RECORDED_ATTR = "_autocycler_device_failure_recorded"
 
 
+def _maybe_xprof(xprof_dir: str, kernel: str):
+    """Start a jax.profiler trace for this dispatch when the per-kernel
+    capture budget (AUTOCYCLER_XPROF_LIMIT, default 2 — typically the
+    compile-laden first call plus one steady-state call) allows it.
+    Returns (profiler context or None, trace path or None); never raises —
+    profiling is evidence, not a dependency."""
+    import re
+    try:
+        limit = int(os.environ.get("AUTOCYCLER_XPROF_LIMIT", "2"))
+    except ValueError:
+        limit = 2
+    with _last_lock:
+        n = _xprof_counts.get(kernel, 0)
+        if n >= limit:
+            return None, None
+        _xprof_counts[kernel] = n + 1
+    try:
+        from ..ops.distance import jax_backend_safe
+        if not jax_backend_safe():
+            return None, None
+        import jax
+        safe = re.sub(r"[^A-Za-z0-9._-]+", "_", kernel).strip("_") or "kernel"
+        path = os.path.join(xprof_dir, f"{safe}-{n}")
+        cm = jax.profiler.trace(path)
+        cm.__enter__()
+        return cm, path
+    except Exception:  # noqa: BLE001 — profiler unavailable/already active
+        return None, None
+
+
 @contextlib.contextmanager
-def device_dispatch(what: str = ""):
+def device_dispatch(what: str = "", flops: float = None,
+                    bytes_moved: float = None):
     """Times one device dispatch (including result materialisation) into
     the process-wide accumulators read by :func:`device_seconds`, opens a
     "device" span in the tracer, and — on an exception unwinding out of the
     dispatch — records the device failure before re-raising (the dispatch
     IS the device boundary, so a raise here is by definition a device-path
-    failure)."""
+    failure).
+
+    Per-kernel telemetry: every dispatch also lands in a histogram labelled
+    by kernel name and phase ("first" = this kernel's first dispatch this
+    process, XLA compile included; "steady" afterwards), read back via
+    :func:`device_kernel_snapshot`. Call sites that know their useful work
+    pass ``flops`` and/or ``bytes_moved`` so bench artifacts can anchor the
+    kernel's rate against hardware peaks (ops.mfu.kernel_rates). With
+    ``AUTOCYCLER_XPROF=<dir>`` the first few dispatches per kernel capture
+    a jax.profiler trace there, linked from the span's ``xprof`` attr."""
+    kernel = what or "device dispatch"
+    with _last_lock:
+        phase = "steady" if kernel in _first_seen else "first"
+    xprof_cm = xprof_path = None
+    xprof_dir = os.environ.get("AUTOCYCLER_XPROF", "").strip()
+    if xprof_dir:
+        xprof_cm, xprof_path = _maybe_xprof(xprof_dir, kernel)
+    attrs = {"xprof": xprof_path} if xprof_path else {}
     start = time.perf_counter()
     try:
-        with trace.span(what or "device dispatch", cat="device"):
+        with trace.span(kernel, cat="device", phase=phase, **attrs):
             yield
     except Exception as e:
         record_device_failure(
-            f"{what or 'device dispatch'} raised {type(e).__name__}: {e}",
-            exc=e)
+            f"{kernel} raised {type(e).__name__}: {e}", exc=e)
         raise
     finally:
+        if xprof_cm is not None:
+            try:
+                xprof_cm.__exit__(None, None, None)
+            except Exception:  # noqa: BLE001
+                pass
         elapsed = time.perf_counter() - start
         reg = metrics_registry.registry()
         reg.counter_inc(DEVICE_SECONDS, elapsed,
@@ -69,9 +130,52 @@ def device_dispatch(what: str = ""):
                         help="device dispatch count")
         reg.observe(DEVICE_DISPATCH_HIST, elapsed,
                     help="per-dispatch host-observed latency",
-                    what=what or "device dispatch")
+                    what=kernel)
+        reg.observe(DEVICE_KERNEL_HIST, elapsed,
+                    help="per-kernel dispatch latency, split first-call "
+                         "(compile) vs steady-state",
+                    kernel=kernel, phase=phase)
+        if flops:
+            reg.counter_inc(DEVICE_KERNEL_FLOPS, float(flops),
+                            help="useful FLOPs dispatched per kernel",
+                            kernel=kernel, phase=phase)
+        if bytes_moved:
+            reg.counter_inc(DEVICE_KERNEL_BYTES, float(bytes_moved),
+                            help="useful HBM bytes moved per kernel",
+                            kernel=kernel, phase=phase)
+        with _last_lock:
+            _first_seen.add(kernel)
         if os.environ.get("AUTOCYCLER_TIMINGS") and what:
             log.message(f"[timing] device {what}: {format_duration(elapsed)}")
+
+
+def device_kernel_snapshot() -> dict:
+    """Per-kernel dispatch accounting: ``{kernel: {phase: {count, total_s,
+    mean_s, min_s, max_s, flops?, bytes?}}}`` with phase "first" (compile
+    included) and "steady". The raw evidence behind bench's
+    ``device_kernels`` block and `autocycler report`'s kernel table."""
+    snap = metrics_registry.registry().snapshot()
+    out: dict = {}
+    for entry in snap.get(DEVICE_KERNEL_HIST, {}).get("values", []):
+        labels = entry.get("labels", {})
+        kernel, phase = labels.get("kernel"), labels.get("phase")
+        if not kernel or not phase or not entry.get("count"):
+            continue
+        out.setdefault(kernel, {})[phase] = {
+            "count": entry["count"],
+            "total_s": round(entry["sum"], 6),
+            "mean_s": round(entry["sum"] / entry["count"], 6),
+            "min_s": round(entry["min"], 6),
+            "max_s": round(entry["max"], 6),
+        }
+    for name, field in ((DEVICE_KERNEL_FLOPS, "flops"),
+                        (DEVICE_KERNEL_BYTES, "bytes")):
+        for entry in snap.get(name, {}).get("values", []):
+            labels = entry.get("labels", {})
+            kernel, phase = labels.get("kernel"), labels.get("phase")
+            if kernel and phase and kernel in out and phase in out[kernel]:
+                out[kernel][phase][field] = entry["value"]
+    return out
 
 
 def device_seconds() -> float:

@@ -29,6 +29,74 @@ from pathlib import Path
 TARGET_SECONDS = 60.0
 
 
+def host_load_snapshot() -> dict:
+    """One host-load sample: loadavg, cumulative /proc/stat CPU jiffies
+    (total + idle, so two snapshots give the busy fraction DURING the run)
+    and the interpreter's native thread count. Best-effort on every field —
+    the bench must run on hosts without /proc."""
+    import os
+    import threading
+
+    snap: dict = {"ts": round(time.time(), 3),
+                  "threads": threading.active_count()}
+    try:
+        snap["loadavg"] = [round(v, 2) for v in os.getloadavg()]
+    except (OSError, AttributeError):
+        snap["loadavg"] = None
+    try:
+        with open("/proc/stat") as f:
+            fields = f.readline().split()
+        vals = [int(v) for v in fields[1:]]
+        snap["cpu_jiffies_total"] = sum(vals)
+        # idle + iowait: neither is work stolen from the benchmark
+        snap["cpu_jiffies_idle"] = vals[3] + (vals[4] if len(vals) > 4 else 0)
+    except (OSError, ValueError, IndexError):
+        pass
+    return snap
+
+
+def host_load_context(before: dict, after: dict) -> dict:
+    """The artifact's ``host_env`` block from two snapshots: whether r05's
+    50 s vs r04's 38.5 s was the code or the machine is only answerable if
+    every artifact records what the machine was doing."""
+    import os
+
+    ctx = {"cpu_count": os.cpu_count(),
+           "loadavg_before": before.get("loadavg"),
+           "loadavg_after": after.get("loadavg"),
+           "threads_before": before.get("threads"),
+           "threads_after": after.get("threads")}
+    t0, t1 = before.get("cpu_jiffies_total"), after.get("cpu_jiffies_total")
+    i0, i1 = before.get("cpu_jiffies_idle"), after.get("cpu_jiffies_idle")
+    if None not in (t0, t1, i0, i1) and t1 > t0:
+        # whole-machine CPU busy fraction across the run — includes OTHER
+        # processes, which is exactly the contamination being measured
+        ctx["cpu_busy_frac"] = round(1.0 - (i1 - i0) / (t1 - t0), 4)
+    la = before.get("loadavg")
+    if la and ctx["cpu_count"]:
+        ctx["ambient_load_per_cpu"] = round(la[0] / ctx["cpu_count"], 4)
+    return ctx
+
+
+def untrusted_reason(host_env: dict) -> str:
+    """Non-empty when the run started on an already-busy machine (1-minute
+    loadavg per CPU above AUTOCYCLER_BENCH_LOAD_MAX, default 0.5): its wall
+    times are machine noise, so the guard must not read them as code
+    regressions. Returns "" when the run is trustworthy."""
+    import os
+
+    try:
+        max_load = float(os.environ.get("AUTOCYCLER_BENCH_LOAD_MAX", "0.5"))
+    except ValueError:
+        max_load = 0.5
+    amb = host_env.get("ambient_load_per_cpu")
+    if isinstance(amb, (int, float)) and amb > max_load:
+        return (f"ambient load {amb:.2f} per cpu at run start exceeds "
+                f"AUTOCYCLER_BENCH_LOAD_MAX={max_load:g}; wall times reflect "
+                "a busy machine, not this code")
+    return ""
+
+
 def _bench_threads() -> int:
     """Worker count for the threaded pipeline stages (compress grouping).
     AUTOCYCLER_BENCH_THREADS overrides; the default 4 matches the ISSUE-3
@@ -281,9 +349,12 @@ def bench_headline() -> None:
         # otherwise expire mid-run and re-probe against a wedged tunnel
         # INSIDE a timed stage (up to a full probe deadline of stall)
         os.environ["AUTOCYCLER_DEVICE_PROBE_TTL"] = "0"
+    load_before = host_load_snapshot()
     results = sorted(((round(e, 2), st) for e, st in
                       (_run_headline_once() for _ in range(3))),
                      key=lambda t: t[0])
+    load_after = host_load_snapshot()
+    host_env = host_load_context(load_before, load_after)
     runs = [e for e, _ in results]
     elapsed, stages = results[len(results) // 2]
     device_total = round(sum(s["device_seconds"] for s in stages.values()), 3)
@@ -320,6 +391,16 @@ def bench_headline() -> None:
         device_kernels["failures"] = bench_failures - failures
         if bench_failures > failures:
             device_kernels["failure_last"] = bench_failure_last
+    # per-kernel dispatch telemetry (utils.timing): populated whenever ANY
+    # dispatch landed on device this process — pipeline or evidence blocks —
+    # with rates anchored against v5e peaks where the call site declared
+    # its useful work (flops / bytes_moved)
+    dispatch_kernels = timing.device_kernel_snapshot()
+    if dispatch_kernels:
+        from autocycler_tpu.ops.mfu import kernel_rates
+
+        device_kernels["dispatch_kernels"] = dispatch_kernels
+        device_kernels["rates"] = kernel_rates(dispatch_kernels)
 
     # the unified telemetry view of the same run: aggregate stage seconds
     # (top-level span durations) and the full metrics-registry snapshot, so
@@ -348,6 +429,11 @@ def bench_headline() -> None:
         "device_failures": failures,
         "device_failure_last": failure_last,
         "device_kernels": device_kernels,
+        # what the machine was doing around the timed runs: "we got
+        # slower" vs "the machine was busy" must be answerable from the
+        # artifact alone
+        "host_env": host_env,
+        "untrusted": untrusted_reason(host_env) or None,
         "stage_seconds": {name: round(secs, 3) for name, secs
                           in sorted(timing.stage_seconds().items())},
         "metrics": metrics_registry.snapshot(),
@@ -678,6 +764,28 @@ def guard_failures(baseline: dict, measured: dict,
     return failures
 
 
+def guard_device_floor(baseline: dict, measured: dict,
+                       probe_kind: str) -> list:
+    """The `device_fraction` floor (ROADMAP item 1): when the baseline
+    records a positive ``device_fraction_floor`` AND the probe answered
+    ``kind=="ok"`` (a healthy chip), a measured fraction below the floor is
+    a failure — device work silently fell back to host. Any other probe
+    kind skips the check: without a healthy device the floor is
+    unachievable and the wall-time guard is the active protection. Pure
+    function; returns failure strings like :func:`guard_failures`."""
+    floor = baseline.get("device_fraction_floor")
+    if not isinstance(floor, (int, float)) or floor <= 0:
+        return []
+    if probe_kind != "ok":
+        return []
+    got = measured.get("device_fraction")
+    if isinstance(got, (int, float)) and got >= floor:
+        return []
+    shown = f"{got:.4f}" if isinstance(got, (int, float)) else "absent"
+    return [f"device_fraction: {shown} vs floor {floor:g} with a healthy "
+            "probe (kind=ok) — device work silently fell back to host"]
+
+
 def guard_report(baseline: dict, measured: dict) -> list:
     """Span-tree diff of the guarded stage metrics: one line per metric,
     indented by the stage/substage name-prefix hierarchy (the guard metric
@@ -730,11 +838,14 @@ def _guard_measure() -> dict:
     gc.disable()
     stage0 = dict(timing.stage_seconds())
     sub0 = timing.substage_snapshot()
+    dev0 = timing.device_seconds()
     devnull = open(os.devnull, "w")
     t0 = time.perf_counter()
     with contextlib.redirect_stderr(devnull):
         run_compress(asm, tmp / "out", threads=_bench_threads())
     wall = time.perf_counter() - t0
+    device_fraction = round((timing.device_seconds() - dev0) / wall, 4) \
+        if wall else 0.0
     stage1 = dict(timing.stage_seconds())
     subs = timing.substage_deltas(sub0)
     # warm rerun into the SAME autocycler dir: the content-addressed
@@ -759,6 +870,10 @@ def _guard_measure() -> dict:
         "compress_build_graph_chains_s": round(subs.get("chains", 0.0), 3),
         "compress_build_graph_links_s": round(subs.get("links", 0.0), 3),
         "compress_build_graph_unitigs_s": round(subs.get("unitigs", 0.0), 3),
+        # NOT a wall metric: consumed by guard_device_floor, and excluded
+        # from the regressions loop (guard_failures iterates baseline
+        # metrics, where this never appears)
+        "device_fraction": device_fraction,
     }
 
 
@@ -771,21 +886,56 @@ def bench_guard(argv: list) -> None:
     baseline to stderr (stdout stays one JSON line)."""
     update = "--update" in argv
     want_report = "--report" in argv
+    load_before = host_load_snapshot()
     measured = _guard_measure()
+    load_after = host_load_snapshot()
+    host_env = host_load_context(load_before, load_after)
+    untrusted = untrusted_reason(host_env)
+    # the compress run above probed the device through the normal gate; ask
+    # what it concluded (no extra bring-up)
+    from autocycler_tpu.ops.distance import device_probe_report
+    probe_kind = device_probe_report().get("kind")
     if update or not GUARD_BASELINE_PATH.exists():
+        metrics = dict(measured)
+        # device_fraction guards via its own floor (guard_device_floor),
+        # never via the larger-is-regression wall comparison
+        device_fraction = metrics.pop("device_fraction", None)
+        previous = {}
+        if GUARD_BASELINE_PATH.exists():
+            try:
+                previous = json.loads(GUARD_BASELINE_PATH.read_text())
+            except ValueError:
+                previous = {}
         artifact = {
             "recorded_threads": _bench_threads(),
             "tolerance": GUARD_TOLERANCE,
-            "metrics": measured,
+            # the floor survives --update (it is policy, not a measurement);
+            # raise it by editing BENCH_GUARD.json once device runs land
+            "device_fraction_floor": previous.get("device_fraction_floor",
+                                                  0.0),
+            "recorded_device_fraction": device_fraction,
+            "recorded_probe_kind": probe_kind,
+            "metrics": metrics,
         }
         GUARD_BASELINE_PATH.write_text(json.dumps(artifact, indent=2) + "\n")
         print(json.dumps({"bench": "guard", "action": "baseline_recorded",
-                          "path": str(GUARD_BASELINE_PATH), **artifact}))
+                          "path": str(GUARD_BASELINE_PATH),
+                          "host_env": host_env,
+                          "untrusted": untrusted or None, **artifact}))
         return
     baseline = json.loads(GUARD_BASELINE_PATH.read_text())
     tolerance = float(baseline.get("tolerance", GUARD_TOLERANCE))
-    failures = guard_failures(baseline.get("metrics", {}), measured,
-                              tolerance)
+    wall_failures = guard_failures(baseline.get("metrics", {}), measured,
+                                   tolerance)
+    floor_failures = guard_device_floor(baseline, measured, probe_kind)
+    # an untrusted run demotes WALL regressions to informational (the
+    # machine was busy; rerun when idle) — but not the device floor, which
+    # compares fractions of the same contaminated wall and stays meaningful
+    if untrusted and wall_failures:
+        untrusted_failures, failures = wall_failures, list(floor_failures)
+    else:
+        untrusted_failures = []
+        failures = wall_failures + floor_failures
     if want_report:
         print("guard span-tree diff (measured vs baseline):", file=sys.stderr)
         for line in guard_report(baseline.get("metrics", {}), measured):
@@ -795,10 +945,21 @@ def bench_guard(argv: list) -> None:
         "passed": not failures,
         "threads": _bench_threads(),
         "tolerance": tolerance,
+        "device_fraction_floor": baseline.get("device_fraction_floor", 0.0),
+        "probe_kind": probe_kind,
+        "host_env": host_env,
+        "untrusted": untrusted or None,
         "baseline": baseline.get("metrics", {}),
         "measured": measured,
         "failures": failures,
+        "untrusted_failures": untrusted_failures,
     }))
+    if untrusted_failures:
+        print(f"\nguard: run untrusted — {untrusted}", file=sys.stderr)
+        print("wall regressions observed but NOT failed "
+              "(rerun on an idle machine to confirm):", file=sys.stderr)
+        for f in untrusted_failures:
+            print(f"  - {f}", file=sys.stderr)
     if failures:
         print("\nPERFORMANCE REGRESSION — `python bench.py guard` failed:",
               file=sys.stderr)
@@ -808,6 +969,96 @@ def bench_guard(argv: list) -> None:
               "re-record the baseline with `python bench.py guard --update`.",
               file=sys.stderr)
         sys.exit(1)
+
+
+def load_round_artifacts(root=None) -> list:
+    """The per-round driver artifacts (``BENCH_r*.json``, shape ``{n, cmd,
+    rc, tail, parsed}``) unwrapped to ``[{round, path, parsed}]`` sorted by
+    round. Unparseable files are skipped; artifacts that are bare bench
+    JSON (no driver envelope) are accepted as their own ``parsed``."""
+    import re
+
+    root = Path(root) if root is not None else Path(__file__).resolve().parent
+    arts = []
+    for path in sorted(root.glob("BENCH_r*.json")):
+        try:
+            data = json.loads(path.read_text())
+        except (OSError, ValueError):
+            continue
+        if not isinstance(data, dict):
+            continue
+        parsed = data.get("parsed")
+        if not isinstance(parsed, dict):
+            parsed = data if "value" in data or "median_s" in data else {}
+        rnd = data.get("n")
+        if not isinstance(rnd, int):
+            m = re.search(r"r(\d+)", path.stem)
+            rnd = int(m.group(1)) if m else -1
+        arts.append({"round": rnd, "path": path.name, "parsed": parsed})
+    return sorted(arts, key=lambda a: a["round"])
+
+
+def trend_rows(artifacts: list) -> list:
+    """One comparable row per round from heterogeneous artifacts (the
+    artifact schema grew over rounds: stages landed in r04, device_probe in
+    r05, host_env in r06 — missing fields render as None, never raise).
+    Pure function so the trajectory extraction is unit-testable."""
+    rows = []
+    for art in artifacts:
+        p = art.get("parsed") or {}
+        runs = p.get("runs_s")
+        if isinstance(runs, list) and runs:
+            best, spread = min(runs), round(max(runs) - min(runs), 2)
+        else:
+            best, spread = p.get("best_s"), None
+        stages = p.get("stages")
+        stages_s = {name: (s.get("seconds") if isinstance(s, dict) else s)
+                    for name, s in stages.items()} \
+            if isinstance(stages, dict) else None
+        probe = p.get("device_probe") or {}
+        host = p.get("host_env") or {}
+        rows.append({
+            "round": art.get("round"),
+            "path": art.get("path"),
+            "median_s": p.get("median_s", p.get("value")),
+            "best_s": best,
+            "spread_s": spread,
+            "device_fraction": p.get("device_fraction"),
+            "probe_kind": probe.get("kind"),
+            "stages_s": stages_s,
+            "ambient_load": host.get("ambient_load_per_cpu"),
+            "untrusted": p.get("untrusted"),
+        })
+    return rows
+
+
+def bench_trend() -> None:
+    """`python bench.py trend`: the round-over-round headline trajectory
+    from the BENCH_r*.json artifacts — median/best/spread wall, device
+    fraction + probe kind, stage breakdown and ambient load — as a text
+    table on stderr and one JSON line on stdout, so "we got slower" vs
+    "the machine was busy" is answerable from artifacts alone."""
+    rows = trend_rows(load_round_artifacts())
+    if not rows:
+        print("no BENCH_r*.json artifacts found", file=sys.stderr)
+    else:
+        def fmt(v, spec=""):
+            return format(v, spec) if isinstance(v, (int, float)) else "-"
+
+        print(f"{'round':>5} {'median_s':>9} {'best_s':>7} {'spread':>7} "
+              f"{'dev_frac':>8} {'probe':>8} {'load':>6}  stages",
+              file=sys.stderr)
+        for r in rows:
+            stages = " ".join(f"{name}={fmt(secs, '.1f')}"
+                              for name, secs in (r["stages_s"] or {}).items())
+            flag = " UNTRUSTED" if r.get("untrusted") else ""
+            print(f"{fmt(r['round']):>5} {fmt(r['median_s'], '.2f'):>9} "
+                  f"{fmt(r['best_s'], '.2f'):>7} {fmt(r['spread_s'], '.2f'):>7} "
+                  f"{fmt(r['device_fraction'], '.4f'):>8} "
+                  f"{r['probe_kind'] or '-':>8} "
+                  f"{fmt(r['ambient_load'], '.2f'):>6}  {stages}{flag}",
+                  file=sys.stderr)
+    print(json.dumps({"bench": "trend", "rounds": rows}))
 
 
 def main() -> None:
@@ -845,6 +1096,8 @@ def main() -> None:
         bench_faultsmoke()
     elif len(sys.argv) > 1 and sys.argv[1] == "guard":
         bench_guard(sys.argv[2:])
+    elif len(sys.argv) > 1 and sys.argv[1] == "trend":
+        bench_trend()
     else:
         bench_headline()
 

@@ -1,0 +1,167 @@
+#!/usr/bin/env python3
+"""Multi-sample reads -> consensus driver, ported from the reference's
+community pipeline ``Auto-Autocycler_by_Tom_Stanton`` (multi-sample loop,
+per-sample auto genome size, assembler availability detection).
+
+Python port of this directory's ``autocycler_multisample.sh`` so the plan
+and the resume/failure semantics are unit-testable and the driver runs
+where bash is absent. Contracts carried over:
+
+- one output directory per sample (``<out>/<basename-of-reads>/``);
+- samples that already have a non-empty consensus are skipped, so an
+  interrupted batch resumes by re-running the same command;
+- a failing stage marks THAT sample failed and the batch continues (exit
+  status 1 if any sample failed, 0 otherwise);
+- a failed assembler job is tolerated — it just contributes nothing to
+  the consensus.
+
+Usage: auto_autocycler.py [options] <reads.fastq[.gz]> [...]
+
+Set ``AUTOCYCLER`` to override the CLI (default:
+``python -m autocycler_tpu``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+from autocycler_wrapper import autocycler_argv, estimate_genome_size
+
+ASSEMBLER_PANEL = ("canu", "flye", "lja", "metamdbg", "miniasm", "necat",
+                   "nextdenovo", "raven", "redbean")
+
+
+def sample_name(reads: str) -> str:
+    """``/x/SRR123.fastq.gz`` -> ``SRR123`` (same suffix stripping as the
+    shell driver)."""
+    name = Path(reads).name
+    for suffix in (".gz", ".fastq", ".fq"):
+        if name.endswith(suffix):
+            name = name[:-len(suffix)]
+    return name
+
+
+def detect_assemblers(panel=ASSEMBLER_PANEL, which=shutil.which) -> list:
+    """The subset of the panel present on PATH (``which`` injectable so
+    tests control the detected set)."""
+    return [a for a in panel if which(a)]
+
+
+def sample_plan(reads: str, sample_dir: str, genome_size: str,
+                assemblers, count: int, kmer: int, threads: int) -> list:
+    """One sample's command sequence as ``[(tolerate_failure, argv), ...]``
+    — pure, so tests assert the staging without assemblers installed."""
+    ac = autocycler_argv()
+    plan = [(False, ac + ["subsample", "--reads", str(reads),
+                          "--out_dir", f"{sample_dir}/subsampled_reads",
+                          "--genome_size", genome_size,
+                          "--count", str(count)])]
+    for a in assemblers:
+        for i in range(1, count + 1):
+            plan.append((True, ac + [
+                "helper", a,
+                "--reads", f"{sample_dir}/subsampled_reads/sample_{i:02d}.fastq",
+                "--out_prefix", f"{sample_dir}/assemblies/{a}_{i:02d}",
+                "--threads", str(threads), "--genome_size", genome_size]))
+    plan += [
+        (False, ac + ["compress", "-i", f"{sample_dir}/assemblies",
+                      "-a", str(sample_dir), "--kmer", str(kmer),
+                      "--threads", str(threads)]),
+        (False, ac + ["cluster", "-a", str(sample_dir)]),
+        (False, ["__per_cluster__", str(sample_dir), str(threads)]),
+    ]
+    return plan
+
+
+def run_sample(plan: list, dry_run: bool) -> bool:
+    """Execute one sample's plan; False means the sample failed (the batch
+    keeps going). Reuses the wrapper port's runner so the per-cluster
+    expansion and tolerated-failure semantics cannot drift between the two
+    drivers."""
+    from autocycler_wrapper import run_plan
+    try:
+        run_plan(plan, dry_run=dry_run)
+        return True
+    except SystemExit as e:
+        print(str(e), file=sys.stderr)
+        return False
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="multi-sample reads -> consensus driver "
+                    "(port of Auto-Autocycler_by_Tom_Stanton)")
+    p.add_argument("reads", nargs="+", help="one long-read set per sample")
+    p.add_argument("-o", "--out", default="multisample_out",
+                   help="output root; each sample gets <out>/<name>/")
+    p.add_argument("-t", "--threads", type=int, default=os.cpu_count() or 8)
+    p.add_argument("-c", "--count", type=int, default=4,
+                   help="subsample count")
+    p.add_argument("-k", "--kmer", type=int, default=51)
+    p.add_argument("-g", "--genome_size", default="auto",
+                   help='e.g. 5.5m; default "auto" = estimated per sample')
+    p.add_argument("-a", "--assemblers", nargs="+",
+                   help="assemblers to use (default: every panel assembler "
+                        "found on PATH)")
+    p.add_argument("--dry-run", action="store_true",
+                   help="print every command instead of executing")
+    args = p.parse_args(argv)
+
+    assemblers = args.assemblers or detect_assemblers()
+    if not assemblers and not args.dry_run:
+        print(f"Error: no assemblers from the panel ({' '.join(ASSEMBLER_PANEL)}) "
+              "are on PATH", file=sys.stderr)
+        return 1
+    if not assemblers:
+        assemblers = list(ASSEMBLER_PANEL)
+    print(f"assemblers: {' '.join(assemblers)}", file=sys.stderr)
+
+    fail = 0
+    for reads in args.reads:
+        name = sample_name(reads)
+        sample_dir = Path(args.out) / name
+        consensus = sample_dir / "consensus_assembly.fasta"
+        if consensus.is_file() and consensus.stat().st_size > 0:
+            print(f"=== {name}: consensus already present, skipping ===",
+                  file=sys.stderr)
+            continue
+        if not args.dry_run and not Path(reads).is_file():
+            print(f"Error: {reads} does not exist", file=sys.stderr)
+            fail = 1
+            continue
+        print(f"=== {name} ===", file=sys.stderr)
+
+        size = args.genome_size
+        if size == "auto":
+            if args.dry_run:
+                size = "<genome_size>"
+            else:
+                try:
+                    size = estimate_genome_size(reads, args.threads)
+                except (subprocess.CalledProcessError, OSError):
+                    print(f"{name}: genome size estimation failed (is raven "
+                          "installed?); skipping", file=sys.stderr)
+                    fail = 1
+                    continue
+                print(f"{name}: estimated genome size {size}", file=sys.stderr)
+        if not args.dry_run:
+            sample_dir.mkdir(parents=True, exist_ok=True)
+        plan = sample_plan(reads, str(sample_dir), size, assemblers,
+                           args.count, args.kmer, args.threads)
+        if run_sample(plan, args.dry_run):
+            if not args.dry_run:
+                print(f"=== {name}: done -> {consensus} ===", file=sys.stderr)
+        else:
+            print(f"=== {name}: FAILED (continuing with remaining samples) "
+                  "===", file=sys.stderr)
+            fail = 1
+    return fail
+
+
+if __name__ == "__main__":
+    sys.exit(main())

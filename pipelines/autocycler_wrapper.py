@@ -1,0 +1,149 @@
+#!/usr/bin/env python3
+"""One-command reads -> consensus wrapper, ported from the reference's
+community pipeline ``autocycler_wrapper_by_iskold`` (the deliberately
+small single-file driver): subsample, assemble with whatever assemblers
+are on PATH, then compress / cluster / trim / resolve / combine.
+
+Python port of this directory's ``autocycler_wrapper.sh`` so the plan is
+unit-testable and the driver runs where bash is absent. The flow is
+command-for-command the same; ``--dry-run`` prints every command instead
+of executing (assemblers included), and a sample whose consensus already
+exists is skipped, so re-running after an interruption resumes.
+
+Usage: autocycler_wrapper.py <reads.fastq[.gz]> <out_dir>
+                             [--subsets N] [--threads N] [--dry-run]
+
+Set ``AUTOCYCLER`` to override the CLI (default:
+``python -m autocycler_tpu``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import subprocess
+import sys
+from pathlib import Path
+
+# every assembler the helper knows; missing tools are skipped at run time
+# and a failed assembly is tolerated (the consensus design only needs most
+# to succeed)
+ASSEMBLER_PANEL = ("canu", "flye", "metamdbg", "miniasm", "myloasm",
+                   "necat", "nextdenovo", "raven", "redbean")
+
+
+def autocycler_argv() -> list:
+    """The CLI to drive, as argv — the AUTOCYCLER env var mirrors the shell
+    drivers' override contract."""
+    return shlex.split(os.environ.get("AUTOCYCLER",
+                                      f"{sys.executable} -m autocycler_tpu"))
+
+
+def build_plan(reads: str, out_dir: str, genome_size: str, subsets: int = 4,
+               threads: int = 8, assemblers=ASSEMBLER_PANEL) -> list:
+    """The full command sequence as ``[(tolerate_failure, argv), ...]`` —
+    pure (no filesystem, no subprocesses) so tests can assert the plan.
+    ``genome_size`` is a string because it may be a placeholder in dry
+    runs. Assembler steps are marked tolerated; pipeline stages are not."""
+    ac = autocycler_argv()
+    out = str(out_dir)
+    plan = [(False, ac + ["subsample", "--reads", str(reads),
+                          "--out_dir", f"{out}/subsampled_reads",
+                          "--genome_size", genome_size,
+                          "--count", str(subsets)])]
+    for i in range(1, subsets + 1):
+        for a in assemblers:
+            plan.append((True, ac + [
+                "helper", a,
+                "--reads", f"{out}/subsampled_reads/sample_{i:02d}.fastq",
+                "--out_prefix", f"{out}/assemblies/{a}_{i:02d}",
+                "--genome_size", genome_size,
+                "--threads", str(threads)]))
+    plan += [
+        (False, ac + ["compress", "-i", f"{out}/assemblies", "-a", out,
+                      "--threads", str(threads)]),
+        (False, ac + ["cluster", "-a", out]),
+        # trim/resolve/combine operate on the clusters that exist AFTER
+        # clustering ran; the runner expands this glob step at execution
+        (False, ["__per_cluster__", out, str(threads)]),
+    ]
+    return plan
+
+
+def estimate_genome_size(reads: str, threads: int) -> str:
+    argv = autocycler_argv() + ["helper", "genome_size", "--reads",
+                                str(reads), "--threads", str(threads)]
+    return subprocess.run(argv, check=True, stdout=subprocess.PIPE,
+                          text=True).stdout.strip()
+
+
+def _run(argv: list, tolerate: bool, dry_run: bool) -> bool:
+    if dry_run:
+        print("DRY-RUN: " + " ".join(argv))
+        return True
+    rc = subprocess.run(argv).returncode
+    if rc != 0 and not tolerate:
+        raise SystemExit(f"command failed ({rc}): {' '.join(argv)}")
+    return rc == 0
+
+
+def run_plan(plan: list, dry_run: bool = False) -> None:
+    for tolerate, argv in plan:
+        if argv and argv[0] == "__per_cluster__":
+            _run_per_cluster(argv[1], argv[2], dry_run)
+            continue
+        _run(argv, tolerate, dry_run)
+
+
+def _run_per_cluster(out: str, threads: str, dry_run: bool) -> None:
+    ac = autocycler_argv()
+    clusters = sorted(Path(out).glob("clustering/qc_pass/cluster_*"))
+    if dry_run and not clusters:
+        print(f"DRY-RUN: for each {out}/clustering/qc_pass/cluster_*: "
+              "trim + resolve; then combine")
+        return
+    for c in clusters:
+        _run(ac + ["trim", "-c", str(c), "--threads", threads],
+             tolerate=False, dry_run=dry_run)
+        _run(ac + ["resolve", "-c", str(c)], tolerate=False, dry_run=dry_run)
+    _run(ac + ["combine", "-a", out, "-i"]
+         + [f"{c}/5_final.gfa" for c in clusters],
+         tolerate=False, dry_run=dry_run)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="one-command reads -> consensus driver "
+                    "(port of autocycler_wrapper_by_iskold)")
+    p.add_argument("reads", help="long reads (fastq, optionally gzipped)")
+    p.add_argument("out_dir")
+    p.add_argument("--subsets", type=int, default=4)
+    p.add_argument("--threads", type=int, default=8)
+    p.add_argument("--assemblers", nargs="+", default=list(ASSEMBLER_PANEL))
+    p.add_argument("--dry-run", action="store_true",
+                   help="print every command instead of executing")
+    args = p.parse_args(argv)
+
+    consensus = Path(args.out_dir) / "consensus_assembly.fasta"
+    if consensus.is_file() and consensus.stat().st_size > 0:
+        print(f"consensus already present, skipping: {consensus}",
+              file=sys.stderr)
+        return 0
+    if args.dry_run:
+        size = "<genome_size>"
+    else:
+        print("Estimating genome size...", file=sys.stderr)
+        size = estimate_genome_size(args.reads, args.threads)
+        print(f"  {size} bp", file=sys.stderr)
+        Path(args.out_dir).mkdir(parents=True, exist_ok=True)
+    plan = build_plan(args.reads, args.out_dir, size, args.subsets,
+                      args.threads, args.assemblers)
+    run_plan(plan, dry_run=args.dry_run)
+    if not args.dry_run:
+        print(f"Consensus: {consensus}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

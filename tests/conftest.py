@@ -10,6 +10,11 @@ jax.config as well.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# keep the device probe in-process for the suite: the subprocess probe
+# (obs.sentinel) would pay a fresh interpreter+jax import per real probe,
+# and the wedge-simulation tests patch the in-process thread boundary.
+# Sentinel tests exercise subprocess mode explicitly with stub children.
+os.environ.setdefault("AUTOCYCLER_PROBE_MODE", "inline")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

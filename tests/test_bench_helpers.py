@@ -75,3 +75,128 @@ def test_mfu_conversions_anchor_to_v5e_peaks():
     expect = 8.0 * 5 * 2**27 * 10 / V5E_HBM_BYTES * 100
     assert abs(bw["pct_peak"] - round(expect, 1)) < 0.2
     assert sort_bandwidth(100, 1, 0.0) == {"gb_per_s": 0.0, "pct_peak": 0.0}
+
+
+# ---------------- environment-aware bench (host load context) ----------------
+
+def test_host_load_snapshot_and_context_shape():
+    before = bench.host_load_snapshot()
+    assert "ts" in before and "threads" in before
+    after = dict(before)
+    # synthesize 100 jiffies of delta, 40 of them idle -> busy 0.6
+    after["cpu_jiffies_total"] = before.get("cpu_jiffies_total", 0) + 100
+    after["cpu_jiffies_idle"] = before.get("cpu_jiffies_idle", 0) + 40
+    ctx = bench.host_load_context(before, after)
+    assert ctx["cpu_count"] >= 1
+    assert ctx["loadavg_before"] == before["loadavg"]
+    if "cpu_jiffies_total" in before:
+        assert ctx["cpu_busy_frac"] == 0.6
+    if before["loadavg"]:
+        assert ctx["ambient_load_per_cpu"] == \
+            round(before["loadavg"][0] / ctx["cpu_count"], 4)
+
+
+def test_untrusted_reason_threshold(monkeypatch):
+    monkeypatch.delenv("AUTOCYCLER_BENCH_LOAD_MAX", raising=False)
+    assert bench.untrusted_reason({"ambient_load_per_cpu": 0.4}) == ""
+    reason = bench.untrusted_reason({"ambient_load_per_cpu": 0.9})
+    assert "busy machine" in reason
+    # missing context never marks a run untrusted
+    assert bench.untrusted_reason({}) == ""
+    monkeypatch.setenv("AUTOCYCLER_BENCH_LOAD_MAX", "1.5")
+    assert bench.untrusted_reason({"ambient_load_per_cpu": 0.9}) == ""
+
+
+# ---------------- guard device_fraction floor ----------------
+
+def test_guard_device_floor_enforced_only_when_probe_ok():
+    baseline = {"device_fraction_floor": 0.1}
+    low = {"device_fraction": 0.01}
+    # healthy probe + below floor -> failure
+    fails = bench.guard_device_floor(baseline, low, "ok")
+    assert len(fails) == 1 and "device_fraction" in fails[0]
+    # any non-ok probe kind skips the floor entirely
+    for kind in ("timeout", "error", "no-tpu", "pinned", None):
+        assert bench.guard_device_floor(baseline, low, kind) == []
+    # at/above the floor passes
+    assert bench.guard_device_floor(baseline, {"device_fraction": 0.1},
+                                    "ok") == []
+    # no floor recorded (old baselines) -> never fails
+    assert bench.guard_device_floor({}, low, "ok") == []
+    assert bench.guard_device_floor({"device_fraction_floor": 0.0}, low,
+                                    "ok") == []
+    # a missing measurement with a healthy probe IS a failure
+    assert "absent" in bench.guard_device_floor(baseline, {}, "ok")[0]
+
+
+def test_guard_failures_ignores_non_numeric_baseline_fields():
+    # BENCH_GUARD.json grew device_fraction_floor / recorded_* fields at the
+    # top level; the metrics comparison must not treat them as wall metrics
+    baseline = {"compress_4x5Mbp_s": 10.0}
+    measured = {"compress_4x5Mbp_s": 10.0, "device_fraction": 0.0}
+    assert bench.guard_failures(baseline, measured) == []
+
+
+# ---------------- bench trend ----------------
+
+def _driver_artifact(n, parsed):
+    return {"n": n, "cmd": "python bench.py", "rc": 0, "tail": "",
+            "parsed": parsed}
+
+
+def test_load_round_artifacts_unwraps_and_sorts(tmp_path):
+    import json as _json
+
+    (tmp_path / "BENCH_r02.json").write_text(_json.dumps(
+        _driver_artifact(2, {"value": 50.0})))
+    (tmp_path / "BENCH_r01.json").write_text(_json.dumps(
+        _driver_artifact(1, {"value": 60.0})))
+    (tmp_path / "BENCH_r03.json").write_text("not json at all")
+    arts = bench.load_round_artifacts(tmp_path)
+    assert [a["round"] for a in arts] == [1, 2]
+    assert arts[0]["parsed"]["value"] == 60.0
+
+
+def test_trend_rows_tolerates_schema_evolution():
+    arts = [
+        # r01-era artifact: bare value only
+        {"round": 1, "path": "BENCH_r01.json", "parsed": {"value": 61.0}},
+        # r05-era artifact: stages + probe + runs
+        {"round": 5, "path": "BENCH_r05.json", "parsed": {
+            "median_s": 50.0, "runs_s": [48.0, 50.0, 55.0],
+            "device_fraction": 0.0,
+            "device_probe": {"kind": "timeout"},
+            "stages": {"compress": {"seconds": 20.0},
+                       "cluster": {"seconds": 12.0}}}},
+        # r06-era artifact: host_env + untrusted
+        {"round": 6, "path": "BENCH_r06.json", "parsed": {
+            "median_s": 39.0, "runs_s": [38.0, 39.0, 40.0],
+            "device_fraction": 0.2, "device_probe": {"kind": "ok"},
+            "host_env": {"ambient_load_per_cpu": 0.8},
+            "untrusted": "busy"}},
+    ]
+    rows = bench.trend_rows(arts)
+    assert [r["round"] for r in rows] == [1, 5, 6]
+    r1, r5, r6 = rows
+    assert r1["median_s"] == 61.0 and r1["probe_kind"] is None
+    assert r5["best_s"] == 48.0 and r5["spread_s"] == 7.0
+    assert r5["probe_kind"] == "timeout"
+    assert r5["stages_s"] == {"compress": 20.0, "cluster": 12.0}
+    assert r6["ambient_load"] == 0.8 and r6["untrusted"] == "busy"
+
+
+def test_bench_trend_renders_and_prints_json(tmp_path, monkeypatch, capsys):
+    import json as _json
+
+    (tmp_path / "BENCH_r01.json").write_text(_json.dumps(
+        _driver_artifact(1, {"value": 61.0})))
+    monkeypatch.setattr(
+        bench, "load_round_artifacts",
+        lambda root=None: [{"round": 1, "path": "BENCH_r01.json",
+                            "parsed": {"value": 61.0}}])
+    bench.bench_trend()
+    captured = capsys.readouterr()
+    line = _json.loads(captured.out)
+    assert line["bench"] == "trend"
+    assert line["rounds"][0]["median_s"] == 61.0
+    assert "round" in captured.err  # the stderr table rendered

@@ -1,0 +1,63 @@
+"""Regression test for the containment memo in commands/cluster.py
+(VERDICT weak №6): the old key used id(distances), which can alias two
+DISTINCT dicts — equal len and id tuple — once the first is garbage
+collected and its id recycled, silently reusing the wrong containment
+matrix. The fix keys on object identity via a held strong reference."""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from autocycler_tpu.commands import cluster as cl  # noqa: E402
+
+
+def _dists(d01):
+    """An asymmetric 2-sequence distance dict: d(0,1)=d01, d(1,0)=0.9 —
+    sequence 0 is contained in 1 iff d01 < 0.9 and d01 < cutoff."""
+    return {(0, 0): 0.0, (1, 1): 0.0, (0, 1): d01, (1, 0): 0.9}
+
+
+def test_distinct_dicts_with_equal_len_and_ids_do_not_alias():
+    cl._contain_cache.clear()
+    ids = (0, 1)
+    a = _dists(0.05)   # contained pair under cutoff 0.2
+    first = cl._contain_ab_cached(a, 0.2, ids)
+    assert first.any()
+    # same len, same ids, same cutoff — different object, different values
+    b = _dists(0.95)   # NOT contained under cutoff 0.2
+    second = cl._contain_ab_cached(b, 0.2, ids)
+    assert not second.any(), \
+        "cache served dict a's matrix for distinct dict b"
+    cl._contain_cache.clear()
+
+
+def test_id_recycling_cannot_serve_stale_matrix():
+    """Simulates CPython id reuse: force the cached dict's id onto a new
+    dict by freeing the first — with the identity fix the new dict misses
+    regardless of what id() says."""
+    cl._contain_cache.clear()
+    ids = (0, 1)
+    a = _dists(0.05)
+    cl._contain_ab_cached(a, 0.2, ids)
+    # drop every strong ref except the cache's own; the cache must STILL
+    # not serve a's matrix to a different dict, however ids collide
+    del a
+    b = _dists(0.95)
+    assert not cl._contain_ab_cached(b, 0.2, ids).any()
+    cl._contain_cache.clear()
+
+
+def test_same_dict_hits_and_cutoff_change_misses():
+    cl._contain_cache.clear()
+    ids = (0, 1)
+    a = _dists(0.15)
+    m1 = cl._contain_ab_cached(a, 0.2, ids)
+    m2 = cl._contain_ab_cached(a, 0.2, ids)
+    assert m1 is m2  # the memo actually memoises
+    m3 = cl._contain_ab_cached(a, 0.1, ids)
+    assert m3 is not m2
+    assert np.asarray(m1).any() and not np.asarray(m3).any()
+    cl._contain_cache.clear()

@@ -1,0 +1,138 @@
+"""Per-kernel device telemetry (utils.timing + ops.mfu): first-call vs
+steady-state phase split, flops/bytes accounting, the snapshot shape and
+the MFU-anchored kernel rates."""
+
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from autocycler_tpu.ops import mfu  # noqa: E402
+from autocycler_tpu.utils import timing  # noqa: E402
+
+pytestmark = pytest.mark.obs
+
+_uniq = iter(range(10_000))
+
+
+def _kernel():
+    return f"telemetry test kernel {next(_uniq)}"
+
+
+def test_first_then_steady_phase_split():
+    kernel = _kernel()
+    for _ in range(3):
+        with timing.device_dispatch(kernel):
+            pass
+    snap = timing.device_kernel_snapshot()[kernel]
+    assert snap["first"]["count"] == 1
+    assert snap["steady"]["count"] == 2
+    for phase in ("first", "steady"):
+        stats = snap[phase]
+        assert stats["total_s"] >= 0
+        assert stats["min_s"] <= stats["mean_s"] <= stats["max_s"]
+
+
+def test_flops_and_bytes_accumulate_per_phase():
+    kernel = _kernel()
+    for _ in range(2):
+        with timing.device_dispatch(kernel, flops=1e9, bytes_moved=2e6):
+            pass
+    snap = timing.device_kernel_snapshot()[kernel]
+    assert snap["first"]["flops"] == 1e9
+    assert snap["steady"]["flops"] == 1e9
+    assert snap["steady"]["bytes"] == 2e6
+
+
+def test_failure_still_records_the_dispatch():
+    kernel = _kernel()
+    with pytest.raises(RuntimeError):
+        with timing.device_dispatch(kernel):
+            raise RuntimeError("boom")
+    snap = timing.device_kernel_snapshot()[kernel]
+    assert snap["first"]["count"] == 1
+
+
+def test_phase_survives_first_call_failure():
+    # the first (failed) dispatch still consumes the "first" slot: the
+    # retry's latency has no compile in it only if compilation happened,
+    # but the split must stay deterministic either way
+    kernel = _kernel()
+    with pytest.raises(ValueError):
+        with timing.device_dispatch(kernel):
+            raise ValueError
+    with timing.device_dispatch(kernel):
+        pass
+    snap = timing.device_kernel_snapshot()[kernel]
+    assert snap["first"]["count"] == 1 and snap["steady"]["count"] == 1
+
+
+# ---------------- kernel_rates (ops.mfu) ----------------
+
+def test_kernel_rates_prefers_steady_and_anchors_peaks():
+    kernels = {
+        "matmul": {
+            "first": {"count": 1, "total_s": 2.0, "flops": 1e12},
+            "steady": {"count": 4, "total_s": 1.0, "flops": 98.5e12},
+        },
+        "sort": {
+            "first": {"count": 1, "total_s": 0.5, "bytes": 40.95e9},
+        },
+        "empty": {"first": {"count": 0, "total_s": 0.0}},
+    }
+    rates = mfu.kernel_rates(kernels)
+    mm = rates["matmul"]
+    assert mm["phase"] == "steady" and mm["count"] == 4
+    assert mm["tflops"] == pytest.approx(98.5, abs=0.01)
+    # 98.5e12 flops/s on a 197e12 peak = 50%
+    assert mm["pct_peak_bf16"] == pytest.approx(50.0, abs=0.1)
+    srt = rates["sort"]
+    assert srt["phase"] == "first"
+    assert srt["gb_per_s"] == pytest.approx(81.9, abs=0.1)
+    # 81.9e9 B/s against the 819e9 HBM peak = 10%
+    assert srt["pct_peak_hbm"] == pytest.approx(10.0, abs=0.1)
+    assert "empty" not in rates
+
+
+def test_kernel_rates_without_work_hints_reports_only_timing():
+    rates = mfu.kernel_rates(
+        {"k": {"steady": {"count": 2, "total_s": 0.5}}})
+    assert rates["k"]["mean_s"] == 0.25
+    assert "tflops" not in rates["k"] and "gb_per_s" not in rates["k"]
+
+
+# ---------------- XPROF capture gating ----------------
+
+def test_xprof_disabled_without_env(monkeypatch):
+    monkeypatch.delenv("AUTOCYCLER_XPROF", raising=False)
+    kernel = _kernel()
+    with timing.device_dispatch(kernel):
+        pass
+    assert kernel not in timing._xprof_counts
+
+
+def test_xprof_capture_limit_and_trace_paths(tmp_path, monkeypatch):
+    # jax.profiler on CPU works fine; default limit is 2 captures/kernel
+    monkeypatch.setenv("AUTOCYCLER_XPROF", str(tmp_path))
+    kernel = _kernel() + " spaced/name"
+    for _ in range(4):
+        with timing.device_dispatch(kernel):
+            time.sleep(0.001)
+    assert timing._xprof_counts[kernel] == 2
+    traces = sorted(tmp_path.iterdir())
+    assert len(traces) == 2
+    # path is sanitised: no spaces or slashes from the kernel name
+    assert all(" " not in t.name and "/" not in t.name for t in traces)
+
+
+def test_xprof_limit_env_override(tmp_path, monkeypatch):
+    monkeypatch.setenv("AUTOCYCLER_XPROF", str(tmp_path))
+    monkeypatch.setenv("AUTOCYCLER_XPROF_LIMIT", "1")
+    kernel = _kernel()
+    for _ in range(3):
+        with timing.device_dispatch(kernel):
+            pass
+    assert len(list(tmp_path.iterdir())) == 1

@@ -90,10 +90,14 @@ def test_guard_reports_all_regressions_sorted():
 def test_guard_subcommand_end_to_end(tmp_path, monkeypatch):
     """`python bench.py guard` records a baseline on first run (exit 0),
     passes against itself on the second, and fails non-zero with a clear
-    message against a sabotaged baseline."""
+    message against a sabotaged baseline. AUTOCYCLER_BENCH_LOAD_MAX is
+    pinned high so a busy CI host cannot demote the forced regression to
+    an untrusted run (that path has its own tests in
+    test_bench_helpers.py)."""
     import os
 
-    env = dict(os.environ, JAX_PLATFORMS="cpu", AUTOCYCLER_BENCH_THREADS="2")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", AUTOCYCLER_BENCH_THREADS="2",
+               AUTOCYCLER_BENCH_LOAD_MAX="1e9")
     baseline = REPO / "BENCH_GUARD.json"
     backup = baseline.read_text() if baseline.exists() else None
     try:

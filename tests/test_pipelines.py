@@ -1,0 +1,159 @@
+"""The Python pipeline ports (pipelines/autocycler_wrapper.py,
+pipelines/auto_autocycler.py): plan shape, resume contracts, assembler
+detection and the --dry-run smoke — no assemblers or subprocesses needed."""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+PIPELINES = Path(__file__).resolve().parent.parent / "pipelines"
+sys.path.insert(0, str(PIPELINES))
+
+import auto_autocycler  # noqa: E402
+import autocycler_wrapper  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _default_cli(monkeypatch):
+    monkeypatch.setenv("AUTOCYCLER", "autocycler")
+
+
+# ---------------- iskold wrapper port ----------------
+
+def test_wrapper_build_plan_staging():
+    plan = autocycler_wrapper.build_plan(
+        "r.fastq", "out", "5.5m", subsets=2, threads=3,
+        assemblers=("flye", "raven"))
+    cmds = [argv for _, argv in plan]
+    assert cmds[0][:2] == ["autocycler", "subsample"]
+    assert "--genome_size" in cmds[0] and "5.5m" in cmds[0]
+    # 2 subsets x 2 assemblers of tolerated helper jobs, in subset order
+    helper_cmds = [argv for tol, argv in plan if argv[1:2] == ["helper"]]
+    assert len(helper_cmds) == 4
+    assert all(tol for tol, argv in plan if argv[1:2] == ["helper"])
+    assert any("out/subsampled_reads/sample_01.fastq" in " ".join(c)
+               for c in helper_cmds)
+    # pipeline stages are NOT tolerated and appear after the assemblers
+    assert cmds[-3][1] == "compress" and cmds[-2][1] == "cluster"
+    assert cmds[-1][0] == "__per_cluster__"
+    assert not any(tol for tol, argv in plan if argv[1:2] != ["helper"])
+
+
+def test_wrapper_env_override_controls_argv(monkeypatch):
+    monkeypatch.setenv("AUTOCYCLER", "python -m autocycler_tpu")
+    assert autocycler_wrapper.autocycler_argv() == \
+        ["python", "-m", "autocycler_tpu"]
+
+
+def test_wrapper_dry_run_prints_plan_and_runs_nothing(tmp_path, capsys,
+                                                      monkeypatch):
+    def boom(*a, **k):
+        raise AssertionError("dry run must not spawn subprocesses")
+
+    monkeypatch.setattr(autocycler_wrapper.subprocess, "run", boom)
+    rc = autocycler_wrapper.main(["r.fastq", str(tmp_path / "out"),
+                                  "--subsets", "1",
+                                  "--assemblers", "flye", "--dry-run"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    lines = [l for l in out.splitlines() if l.startswith("DRY-RUN:")]
+    assert any("subsample" in l for l in lines)
+    assert any("helper flye" in l for l in lines)
+    assert any("compress" in l for l in lines)
+    assert any("cluster_*" in l for l in lines)  # the per-cluster expansion
+    assert "<genome_size>" in out  # dry runs never estimate
+
+
+def test_wrapper_resume_skips_existing_consensus(tmp_path, capsys):
+    out = tmp_path / "out"
+    out.mkdir()
+    (out / "consensus_assembly.fasta").write_text(">x\nACGT\n")
+    rc = autocycler_wrapper.main(["r.fastq", str(out), "--dry-run"])
+    assert rc == 0
+    captured = capsys.readouterr()
+    assert "already present" in captured.err
+    assert "DRY-RUN" not in captured.out
+
+
+def test_wrapper_run_plan_raises_on_pipeline_stage_failure(monkeypatch):
+    calls = []
+
+    class P:
+        returncode = 1
+
+    monkeypatch.setattr(autocycler_wrapper.subprocess, "run",
+                        lambda argv: calls.append(argv) or P())
+    # tolerated step failing is fine; untolerated raises SystemExit
+    autocycler_wrapper.run_plan([(True, ["helper"])])
+    with pytest.raises(SystemExit):
+        autocycler_wrapper.run_plan([(False, ["compress"])])
+    assert calls == [["helper"], ["compress"]]
+
+
+# ---------------- Tom Stanton Auto-Autocycler port ----------------
+
+def test_sample_name_strips_read_suffixes():
+    assert auto_autocycler.sample_name("/a/b/SRR1.fastq.gz") == "SRR1"
+    assert auto_autocycler.sample_name("x.fq") == "x"
+    assert auto_autocycler.sample_name("plain.fastq") == "plain"
+
+
+def test_detect_assemblers_injectable_which():
+    found = auto_autocycler.detect_assemblers(
+        panel=("flye", "raven", "canu"),
+        which=lambda a: "/usr/bin/" + a if a in ("raven",) else None)
+    assert found == ["raven"]
+
+
+def test_sample_plan_staging():
+    plan = auto_autocycler.sample_plan(
+        "r.fastq", "out/s1", "auto_size", ("flye",), count=2, kmer=41,
+        threads=2)
+    cmds = [argv for _, argv in plan]
+    assert cmds[0][1] == "subsample"
+    compress = next(c for c in cmds if c[1:2] == ["compress"])
+    assert "--kmer" in compress and "41" in compress
+    assert cmds[-1] == ["__per_cluster__", "out/s1", "2"]
+
+
+def test_multisample_dry_run_batches_and_resumes(tmp_path, capsys,
+                                                 monkeypatch):
+    def boom(*a, **k):
+        raise AssertionError("dry run must not spawn subprocesses")
+
+    monkeypatch.setattr(auto_autocycler.subprocess, "run", boom)
+    out = tmp_path / "multi"
+    done = out / "done_sample"
+    done.mkdir(parents=True)
+    (done / "consensus_assembly.fasta").write_text(">x\nACGT\n")
+    rc = auto_autocycler.main(
+        ["done_sample.fastq", "fresh_sample.fastq", "-o", str(out),
+         "-a", "flye", "--dry-run"])
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert "done_sample: consensus already present" in captured.err
+    assert "=== fresh_sample ===" in captured.err
+    assert any("fresh_sample" in l for l in captured.out.splitlines()
+               if l.startswith("DRY-RUN:"))
+
+
+def test_multisample_missing_reads_marks_batch_failed(tmp_path, capsys):
+    rc = auto_autocycler.main(
+        ["does_not_exist.fastq", "-o", str(tmp_path), "-a", "flye"])
+    assert rc == 1
+    assert "does not exist" in capsys.readouterr().err
+
+
+def test_multisample_failed_sample_continues_batch(tmp_path, monkeypatch,
+                                                   capsys):
+    monkeypatch.setattr(auto_autocycler, "run_sample",
+                        lambda plan, dry: False)
+    rc = auto_autocycler.main(
+        ["a.fastq", "b.fastq", "-o", str(tmp_path), "-a", "flye",
+         "-g", "5m", "--dry-run"])
+    err = capsys.readouterr().err
+    assert rc == 1
+    # both samples were attempted despite the first failing
+    assert "=== a ===" in err and "=== b ===" in err
+    assert err.count("FAILED (continuing") == 2

@@ -1,0 +1,223 @@
+"""Probe sentinel (obs.sentinel): subprocess probe outcomes (ok / wedge),
+environment snapshot, probe_log.jsonl schema, the false->true recovery
+transition firing hooks exactly once, and negative-cache clearing on
+recovery."""
+
+import json
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from autocycler_tpu.obs import sentinel  # noqa: E402
+from autocycler_tpu.ops import distance  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_sentinel():
+    sentinel._reset_for_tests()
+    yield
+    sentinel._reset_for_tests()
+
+
+def _stub_probe_argv(monkeypatch, body: str):
+    """Replace the probe child with a tiny jax-free script."""
+    monkeypatch.setattr(sentinel, "_probe_argv",
+                        lambda: [sys.executable, "-c",
+                                 textwrap.dedent(body)])
+
+
+# ---------------- environment snapshot ----------------
+
+def test_environment_snapshot_shape(monkeypatch):
+    monkeypatch.setenv("AUTOCYCLER_PROBE_WATCH", "12")
+    snap = sentinel.environment_snapshot()
+    for key in ("jax_platforms", "env", "plugin_versions", "accel_devices",
+                "python", "platform", "cpu_count", "pid"):
+        assert key in snap, key
+    # the suite pins JAX_PLATFORMS=cpu (conftest) — both views agree
+    assert snap["jax_platforms"] == "cpu"
+    assert snap["env"]["JAX_PLATFORMS"] == "cpu"
+    assert snap["env"]["AUTOCYCLER_PROBE_WATCH"] == "12"
+    assert isinstance(snap["accel_devices"], list)
+    json.dumps(snap)  # must be a JSON-serialisable artifact
+
+
+# ---------------- subprocess probe ----------------
+
+def test_subprocess_probe_parses_marker_outcome(monkeypatch):
+    _stub_probe_argv(monkeypatch, """
+        import json, sys
+        print("noise before the marker")
+        sys.stderr.write("PJRT init chatter\\n")
+        print("AUTOCYCLER_PROBE:" + json.dumps(
+            {"attached": True, "kind": "ok", "reason": "stub",
+             "backend": "tpu", "device_count": 1, "seconds": 0.01}))
+    """)
+    out = sentinel.subprocess_probe(deadline=30)
+    assert out["attached"] is True and out["kind"] == "ok"
+    assert out["mode"] == "subprocess"
+    assert out["backend"] == "tpu" and out["device_count"] == 1
+    assert "PJRT init chatter" in out["stderr_tail"]
+    assert out["seconds"] >= 0
+
+
+def test_subprocess_probe_kills_wedged_child_and_keeps_stderr(monkeypatch):
+    _stub_probe_argv(monkeypatch, """
+        import sys, time
+        sys.stderr.write("libtpu: opening transport...\\n")
+        sys.stderr.flush()
+        time.sleep(60)
+    """)
+    out = sentinel.subprocess_probe(deadline=1.5)
+    assert out["attached"] is False and out["kind"] == "timeout"
+    assert "wedged transport" in out["reason"]
+    assert "libtpu: opening transport" in out.get("stderr_tail", "")
+    assert out["seconds"] < 30  # killed at the deadline, not abandoned
+
+
+def test_subprocess_probe_child_crash_is_diagnosed(monkeypatch):
+    _stub_probe_argv(monkeypatch, "import sys; sys.exit(7)")
+    out = sentinel.subprocess_probe(deadline=10)
+    assert out["attached"] is False and out["kind"] == "error"
+    assert "exited 7" in out["reason"]
+
+
+def test_real_probe_child_answers_no_tpu_on_pinned_cpu():
+    # the UNSTUBBED child on this host: JAX_PLATFORMS=cpu (conftest) means
+    # the backend initialises as cpu -> a clean no-tpu diagnosis
+    out = sentinel.subprocess_probe(deadline=120)
+    assert out["kind"] == "no-tpu" and out["attached"] is False
+    assert out["backend"] == "cpu"
+
+
+# ---------------- probe_log.jsonl ----------------
+
+def test_record_outcome_appends_schema_lines(tmp_path):
+    sentinel.set_probe_log_dir(tmp_path)
+    sentinel.record_outcome({"attached": False, "kind": "timeout",
+                             "reason": "stub wedge", "seconds": 1.0,
+                             "stderr_tail": "x" * 5000}, source="gate")
+    entries = sentinel.read_probe_log()
+    assert len(entries) == 1
+    e = entries[0]
+    for key in ("ts", "source", "attached", "kind", "reason", "seconds"):
+        assert key in e, key
+    assert e["source"] == "gate"
+    assert len(e["stderr_tail"]) == 2000  # tail truncated into the log
+
+
+def test_probe_log_dir_precedence(tmp_path, monkeypatch):
+    a, b, c = tmp_path / "explicit", tmp_path / "env", tmp_path / "fallback"
+    sentinel.set_probe_log_dir(c, fallback=True)
+    assert sentinel.probe_log_path().parent == c
+    monkeypatch.setenv("AUTOCYCLER_TRACE_DIR", str(b))
+    assert sentinel.probe_log_path().parent == b
+    sentinel.set_probe_log_dir(a)
+    assert sentinel.probe_log_path().parent == a
+
+
+def test_read_probe_log_skips_malformed_lines(tmp_path):
+    path = tmp_path / "probe_log.jsonl"
+    path.write_text('{"ok": 1}\nnot json\n\n{"ok": 2}\n')
+    entries = sentinel.read_probe_log(path)
+    assert [e["ok"] for e in entries] == [1, 2]
+    assert sentinel.read_probe_log(path, limit=1) == [{"ok": 2}]
+
+
+# ---------------- recovery transition ----------------
+
+def _outcome(attached):
+    return {"attached": attached,
+            "kind": "ok" if attached else "timeout",
+            "reason": "stub", "seconds": 0.0}
+
+
+def test_false_to_true_transition_fires_hook_exactly_once(tmp_path):
+    sentinel.set_probe_log_dir(tmp_path)
+    fired = []
+    sentinel.on_recovery(fired.append)
+    seq = [False, False, True, True, False, True]
+    watcher = sentinel.ProbeWatcher(
+        interval=0.01, deadline=1.0,
+        probe_fn=lambda deadline: _outcome(seq.pop(0)))
+    for _ in range(6):
+        watcher.cycle()
+    assert len(fired) == 1
+    assert fired[0]["kind"] == "ok"
+    # the recovery event itself is logged
+    types = [e.get("type") for e in sentinel.read_probe_log()]
+    assert types.count("recovery") == 1
+
+
+def test_true_first_probe_never_fires_hook(tmp_path):
+    sentinel.set_probe_log_dir(tmp_path)
+    fired = []
+    sentinel.on_recovery(fired.append)
+    for attached in (True, True):
+        sentinel.record_outcome(_outcome(attached))
+    assert fired == []
+
+
+def test_recovery_clears_negative_probe_cache(tmp_path, monkeypatch):
+    # a persisted negative + failed in-memory state, as after a wedge
+    cache = tmp_path / "cache"
+    cache.mkdir()
+    (cache / "device_probe.json").write_text(
+        json.dumps({"kind": "timeout", "reason": "wedged", "at": 0}))
+    distance._tpu_attached.cache_clear()
+    monkeypatch.setattr(distance, "_probe_cache_dir", str(cache))
+    with distance._PROBE_LOCK:
+        distance._probe_state.update(attached=False, cached=True, fails=3,
+                                     kind="timeout")
+    sentinel.set_probe_log_dir(tmp_path)
+    sentinel.record_outcome(_outcome(False))
+    assert (cache / "device_probe.json").exists()
+    sentinel.record_outcome(_outcome(True))
+    assert not (cache / "device_probe.json").exists()
+    with distance._PROBE_LOCK:
+        assert distance._probe_state["cached"] is False
+        assert distance._probe_state["fails"] == 0
+    distance._tpu_attached.cache_clear()
+
+
+def test_hook_exception_does_not_kill_the_watcher(tmp_path, capsys):
+    sentinel.set_probe_log_dir(tmp_path)
+    good = []
+    sentinel.on_recovery(lambda e: (_ for _ in ()).throw(RuntimeError("x")))
+    sentinel.on_recovery(good.append)
+    sentinel.record_outcome(_outcome(False))
+    sentinel.record_outcome(_outcome(True))
+    assert len(good) == 1
+    assert "recovery hook failed" in capsys.readouterr().err
+
+
+# ---------------- watcher config ----------------
+
+def test_watch_interval_parsing(monkeypatch):
+    monkeypatch.delenv("AUTOCYCLER_PROBE_WATCH", raising=False)
+    assert sentinel.watch_interval() is None
+    monkeypatch.setenv("AUTOCYCLER_PROBE_WATCH", "30")
+    assert sentinel.watch_interval() == 30.0
+    monkeypatch.setenv("AUTOCYCLER_PROBE_WATCH", "0")
+    assert sentinel.watch_interval() is None
+    monkeypatch.setenv("AUTOCYCLER_PROBE_WATCH", "banana")
+    assert sentinel.watch_interval() is None
+
+
+def test_maybe_start_watcher_disabled_without_env(monkeypatch):
+    monkeypatch.delenv("AUTOCYCLER_PROBE_WATCH", raising=False)
+    assert sentinel.maybe_start_watcher() is None
+
+
+def test_probe_deadline_env_precedence(monkeypatch):
+    monkeypatch.delenv("AUTOCYCLER_PROBE_DEADLINE_S", raising=False)
+    monkeypatch.delenv("AUTOCYCLER_DEVICE_PROBE_TIMEOUT", raising=False)
+    assert sentinel.probe_deadline() == 60.0
+    monkeypatch.setenv("AUTOCYCLER_DEVICE_PROBE_TIMEOUT", "15")
+    assert sentinel.probe_deadline() == 15.0
+    monkeypatch.setenv("AUTOCYCLER_PROBE_DEADLINE_S", "5")
+    assert sentinel.probe_deadline() == 5.0
