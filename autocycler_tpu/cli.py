@@ -144,6 +144,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "(an AUTOCYCLER_TRACE_DIR run dir or an output dir)")
     p.add_argument("--json", action="store_true",
                    help="emit the merged report as JSON instead of text")
+    p.add_argument("--html", nargs="?", const="", default=None,
+                   metavar="PATH",
+                   help="additionally write a self-contained run_report.html "
+                        "(into the run dir, or to PATH when given)")
 
     p = sub.add_parser("resolve", help="resolve repeats in the unitig graph")
     p.add_argument("-c", "--cluster_dir", required=True)
@@ -163,6 +167,25 @@ def build_parser() -> argparse.ArgumentParser:
     from .commands.table import DEFAULT_FIELDS
     p.add_argument("-f", "--fields", default=DEFAULT_FIELDS)
     p.add_argument("-s", "--sigfigs", type=int, default=3)
+
+    p = sub.add_parser("watch",
+                       help="follow another process's run live: tail a run "
+                            "directory's trace.jsonl and render the stage/"
+                            "isolate tree with QC highlights")
+    p.add_argument("run_dir",
+                   help="the run's AUTOCYCLER_TRACE_DIR directory "
+                        "(holds trace.jsonl)")
+    p.add_argument("--follow", action="store_true",
+                   help="keep polling and re-rendering until the run "
+                        "finishes (default: render once and exit)")
+    p.add_argument("--once", action="store_true",
+                   help="render the current state once and exit "
+                        "(the default; overrides --follow)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="--follow poll interval in seconds (default 2)")
+    p.add_argument("--cycles", type=int,
+                   help="--follow: stop after this many polls even if the "
+                        "run has not finished")
 
     p = sub.add_parser("trim", help="trim contigs in a cluster")
     p.add_argument("-c", "--cluster_dir", required=True)
@@ -218,7 +241,7 @@ def dispatch(args) -> int:
                args.extra_args, timeout=args.timeout, retries=args.retries)
     elif args.command == "report":
         from .obs.report import report
-        return report(args.run_dir, as_json=args.json)
+        return report(args.run_dir, as_json=args.json, html=args.html)
     elif args.command == "resolve":
         from .commands.resolve import resolve
         resolve(args.cluster_dir, args.verbose)
@@ -233,6 +256,10 @@ def dispatch(args) -> int:
         from .commands.trim import trim
         trim(args.cluster_dir, args.min_identity, args.max_unitigs, args.mad,
              args.threads)
+    elif args.command == "watch":
+        from .obs.watch import watch
+        return watch(args.run_dir, follow=args.follow and not args.once,
+                     interval=args.interval, cycles=args.cycles)
 
 
 # Subcommands that build the reference-cyclic unitig graph (next/prev
@@ -272,12 +299,17 @@ def main(argv=None) -> int:
         import gc
         gc.disable()
     from .obs import trace
-    # `report` reads a previous run's telemetry — tracing it would clutter
-    # (or append to) the very artifacts it renders. `doctor` likewise only
-    # inspects state (and must stay side-effect-free on a wedged host).
-    owns_run = (args.command not in ("report", "doctor")
+    # `report` and `watch` read a previous/other run's telemetry — tracing
+    # them would clutter (or clobber) the very artifacts they render.
+    # `doctor` likewise only inspects state (and must stay side-effect-free
+    # on a wedged host).
+    owns_run = (args.command not in ("report", "doctor", "watch")
                 and trace.maybe_start_run(name=args.command))
-    if args.command not in ("report", "doctor"):
+    if owns_run:
+        from .obs import ledger, qc
+        qc.reset()
+        ledger.reset()
+    if args.command not in ("report", "doctor", "watch"):
         from .obs import sentinel
         sentinel.maybe_start_watcher()
     try:
@@ -289,7 +321,11 @@ def main(argv=None) -> int:
         return 1
     finally:
         if owns_run:
-            trace.finish_run()
+            run_dir = trace.finish_run()
+            if run_dir:
+                from .obs import ledger, qc
+                qc.write_qc_report(run_dir)
+                ledger.write_ledger(run_dir, command=args.command)
         metrics_path = os.environ.get("AUTOCYCLER_METRICS")
         if metrics_path:
             trace.write_metrics_file(metrics_path)

@@ -34,7 +34,8 @@ from pathlib import Path
 from typing import List
 
 from ..models.simplify import simplify_structure
-from ..obs import trace
+from ..obs import ledger, trace
+from ..obs import qc as obs_qc
 from ..ops.distance import intersections_to_distances, membership_matrix
 from ..ops.graph_build import build_unitig_graph
 from ..parallel.batch import batched_membership_intersections
@@ -107,7 +108,8 @@ def batch(assemblies_parent, out_parent, k_size: int = 51,
             manifest.start(iso.name)
             log.message(f"Compressing isolate {iso.name}")
             with trace.span(f"isolate/{iso.name}", cat="isolate",
-                            stage="compress"), errs.quarantine(iso.name):
+                            stage="compress"), obs_qc.scope(iso.name), \
+                    errs.quarantine(iso.name):
                 from ..metrics import InputAssemblyMetrics
                 from ..utils.cache import open_cache
                 # warm-start caches live under the isolate's out dir, so a
@@ -121,6 +123,9 @@ def batch(assemblies_parent, out_parent, k_size: int = 51,
                 out_dir = out_parent / iso.name
                 os.makedirs(out_dir, exist_ok=True)
                 graph.save_gfa(out_dir / "input_assemblies.gfa", sequences)
+                obs_qc.compress_qc(graph, sequences)
+                ledger.record_stage(
+                    "compress", outputs=[out_dir / "input_assemblies.gfa"])
                 M, w, ids = membership_matrix(graph, sequences)
                 compressed.append((iso, (sequences, ids), M, w))
                 del graph
@@ -153,7 +158,8 @@ def batch(assemblies_parent, out_parent, k_size: int = 51,
     with stage_timer("batch/cluster"):
         for (iso, (sequences, ids), _, _), inter in zip(compressed, inters):
             with trace.span(f"isolate/{iso.name}", cat="isolate",
-                            stage="cluster"), errs.quarantine(iso.name):
+                            stage="cluster"), obs_qc.scope(iso.name), \
+                    errs.quarantine(iso.name):
                 distances = intersections_to_distances(inter, ids)
                 run_cluster(out_parent / iso.name, max_contigs=max_contigs,
                             precomputed_distances=distances)
@@ -211,7 +217,8 @@ def batch(assemblies_parent, out_parent, k_size: int = 51,
             if iso.name not in iso_cluster_dirs:
                 continue
             with trace.span(f"isolate/{iso.name}", cat="isolate",
-                            stage="finalise"), errs.quarantine(iso.name):
+                            stage="finalise"), obs_qc.scope(iso.name), \
+                    errs.quarantine(iso.name):
                 for cdir in iso_cluster_dirs[iso.name]:
                     trimmed = trim(cdir, dp_screen=screens[cdir],
                                    preloaded=graphs.pop(cdir))

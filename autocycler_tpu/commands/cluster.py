@@ -21,6 +21,8 @@ import numpy as np
 from ..metrics import ClusteringMetrics, UntrimmedClusterMetrics
 from ..models import Sequence, UnitigGraph
 from ..models.simplify import merge_linear_paths
+from ..obs import ledger
+from ..obs import qc as obs_qc
 from ..ops.distance import pairwise_contig_distances
 from ..utils import (format_float, load_file_lines, log, median, quit_with_error,
                      usize_division_rounded)
@@ -768,6 +770,14 @@ def cluster(autocycler_dir, cutoff: float = 0.2, min_assemblies: Optional[int] =
         save_data_to_tsv(sequences, qc_results, clustering_dir / "clustering.tsv")
         clustering_metrics(sequences, qc_results).save_to_yaml(
             clustering_dir / "clustering.yaml")
+    obs_qc.cluster_qc(sequences, qc_results)
+    ledger.record_stage(
+        "cluster", inputs=[gfa],
+        outputs=[clustering_dir / "pairwise_distances.phylip",
+                 clustering_dir / "clustering.newick",
+                 clustering_dir / "clustering.tsv",
+                 clustering_dir / "clustering.yaml"]
+        + sorted(clustering_dir.glob("qc_*/cluster_*/1_untrimmed.gfa")))
 
     log.section_header("Finished!")
     log.explanation("You can now run autocycler trim on each cluster.")

@@ -20,6 +20,7 @@ from ..metrics import InputAssemblyDetails, InputAssemblyMetrics, InputContigDet
 from ..models import Sequence, UnitigGraph
 from ..models.sequence import padded_strand
 from ..models.simplify import simplify_structure
+from ..obs import ledger, qc
 from ..ops.end_repair import sequence_end_repair
 from ..ops.graph_build import build_unitig_graph
 from ..utils import (Spinner, check_threads, find_all_assemblies,
@@ -85,6 +86,8 @@ def compress(assemblies_dir, autocycler_dir, k_size: int = 51,
     out_yaml = Path(autocycler_dir) / "input_assemblies.yaml"
     graph.save_gfa(out_gfa, sequences)
     _save_metrics(metrics, assembly_count, sequences, graph, out_yaml)
+    qc.compress_qc(graph, sequences)
+    ledger.record_stage("compress", outputs=[out_gfa, out_yaml])
 
     log.section_header("Finished!")
     log.explanation("You can now run autocycler cluster to group contigs based on their "
@@ -109,6 +112,7 @@ def load_sequences(assemblies_dir, k_size: int, metrics: InputAssemblyMetrics,
     log.section_header("Loading input assemblies")
     log.explanation("Input assemblies are now loaded and each contig is given a unique ID.")
     assemblies = find_all_assemblies(assemblies_dir)
+    ledger.record_inputs(assemblies)
     with substage("load"):
         per_file, file_hashes = _load_assembly_files(assemblies, k_size,
                                                      threads, cache)

@@ -19,6 +19,7 @@ import numpy as np
 from ..metrics import TrimmedClusterMetrics
 from ..models import Sequence, UnitigGraph
 from ..models.simplify import merge_linear_paths
+from ..obs import ledger, qc
 from ..ops.align import GAP, Weights, find_midpoint, overlap_alignment
 from ..utils import (check_threads, log, mad as mad_fn, map_threaded, median,
                      quit_with_error, reverse_signed_path)
@@ -88,6 +89,7 @@ def trim(cluster_dir, min_identity: float = 0.75, max_unitigs: int = 5000,
         # until choose_trim_type applies the results)
         all_paths = graph.get_unitig_paths_for_sequences(
             [s.id for s in sequences]) if max_unitigs else {}
+    orig_lengths = {s.id: s.length for s in sequences}
     with stage_timer("trim/overlaps"):
         start_end = trim_start_end_overlap(graph, sequences, weights,
                                            min_identity, max_unitigs,
@@ -95,13 +97,27 @@ def trim(cluster_dir, min_identity: float = 0.75, max_unitigs: int = 5000,
         hairpin = trim_hairpin_overlap(graph, sequences, weights, min_identity,
                                        max_unitigs, all_paths, threads,
                                        dp_screen)
+        # mirror choose_trim_type's winner selection (start_end wins ties)
+        # so QC records exactly the trims that were applied
+        se_count = sum(r is not None for r in start_end)
+        hp_count = sum(r is not None for r in hairpin)
+        winner = start_end if se_count >= hp_count else hairpin
+        chosen = [(s.id, r if (se_count or hp_count) else None)
+                  for s, r in zip(sequences, winner)]
         sequences = choose_trim_type(start_end, hairpin, graph, sequences)
     with stage_timer("trim/outputs"):
+        pre_exclude_ids = {s.id for s in sequences}
         sequences = exclude_outliers_in_length(graph, sequences, mad)
+        excluded_ids = pre_exclude_ids - {s.id for s in sequences}
         clean_up_graph(graph, sequences)
         graph.save_gfa(trimmed_gfa, sequences)
         TrimmedClusterMetrics.new(
             [s.length for s in sequences]).save_to_yaml(trimmed_yaml)
+    qc.trim_qc(cluster_dir.name, orig_lengths, se_count, hp_count, chosen,
+               sequences, excluded_ids)
+    ledger.record_stage("trim", inputs=[untrimmed_gfa],
+                        outputs=[trimmed_gfa, trimmed_yaml],
+                        cluster=cluster_dir.name)
     log.section_header("Finished!")
     log.message(f"Unitig graph of trimmed sequences: {trimmed_gfa}")
     log.message()

@@ -5,10 +5,14 @@ The pipeline's observability fragments (utils.timing accumulators,
 utils.cache hit counters, utils.resilience degrade events, bench
 artifacts) all write through this package, so one run directory — driven
 by ``AUTOCYCLER_TRACE_DIR`` — answers "what did this run spend its time
-and memory on, and what degraded?". See docs/observability.md.
+and memory on, and what degraded?". The data-plane layer adds "what did
+the *assembly* look like, and where did every artifact come from?":
+``qc`` journals per-stage scientific QC into ``qc_report.json``,
+``ledger`` hashes input→output artifact lineage into ``ledger.json``, and
+``watch`` follows another process's run live. See docs/observability.md.
 """
 
-from . import metrics_registry, sentinel, trace
+from . import ledger, metrics_registry, qc, sentinel, trace, watch
 from .memory import memory_sample
 from .metrics_registry import (MetricsRegistry, counter_inc, gauge_set,
                                info_set, observe, registry, snapshot,

@@ -1,0 +1,220 @@
+"""Per-run provenance ledger: what went in, what came out, what was reused.
+
+``ledger.json`` makes a run auditable after the fact: the sha256 of every
+input file, the package/jax/libtpu versions and effective ``AUTOCYCLER_*``
+knobs it ran under, the warm-start cache lineage (parse, end-repair,
+compile, probe — did this run recompute or reuse?), and a per-stage record
+of input → output artifact hashes. Two runs whose ledgers match inputs,
+versions and knobs should match artifact hashes; when they don't, the
+ledger says which stage diverged.
+
+Collection is gated on an active trace run (:func:`autocycler_tpu.obs.trace
+.tracing_active`): hashing artifacts costs real I/O, and the ledger is only
+written into a run directory anyway. The CLI resets the ledger when it
+starts a run and writes ``ledger.json`` atomically at run end, next to
+``trace.jsonl`` and ``qc_report.json``.
+
+``autocycler batch`` runs inside :func:`obs.qc.scope`, so per-isolate
+stage entries carry their isolate name — a 100-isolate fleet run gets 100
+auditable lineages in one ledger.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+import threading
+import time
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from . import metrics_registry, trace
+from .qc import current_scope
+
+LEDGER_JSON = "ledger.json"
+SCHEMA = 1
+
+_lock = threading.Lock()
+_inputs: Dict[str, dict] = {}
+_stages: List[dict] = []
+
+
+def reset() -> None:
+    with _lock:
+        _inputs.clear()
+        _stages.clear()
+
+
+def _hash_file(path) -> Optional[dict]:
+    """{"sha256", "bytes"} of a file, streamed; None when unreadable."""
+    h = hashlib.sha256()
+    size = 0
+    try:
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+                size += len(chunk)
+    except OSError:
+        return None
+    return {"sha256": h.hexdigest(), "bytes": size}
+
+
+def record_inputs(paths) -> None:
+    """Hash run input files (assembly FASTAs) into the ledger's top-level
+    input table. No-op without an active trace run; never raises."""
+    if not trace.tracing_active():
+        return
+    for path in paths:
+        try:
+            key = str(path)
+            digest = _hash_file(path)
+        except Exception:  # noqa: BLE001 — provenance must not fail the run
+            continue
+        if digest is None:
+            continue
+        iso = current_scope()
+        if iso:
+            digest = dict(digest, isolate=iso)
+        with _lock:
+            _inputs[key] = digest
+
+
+def record_stage(stage: str, inputs=(), outputs=(),
+                 cluster: Optional[str] = None, **extra) -> Optional[dict]:
+    """One stage's input → output artifact hashes. Missing/unreadable files
+    are skipped silently (a stage may legitimately not write an optional
+    artifact). No-op without an active trace run."""
+    if not trace.tracing_active():
+        return None
+
+    def table(paths) -> Dict[str, dict]:
+        out = {}
+        for path in paths:
+            try:
+                digest = _hash_file(path)
+            except Exception:  # noqa: BLE001
+                digest = None
+            if digest is not None:
+                out[str(path)] = digest
+        return out
+
+    entry = {"stage": stage, "ts_epoch": round(time.time(), 3),
+             "inputs": table(inputs), "outputs": table(outputs)}
+    iso = current_scope()
+    if iso:
+        entry["isolate"] = iso
+    if cluster:
+        entry["cluster"] = cluster
+    if extra:
+        entry["extra"] = extra
+    with _lock:
+        _stages.append(entry)
+    return entry
+
+
+def _env_knobs() -> dict:
+    """The effective environment this run saw: the platform pin plus every
+    AUTOCYCLER knob (same filter as the sentinel's environment snapshot)."""
+    return {k: os.environ[k] for k in sorted(os.environ)
+            if k == "JAX_PLATFORMS" or k.startswith("AUTOCYCLER_")
+            or k in ("XLA_FLAGS", "LIBTPU_INIT_ARGS", "TPU_NAME",
+                     "PJRT_DEVICE", "TPU_LIBRARY_PATH")}
+
+
+def _versions() -> dict:
+    """Package versions without importing jax (a ledger write must be safe
+    on a wedged host): autocycler itself, python, and every jax/TPU-adjacent
+    distribution from importlib metadata."""
+    import platform
+
+    from .. import __version__
+
+    packages = {}
+    try:
+        from importlib import metadata
+        for dist in metadata.distributions():
+            name = (dist.metadata.get("Name") or "").lower()
+            if any(tag in name for tag in ("jax", "tpu", "pjrt", "axon")):
+                packages[name] = dist.version
+    except Exception:  # noqa: BLE001
+        pass
+    return {"autocycler_tpu": __version__,
+            "python": platform.python_version(),
+            "packages": dict(sorted(packages.items()))}
+
+
+def _cache_lineage() -> dict:
+    """Hit/miss lineage for every warm-start layer: the parse and
+    end-repair caches (metrics registry), the persistent XLA compile cache
+    (knob + directory), and the device-probe cache (last logged outcome +
+    persisted negative-probe state + recovery count)."""
+    reg = metrics_registry.registry()
+    lineage: dict = {}
+    for which in ("parse", "repair"):
+        lineage[which] = {
+            "hits": int(reg.value("autocycler_cache_events_total",
+                                  cache=which, event="hit")),
+            "misses": int(reg.value("autocycler_cache_events_total",
+                                    cache=which, event="miss")),
+        }
+    compile_dir = os.environ.get("AUTOCYCLER_COMPILE_CACHE", "").strip()
+    lineage["compile"] = {"enabled": bool(compile_dir),
+                          "dir": compile_dir or None}
+    probe: dict = {
+        "recoveries": int(reg.value("autocycler_probe_recoveries_total")),
+    }
+    try:
+        from . import sentinel
+        tail = sentinel.read_probe_log(limit=1)
+        if tail:
+            probe["last"] = tail[-1]
+        log_path = sentinel.probe_log_path()
+        if log_path is not None:
+            neg = log_path.parent / "device_probe.json"
+            probe["negative_cache"] = neg.is_file()
+    except Exception:  # noqa: BLE001
+        pass
+    lineage["probe"] = probe
+    return lineage
+
+
+def build_ledger(command: Optional[str] = None) -> dict:
+    with _lock:
+        inputs = dict(_inputs)
+        stages = [dict(s) for s in _stages]
+    ledger = {
+        "schema": SCHEMA,
+        "created_epoch": round(time.time(), 3),
+        "inputs": inputs,
+        "stages": stages,
+        "env": _env_knobs(),
+        "versions": _versions(),
+        "caches": _cache_lineage(),
+    }
+    if command:
+        ledger["command"] = command
+    return ledger
+
+
+def write_ledger(run_dir, command: Optional[str] = None) -> Optional[Path]:
+    """Write ``ledger.json`` atomically (tempfile + rename — a reader or a
+    crash never sees a torn ledger). Returns the path, or None when there
+    is nothing to record or the write failed."""
+    with _lock:
+        if not _inputs and not _stages:
+            return None
+    payload = build_ledger(command)
+    path = Path(run_dir) / LEDGER_JSON
+    try:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=str(path.parent),
+                                   prefix=path.name + ".tmp")
+        with os.fdopen(fd, "w") as f:
+            json.dump(payload, f, indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+        return path
+    except OSError:
+        return None

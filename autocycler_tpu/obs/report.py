@@ -11,19 +11,29 @@ Inputs, all optional except that at least one must exist in the directory:
   summary and pool counters;
 - ``batch_manifest.json`` (utils.resilience.RunManifest) — per-isolate
   status lines;
+- ``qc_report.json`` (obs.qc) — per-stage scientific QC: unitig shape,
+  cluster pass/fail verdicts, trim decisions, bridge support;
+- ``ledger.json`` (obs.ledger) — input hashes, versions, env knobs, cache
+  lineage and per-stage artifact hashes;
 - ``BENCH*.json`` bench artifacts — one summary line each.
 
-``--json`` emits the merged structure as one JSON document instead.
+``--json`` emits the merged structure as one JSON document instead, and
+``--html`` additionally writes a self-contained ``run_report.html``.
 """
 
 from __future__ import annotations
 
+import html as _html
 import json
 import sys
 from pathlib import Path
 from typing import Dict, List, Optional
 
+from .ledger import LEDGER_JSON
+from .qc import QC_REPORT_JSON
 from .trace import METRICS_JSON, TRACE_JSONL
+
+RUN_REPORT_HTML = "run_report.html"
 
 # report total vs recorded wall-clock agreement gate (the acceptance bar:
 # a stage tree that disagrees with the wall by more than this is reported
@@ -171,6 +181,18 @@ def build_report(run_dir) -> Optional[dict]:
             manifest = json.loads(manifest_path.read_text())
         except (OSError, json.JSONDecodeError):
             manifest = None
+    qc = ledger = None
+    for name, slot in ((QC_REPORT_JSON, "qc"), (LEDGER_JSON, "ledger")):
+        path = run_dir / name
+        if path.is_file():
+            try:
+                data = json.loads(path.read_text())
+            except (OSError, json.JSONDecodeError):
+                data = None
+            if slot == "qc":
+                qc = data
+            else:
+                ledger = data
     bench: List[dict] = []
     for path in sorted(run_dir.glob("BENCH*.json")) + \
             sorted(run_dir.glob("bench*.json")):
@@ -180,7 +202,8 @@ def build_report(run_dir) -> Optional[dict]:
             continue
         if isinstance(data, dict):
             bench.append({"file": path.name, **data})
-    if trace is None and metrics is None and manifest is None and not bench:
+    if trace is None and metrics is None and manifest is None \
+            and qc is None and ledger is None and not bench:
         return None
     report: dict = {"dir": str(run_dir)}
     if trace is not None:
@@ -199,6 +222,10 @@ def build_report(run_dir) -> Optional[dict]:
         report["metrics"] = metrics
     if manifest is not None:
         report["manifest"] = manifest
+    if qc is not None:
+        report["qc"] = qc
+    if ledger is not None:
+        report["ledger"] = ledger
     if bench:
         report["bench"] = bench
     return report
@@ -309,6 +336,16 @@ def render_report(report: dict) -> str:
                 lines.append(f"  FAILED {name} (stage {stage}): "
                              f"{entry.get('error')}")
         lines.append("")
+    qc = report.get("qc")
+    if qc:
+        lines.append("Assembly QC:")
+        _render_qc_lines(qc, lines)
+        lines.append("")
+    ledger = report.get("ledger")
+    if ledger:
+        lines.append("Provenance:")
+        _render_ledger_lines(ledger, lines)
+        lines.append("")
     for artifact in report.get("bench", []):
         if "metric" in artifact:
             line = (f"Bench {artifact['file']}: {artifact['metric']} = "
@@ -322,14 +359,288 @@ def render_report(report: dict) -> str:
     return "\n".join(lines).rstrip() + "\n"
 
 
-def report(run_dir, as_json: bool = False) -> int:
-    """CLI entry point for `autocycler report`."""
+def _render_qc_lines(qc: dict, lines: List[str]) -> None:
+    """Readable per-stage QC from a qc_report.json payload; tolerates
+    partial/foreign payloads (every field is optional)."""
+    for entry in qc.get("entries", []) if isinstance(qc, dict) else []:
+        if not isinstance(entry, dict):
+            continue
+        stage = entry.get("stage", "?")
+        metrics = entry.get("metrics") or {}
+        prefix = "  "
+        if entry.get("isolate"):
+            prefix += f"[{entry['isolate']}] "
+        if stage == "compress":
+            lines.append(
+                f"{prefix}compress: {metrics.get('unitigs', '?')} unitigs, "
+                f"{metrics.get('total_bp', '?')} bp, "
+                f"N50 {metrics.get('n50_bp', '?')} "
+                f"(from {metrics.get('input_contigs', '?')} contigs / "
+                f"{metrics.get('input_bp', '?')} bp)")
+            hist = metrics.get("depth_hist_bp")
+            if isinstance(hist, dict) and hist:
+                lines.append(f"{prefix}  depth histogram (bp): " + ", ".join(
+                    f"{k}: {v}" for k, v in hist.items()))
+        elif stage == "cluster":
+            lines.append(
+                f"{prefix}cluster: {metrics.get('clusters_pass', '?')} pass /"
+                f" {metrics.get('clusters_fail', '?')} fail "
+                f"(size balance {metrics.get('size_balance_ratio', '?')})")
+            for c in metrics.get("clusters") or []:
+                if not isinstance(c, dict):
+                    continue
+                verdict = "PASS" if c.get("passed") else "FAIL"
+                line = (f"{prefix}  cluster {c.get('cluster', '?'):>3}: "
+                        f"{verdict}  {c.get('contigs', '?')} contigs "
+                        f"{c.get('total_bp', '?')} bp "
+                        f"dist {c.get('distance', '?')}")
+                reasons = c.get("failure_reasons") or []
+                if reasons:
+                    line += f"  [{', '.join(str(r) for r in reasons)}]"
+                lines.append(line)
+        elif stage == "trim":
+            lines.append(
+                f"{prefix}trim {entry.get('cluster', '?')}: "
+                f"{metrics.get('trimmed_contigs', '?')}/"
+                f"{metrics.get('contigs', '?')} contigs trimmed, "
+                f"{metrics.get('trimmed_bp', '?')} bp removed "
+                f"({metrics.get('trim_type', '?')}; "
+                f"{metrics.get('excluded_contigs', '?')} excluded)")
+        elif stage == "resolve":
+            lines.append(
+                f"{prefix}resolve {entry.get('cluster', '?')}: "
+                f"{metrics.get('anchors', '?')} anchors, "
+                f"{metrics.get('bridges', '?')} bridges "
+                f"({metrics.get('unique_bridges', '?')} unique / "
+                f"{metrics.get('conflicting_bridges', '?')} conflicting, "
+                f"{metrics.get('culled_bridges', '?')} culled), "
+                f"min support {metrics.get('min_bridge_support', '?')}")
+        elif stage == "combine":
+            resolved = metrics.get("fully_resolved")
+            lines.append(
+                f"{prefix}combine: {metrics.get('clusters', '?')} clusters "
+                f"-> {metrics.get('consensus_bp', '?')} bp consensus in "
+                f"{metrics.get('consensus_unitigs', '?')} unitigs"
+                + (", fully resolved" if resolved else
+                   ", NOT fully resolved" if resolved is not None else ""))
+        else:
+            scalars = {k: v for k, v in metrics.items()
+                       if isinstance(v, (int, float, bool, str))}
+            lines.append(f"{prefix}{stage}: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(scalars.items())) if scalars
+                else f"{prefix}{stage}")
+
+
+def _render_ledger_lines(ledger: dict, lines: List[str]) -> None:
+    if not isinstance(ledger, dict):
+        return
+    inputs = ledger.get("inputs") or {}
+    if inputs:
+        total = sum(v.get("bytes", 0) for v in inputs.values()
+                    if isinstance(v, dict))
+        lines.append(f"  inputs: {len(inputs)} file"
+                     f"{'s' if len(inputs) != 1 else ''} hashed "
+                     f"({_fmt_bytes(total)})")
+    versions = ledger.get("versions") or {}
+    if versions:
+        bits = [f"autocycler_tpu {versions.get('autocycler_tpu', '?')}",
+                f"python {versions.get('python', '?')}"]
+        for pkg, ver in sorted((versions.get("packages") or {}).items()):
+            bits.append(f"{pkg} {ver}")
+        lines.append("  versions: " + " · ".join(bits))
+    caches = ledger.get("caches") or {}
+    if caches:
+        bits = []
+        for which in ("parse", "repair"):
+            c = caches.get(which)
+            if isinstance(c, dict):
+                bits.append(f"{which} {c.get('hits', 0)} hit/"
+                            f"{c.get('misses', 0)} miss")
+        compile_c = caches.get("compile") or {}
+        bits.append("compile " +
+                    ("on" if compile_c.get("enabled") else "off"))
+        probe = caches.get("probe") or {}
+        bits.append(f"probe recoveries {probe.get('recoveries', 0)}")
+        lines.append("  caches: " + " · ".join(bits))
+    stages = ledger.get("stages") or []
+    if stages:
+        bits = []
+        for s in stages:
+            if not isinstance(s, dict):
+                continue
+            label = s.get("stage", "?")
+            if s.get("cluster"):
+                label += f"/{s['cluster']}"
+            if s.get("isolate"):
+                label = f"{s['isolate']}:{label}"
+            bits.append(f"{label} ({len(s.get('inputs') or {})} in -> "
+                        f"{len(s.get('outputs') or {})} out)")
+        lines.append("  stages: " + ", ".join(bits))
+
+
+def _esc(value) -> str:
+    return _html.escape(str(value))
+
+
+def _html_kv_table(rows, headers) -> List[str]:
+    out = ["<table>", "<tr>" + "".join(f"<th>{_esc(h)}</th>"
+                                       for h in headers) + "</tr>"]
+    for row in rows:
+        out.append("<tr>" + "".join(f"<td>{_esc(c)}</td>" for c in row)
+                   + "</tr>")
+    out.append("</table>")
+    return out
+
+
+def render_html(report: dict) -> str:
+    """One self-contained HTML document (inline CSS, no external assets)
+    from the merged report structure — openable from a laptop that only
+    scp'd the run directory home."""
+    title = f"Autocycler run report — {report.get('dir', '')}"
+    parts: List[str] = [
+        "<!DOCTYPE html>",
+        "<html lang=\"en\"><head><meta charset=\"utf-8\">",
+        f"<title>{_esc(title)}</title>",
+        "<style>",
+        "body{font-family:system-ui,sans-serif;margin:2em auto;"
+        "max-width:70em;padding:0 1em;color:#1a1a2e;}",
+        "h1{font-size:1.4em;border-bottom:2px solid #4a4e69;}",
+        "h2{font-size:1.1em;margin-top:1.6em;color:#4a4e69;}",
+        "pre{background:#f4f4f8;padding:0.8em;overflow-x:auto;"
+        "border-radius:4px;font-size:0.85em;}",
+        "table{border-collapse:collapse;margin:0.5em 0;font-size:0.9em;}",
+        "th,td{border:1px solid #c9c9d4;padding:0.25em 0.6em;"
+        "text-align:left;}",
+        "th{background:#e9e9f0;}",
+        ".pass{color:#1b7a3d;font-weight:600;}",
+        ".fail{color:#b3261e;font-weight:600;}",
+        ".warn{color:#8a5a00;font-weight:600;}",
+        "</style></head><body>",
+        f"<h1>{_esc(title)}</h1>",
+    ]
+    trace = report.get("trace")
+    if trace:
+        header = trace.get("run") or {}
+        wall = trace.get("wall_s")
+        bits = [f"command <b>{_esc(header.get('name', '?'))}</b>",
+                f"{trace.get('span_count', 0)} spans",
+                f"stage-tree total {_esc(_fmt_s(trace.get('tree_total_s', 0)))}"]
+        if wall:
+            bits.append(f"wall {_esc(_fmt_s(wall))}")
+            agreement = trace.get("wall_agreement", 0.0)
+            if abs(agreement - 1.0) > WALL_AGREEMENT:
+                bits.append(f"<span class=\"warn\">tree covers "
+                            f"{agreement * 100:.1f}% of wall</span>")
+        parts.append("<p>" + " · ".join(bits) + "</p>")
+        tree_lines: List[str] = []
+        _render_tree(trace.get("tree", []), tree_lines,
+                     parent_seconds=wall or trace.get("tree_total_s"))
+        parts.append("<h2>Stage tree</h2>")
+        parts.append("<pre>" + _esc("\n".join(tree_lines)) + "</pre>")
+    qc = report.get("qc")
+    if qc:
+        parts.append("<h2>Assembly QC</h2>")
+        qc_lines: List[str] = []
+        _render_qc_lines(qc, qc_lines)
+        parts.append("<pre>" + _esc("\n".join(qc_lines)) + "</pre>")
+        clusters = []
+        for entry in qc.get("entries", []):
+            if isinstance(entry, dict) and entry.get("stage") == "cluster":
+                clusters = (entry.get("metrics") or {}).get("clusters") or []
+        if clusters:
+            parts.append("<table><tr><th>cluster</th><th>verdict</th>"
+                         "<th>contigs</th><th>bp</th><th>distance</th>"
+                         "<th>failure reasons</th></tr>")
+            for c in clusters:
+                if not isinstance(c, dict):
+                    continue
+                verdict = ("<span class=\"pass\">PASS</span>"
+                           if c.get("passed")
+                           else "<span class=\"fail\">FAIL</span>")
+                reasons = ", ".join(str(r) for r in
+                                    (c.get("failure_reasons") or []))
+                parts.append(
+                    f"<tr><td>{_esc(c.get('cluster', '?'))}</td>"
+                    f"<td>{verdict}</td>"
+                    f"<td>{_esc(c.get('contigs', '?'))}</td>"
+                    f"<td>{_esc(c.get('total_bp', '?'))}</td>"
+                    f"<td>{_esc(c.get('distance', '?'))}</td>"
+                    f"<td>{_esc(reasons)}</td></tr>")
+            parts.append("</table>")
+    ledger = report.get("ledger")
+    if ledger:
+        parts.append("<h2>Provenance</h2>")
+        led_lines: List[str] = []
+        _render_ledger_lines(ledger, led_lines)
+        parts.append("<pre>" + _esc("\n".join(led_lines)) + "</pre>")
+        inputs = ledger.get("inputs") or {}
+        if inputs:
+            rows = [(path, digest.get("bytes", "?"),
+                     digest.get("sha256", "?")[:16] + "…")
+                    for path, digest in sorted(inputs.items())
+                    if isinstance(digest, dict)]
+            parts.append("<h2>Input files</h2>")
+            parts.extend(_html_kv_table(rows, ("path", "bytes", "sha256")))
+        stage_rows = []
+        for s in ledger.get("stages") or []:
+            if not isinstance(s, dict):
+                continue
+            for path, digest in sorted((s.get("outputs") or {}).items()):
+                if isinstance(digest, dict):
+                    label = s.get("stage", "?")
+                    if s.get("cluster"):
+                        label += f"/{s['cluster']}"
+                    if s.get("isolate"):
+                        label = f"{s['isolate']}:{label}"
+                    stage_rows.append((label, path, digest.get("bytes", "?"),
+                                       digest.get("sha256", "?")[:16] + "…"))
+        if stage_rows:
+            parts.append("<h2>Stage outputs</h2>")
+            parts.extend(_html_kv_table(
+                stage_rows, ("stage", "artifact", "bytes", "sha256")))
+    metrics = report.get("metrics")
+    if metrics:
+        dev_s = _metric_total(metrics, "autocycler_device_seconds_total")
+        dispatches = _metric_total(metrics,
+                                   "autocycler_device_dispatches_total")
+        failures = _metric_total(metrics, "autocycler_device_failures_total")
+        parts.append("<h2>Device</h2>")
+        parts.append(f"<p>{_esc(_fmt_s(dev_s))} on device across "
+                     f"{int(dispatches)} dispatches; {int(failures)} "
+                     f"failures</p>")
+    manifest = report.get("manifest")
+    if manifest:
+        items = manifest.get("items", {})
+        rows = [(name, entry.get("status", "?"), entry.get("stage") or "",
+                 entry.get("error") or "")
+                for name, entry in sorted(items.items())]
+        parts.append(f"<h2>Isolates ({len(items)})</h2>")
+        parts.extend(_html_kv_table(
+            rows, ("isolate", "status", "stage", "error")))
+    parts.append("</body></html>")
+    return "\n".join(parts) + "\n"
+
+
+def report(run_dir, as_json: bool = False,
+           html: Optional[str] = None) -> int:
+    """CLI entry point for `autocycler report`. ``html`` of "" writes
+    ``run_report.html`` into the run dir; a non-empty value is the output
+    path; None skips HTML."""
     built = build_report(run_dir)
     if built is None:
         print(f"Error: no telemetry found in {run_dir} (expected "
-              f"{TRACE_JSONL}, {METRICS_JSON}, batch_manifest.json or "
+              f"{TRACE_JSONL}, {METRICS_JSON}, {QC_REPORT_JSON}, "
+              f"{LEDGER_JSON}, batch_manifest.json or "
               "BENCH*.json)", file=sys.stderr)
         return 1
+    if html is not None:
+        out = Path(html) if html else Path(run_dir) / RUN_REPORT_HTML
+        try:
+            out.write_text(render_html(built))
+            print(f"wrote {out}", file=sys.stderr)
+        except OSError as e:
+            print(f"Error: could not write {out}: {e}", file=sys.stderr)
+            return 1
     if as_json:
         print(json.dumps(built, indent=2, sort_keys=True))
     else:

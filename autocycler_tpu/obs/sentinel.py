@@ -35,6 +35,7 @@ import os
 import platform
 import subprocess
 import sys
+import tempfile
 import threading
 import time
 from pathlib import Path
@@ -216,9 +217,21 @@ def probe_log_path() -> Optional[Path]:
     return None
 
 
+def probe_log_max() -> int:
+    """Probe-log rotation cap: keep only the newest N entries
+    (AUTOCYCLER_PROBE_LOG_MAX, default 500; 0 disables rotation)."""
+    raw = os.environ.get("AUTOCYCLER_PROBE_LOG_MAX", "").strip()
+    try:
+        return max(0, int(raw)) if raw else 500
+    except ValueError:
+        return 500
+
+
 def append_probe_log(entry: dict) -> None:
     """Append one JSON line to the configured probe log (no-op without a
-    configured directory; never raises)."""
+    configured directory; never raises). The log is rotated to the newest
+    ``probe_log_max()`` entries on append, so a long-lived
+    AUTOCYCLER_PROBE_WATCH sentinel cannot grow it unboundedly."""
     path = probe_log_path()
     if path is None:
         return
@@ -226,6 +239,31 @@ def append_probe_log(entry: dict) -> None:
         path.parent.mkdir(parents=True, exist_ok=True)
         with open(path, "a") as f:
             f.write(json.dumps(entry, default=str) + "\n")
+        _rotate_probe_log(path)
+    except OSError:
+        pass
+
+
+def _rotate_probe_log(path: Path) -> None:
+    """Truncate the probe log to its newest ``probe_log_max()`` lines via
+    tempfile + atomic replace; a reader never sees a torn log. Cheap check
+    first (line count ~ newline count) so the steady state is one stat."""
+    cap = probe_log_max()
+    if cap <= 0:
+        return
+    try:
+        data = path.read_bytes()
+    except OSError:
+        return
+    if data.count(b"\n") <= cap:
+        return
+    lines = data.splitlines(keepends=True)[-cap:]
+    try:
+        fd, tmp = tempfile.mkstemp(dir=str(path.parent),
+                                   prefix=path.name + ".tmp")
+        with os.fdopen(fd, "wb") as f:
+            f.writelines(lines)
+        os.replace(tmp, path)
     except OSError:
         pass
 

@@ -156,6 +156,11 @@ def _run_headline_once():
     # off for the run — 125 GB of host RAM absorbs the uncollected cycles.
     import gc
 
+    # fresh QC journal per run so the artifact's embedded QC summary
+    # describes THIS pipeline run, not the accumulation of all three
+    from autocycler_tpu.obs import qc
+
+    qc.reset()
     gc.disable()
     t0 = time.perf_counter()
     staged("compress", compress, asm_dir, out_dir, threads=_bench_threads())
@@ -406,7 +411,7 @@ def bench_headline() -> None:
     # (top-level span durations) and the full metrics-registry snapshot, so
     # the artifact carries the cache/pool/degradation accounting alongside
     # the wall numbers above
-    from autocycler_tpu.obs import metrics_registry
+    from autocycler_tpu.obs import metrics_registry, qc
 
     print(json.dumps({
         "metric": "headline_pipeline_24x6Mbp",
@@ -437,6 +442,10 @@ def bench_headline() -> None:
         "stage_seconds": {name: round(secs, 3) for name, secs
                           in sorted(timing.stage_seconds().items())},
         "metrics": metrics_registry.snapshot(),
+        # the scientific shape of the (last) run: unitig/cluster/trim/
+        # bridge QC aggregates, so artifacts compare assemblies, not
+        # only wall seconds
+        "qc": qc.summary() or None,
     }))
 
 
@@ -1001,8 +1010,10 @@ def load_round_artifacts(root=None) -> list:
 def trend_rows(artifacts: list) -> list:
     """One comparable row per round from heterogeneous artifacts (the
     artifact schema grew over rounds: stages landed in r04, device_probe in
-    r05, host_env in r06 — missing fields render as None, never raise).
-    Pure function so the trajectory extraction is unit-testable."""
+    r05, host_env + device_kernels in r06 — a BENCH_r01-era artifact has
+    none of them; every extraction tolerates absence and renders None,
+    never raises). Pure function so the trajectory extraction is
+    unit-testable."""
     rows = []
     for art in artifacts:
         p = art.get("parsed") or {}
@@ -1017,6 +1028,8 @@ def trend_rows(artifacts: list) -> list:
             if isinstance(stages, dict) else None
         probe = p.get("device_probe") or {}
         host = p.get("host_env") or {}
+        kernels = p.get("device_kernels")
+        kernels = kernels if isinstance(kernels, dict) else {}
         rows.append({
             "round": art.get("round"),
             "path": art.get("path"),
@@ -1027,7 +1040,47 @@ def trend_rows(artifacts: list) -> list:
             "probe_kind": probe.get("kind"),
             "stages_s": stages_s,
             "ambient_load": host.get("ambient_load_per_cpu"),
+            "device_dispatches": p.get("device_dispatches"),
+            "kernel_failures": kernels.get("failures"),
             "untrusted": p.get("untrusted"),
+        })
+    return rows
+
+
+def load_multichip_artifacts(root=None) -> list:
+    """The multi-chip scaling artifacts (``MULTICHIP_r*.json``, shape
+    ``{n_devices, rc, ok, skipped, tail}``) as ``[{round, path, parsed}]``
+    sorted by round. Unparseable files are skipped."""
+    import re
+
+    root = Path(root) if root is not None else Path(__file__).resolve().parent
+    arts = []
+    for path in sorted(root.glob("MULTICHIP_r*.json")):
+        try:
+            data = json.loads(path.read_text())
+        except (OSError, ValueError):
+            continue
+        if not isinstance(data, dict):
+            continue
+        m = re.search(r"r(\d+)", path.stem)
+        arts.append({"round": int(m.group(1)) if m else -1,
+                     "path": path.name, "parsed": data})
+    return sorted(arts, key=lambda a: a["round"])
+
+
+def multichip_rows(artifacts: list) -> list:
+    """One row per multi-chip round; every field optional (the schema may
+    grow, and a truncated artifact must render as None, not raise)."""
+    rows = []
+    for art in artifacts:
+        p = art.get("parsed") or {}
+        rows.append({
+            "round": art.get("round"),
+            "path": art.get("path"),
+            "n_devices": p.get("n_devices"),
+            "ok": p.get("ok"),
+            "skipped": p.get("skipped"),
+            "rc": p.get("rc"),
         })
     return rows
 
@@ -1038,13 +1091,13 @@ def bench_trend() -> None:
     fraction + probe kind, stage breakdown and ambient load — as a text
     table on stderr and one JSON line on stdout, so "we got slower" vs
     "the machine was busy" is answerable from artifacts alone."""
+    def fmt(v, spec=""):
+        return format(v, spec) if isinstance(v, (int, float)) else "-"
+
     rows = trend_rows(load_round_artifacts())
     if not rows:
         print("no BENCH_r*.json artifacts found", file=sys.stderr)
     else:
-        def fmt(v, spec=""):
-            return format(v, spec) if isinstance(v, (int, float)) else "-"
-
         print(f"{'round':>5} {'median_s':>9} {'best_s':>7} {'spread':>7} "
               f"{'dev_frac':>8} {'probe':>8} {'load':>6}  stages",
               file=sys.stderr)
@@ -1058,7 +1111,18 @@ def bench_trend() -> None:
                   f"{r['probe_kind'] or '-':>8} "
                   f"{fmt(r['ambient_load'], '.2f'):>6}  {stages}{flag}",
                   file=sys.stderr)
-    print(json.dumps({"bench": "trend", "rounds": rows}))
+    mrows = multichip_rows(load_multichip_artifacts())
+    if mrows:
+        print("", file=sys.stderr)
+        print(f"{'round':>5} {'devices':>8} {'ok':>5} {'skipped':>8} "
+              f"{'rc':>4}  (MULTICHIP_r*.json)", file=sys.stderr)
+        for r in mrows:
+            print(f"{fmt(r['round']):>5} {fmt(r['n_devices']):>8} "
+                  f"{str(r['ok']) if r['ok'] is not None else '-':>5} "
+                  f"{str(r['skipped']) if r['skipped'] is not None else '-':>8} "
+                  f"{fmt(r['rc']):>4}", file=sys.stderr)
+    print(json.dumps({"bench": "trend", "rounds": rows,
+                      "multichip": mrows}))
 
 
 def main() -> None:

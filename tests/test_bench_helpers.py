@@ -200,3 +200,62 @@ def test_bench_trend_renders_and_prints_json(tmp_path, monkeypatch, capsys):
     assert line["bench"] == "trend"
     assert line["rounds"][0]["median_s"] == 61.0
     assert "round" in captured.err  # the stderr table rendered
+
+
+def test_trend_rows_tolerates_missing_device_kernels_and_host_load():
+    # r01-era artifact has neither device_kernels nor host_env; a newer one
+    # has both — neither shape may raise, and the fields degrade to None
+    arts = [
+        {"round": 1, "path": "BENCH_r01.json", "parsed": {"value": 61.0}},
+        {"round": 7, "path": "BENCH_r07.json", "parsed": {
+            "median_s": 30.0, "device_dispatches": 42,
+            "device_kernels": {"failures": 2,
+                               "dotplot": {"gcells_per_s": 100}},
+            "host_env": {"ambient_load_per_cpu": 0.1}}},
+        # device_kernels of a wrong type must not raise either
+        {"round": 8, "path": "BENCH_r08.json",
+         "parsed": {"median_s": 29.0, "device_kernels": "corrupt"}},
+    ]
+    rows = bench.trend_rows(arts)
+    assert rows[0]["device_dispatches"] is None
+    assert rows[0]["kernel_failures"] is None
+    assert rows[1]["device_dispatches"] == 42
+    assert rows[1]["kernel_failures"] == 2
+    assert rows[2]["kernel_failures"] is None
+
+
+def test_load_multichip_artifacts_and_rows(tmp_path):
+    import json as _json
+
+    (tmp_path / "MULTICHIP_r07.json").write_text(_json.dumps(
+        {"n_devices": 4, "rc": 0, "ok": True, "skipped": False,
+         "tail": "..."}))
+    (tmp_path / "MULTICHIP_r06.json").write_text(_json.dumps(
+        {"skipped": True}))                      # older, sparse schema
+    (tmp_path / "MULTICHIP_r05.json").write_text("not json")
+    arts = bench.load_multichip_artifacts(tmp_path)
+    assert [a["round"] for a in arts] == [6, 7]  # sorted; corrupt skipped
+    rows = bench.multichip_rows(arts)
+    assert rows[0] == {"round": 6, "path": "MULTICHIP_r06.json",
+                       "n_devices": None, "ok": None, "skipped": True,
+                       "rc": None}
+    assert rows[1]["n_devices"] == 4 and rows[1]["ok"] is True
+
+
+def test_bench_trend_includes_multichip_section(monkeypatch, capsys):
+    import json as _json
+
+    monkeypatch.setattr(
+        bench, "load_round_artifacts",
+        lambda root=None: [{"round": 1, "path": "BENCH_r01.json",
+                            "parsed": {"value": 61.0}}])
+    monkeypatch.setattr(
+        bench, "load_multichip_artifacts",
+        lambda root=None: [{"round": 7, "path": "MULTICHIP_r07.json",
+                            "parsed": {"n_devices": 4, "ok": True,
+                                       "skipped": False, "rc": 0}}])
+    bench.bench_trend()
+    captured = capsys.readouterr()
+    line = _json.loads(captured.out)
+    assert line["multichip"][0]["n_devices"] == 4
+    assert "MULTICHIP" in captured.err

@@ -1,0 +1,108 @@
+"""Registry-wide metric naming lint. After a small end-to-end compress run
+every metric name the codebase registers must follow the Prometheus
+conventions we committed to: an ``autocycler_`` prefix, lowercase
+snake_case, counters ending ``_total``, histograms carrying a unit suffix.
+This is a tier-1 tripwire: a new metric with a sloppy name fails here, not
+in a dashboard three weeks later."""
+
+import gc
+import re
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from autocycler_tpu import cli
+from autocycler_tpu.obs import metrics_registry, trace
+from synthetic import make_assemblies
+
+pytestmark = pytest.mark.obs
+
+NAME_RE = re.compile(r"^autocycler_[a-z][a-z0-9_]*[a-z0-9]$")
+UNIT_SUFFIXES = ("_seconds", "_bytes", "_ratio")
+
+
+@pytest.fixture(autouse=True)
+def _clean_trace():
+    trace._abort_run_for_tests()
+    yield
+    trace._abort_run_for_tests()
+
+
+def _lint(snapshot: dict) -> list:
+    problems = []
+    for name, meta in snapshot.items():
+        kind = meta.get("type")
+        if not NAME_RE.match(name):
+            problems.append(f"{name}: not autocycler_-prefixed snake_case")
+        if "__" in name:
+            problems.append(f"{name}: double underscore")
+        if kind == "counter" and not name.endswith("_total"):
+            problems.append(f"{name}: counter must end in _total")
+        if kind != "counter" and name.endswith("_total"):
+            problems.append(f"{name}: _total reserved for counters "
+                            f"(is {kind})")
+        if kind == "histogram" and not name.endswith(UNIT_SUFFIXES):
+            problems.append(f"{name}: histogram needs a unit suffix "
+                            f"{UNIT_SUFFIXES}")
+        if kind == "histogram" and name.endswith(("_count", "_sum",
+                                                  "_bucket")):
+            problems.append(f"{name}: collides with exposition suffixes")
+        if not meta.get("help") and kind != "info":
+            problems.append(f"{name}: missing help text")
+        for entry in meta.get("values", []):
+            for label in entry.get("labels", {}):
+                if not re.match(r"^[a-z][a-z0-9_]*$", label):
+                    problems.append(f"{name}: bad label name {label!r}")
+                if label in ("le", "quantile", "job", "instance"):
+                    problems.append(f"{name}: reserved label {label!r}")
+    return problems
+
+
+def test_registry_names_after_small_e2e(tmp_path, monkeypatch, capsys):
+    """Drive a real compress (spans, caches, QC gauges, device counters all
+    register) then lint everything that landed in the registry."""
+    asm_dir = make_assemblies(tmp_path, n_assemblies=2, chromosome_len=1500,
+                              plasmid_len=400, seed=3)
+    out_dir = tmp_path / "out"
+    monkeypatch.setenv("AUTOCYCLER_TRACE_DIR", str(tmp_path / "runs"))
+    gc.disable()
+    try:
+        rc = cli.main(["compress", "-i", str(asm_dir), "-a", str(out_dir)])
+    finally:
+        gc.enable()
+    capsys.readouterr()
+    assert rc == 0
+    snapshot = metrics_registry.snapshot()
+    assert snapshot, "e2e run registered no metrics at all"
+    assert any(n.startswith("autocycler_qc_compress_") for n in snapshot)
+    problems = _lint(snapshot)
+    assert not problems, "metric naming violations:\n  " + \
+        "\n  ".join(problems)
+
+
+def test_lint_catches_violations():
+    reg = metrics_registry.MetricsRegistry()
+    reg.counter_inc("autocycler_bad_counter")          # missing _total
+    reg.gauge_set("autocycler_sneaky_total", 1.0, help="h")
+    reg.observe("autocycler_latency", 0.2, help="h")   # no unit suffix
+    reg.counter_inc("NotPrefixed_total", help="h")
+    reg.gauge_set("autocycler_ok_gauge", 1.0, help="h", le="0.5")
+    problems = _lint(reg.snapshot())
+    assert len(problems) >= 5
+    joined = "\n".join(problems)
+    assert "must end in _total" in joined
+    assert "reserved for counters" in joined
+    assert "unit suffix" in joined
+    assert "snake_case" in joined
+    assert "reserved label" in joined
+
+
+def test_current_registry_passes_lint_without_e2e():
+    """Even the ambient registry state accumulated by this test session
+    (imports, other tests) must lint clean."""
+    problems = _lint(metrics_registry.snapshot())
+    assert not problems, "metric naming violations:\n  " + \
+        "\n  ".join(problems)

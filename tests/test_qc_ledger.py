@@ -1,0 +1,374 @@
+"""Data-plane observability: per-stage QC metrics (obs.qc), the provenance
+ledger (obs.ledger), the `autocycler watch` cross-process follower and the
+report's QC/provenance/HTML merge.
+
+The acceptance gate lives here: an e2e compress->...->combine run through
+the CLI with AUTOCYCLER_TRACE_DIR produces `ledger.json` + `qc_report.json`
+whose artifact hashes and QC counts MATCH the actual outputs on disk.
+"""
+
+import gc
+import hashlib
+import json
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from synthetic import make_assemblies  # noqa: E402
+
+from autocycler_tpu import cli
+from autocycler_tpu.obs import ledger, qc, trace, watch
+from autocycler_tpu.obs import report as obs_report
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    trace._abort_run_for_tests()
+    qc.reset()
+    ledger.reset()
+    yield
+    trace._abort_run_for_tests()
+    qc.reset()
+    ledger.reset()
+
+
+def _sha256(path) -> str:
+    return hashlib.sha256(Path(path).read_bytes()).hexdigest()
+
+
+def _gfa_stats(path):
+    """(segment count, total bp) of a GFA's S lines."""
+    count = total = 0
+    for line in Path(path).read_text().splitlines():
+        if line.startswith("S\t"):
+            count += 1
+            total += len(line.split("\t")[2])
+    return count, total
+
+
+def _cli(monkeypatch, run_dir, argv):
+    """One CLI command with its own trace dir (each run rewrites the run
+    artifacts, so every pipeline command gets a fresh directory)."""
+    monkeypatch.setenv("AUTOCYCLER_TRACE_DIR", str(run_dir))
+    try:
+        rc = cli.main(argv)
+    finally:
+        gc.enable()     # the CLI disables gc for graph commands
+    assert rc == 0, argv
+    return run_dir
+
+
+# ---------------- unit: qc module ----------------
+
+def test_n50_definition():
+    assert qc.n50([]) == 0
+    assert qc.n50([100]) == 100
+    # total 100+60+40 = 200; running 100 >= 100 at the first contig
+    assert qc.n50([40, 100, 60]) == 100
+    # equal lengths: N50 is that length
+    assert qc.n50([50, 50, 50, 50]) == 50
+
+
+def test_record_journals_registers_and_scopes():
+    qc.record("compress", unitigs=5, total_bp=1000, note="x",
+              hist={"a": 1})
+    entries = qc.entries()
+    assert entries[-1]["stage"] == "compress"
+    assert entries[-1]["metrics"]["unitigs"] == 5
+    # numeric scalars became gauges; dicts/strings did not
+    from autocycler_tpu.obs import metrics_registry
+    snap = metrics_registry.snapshot()
+    assert "autocycler_qc_compress_unitigs" in snap
+    assert "autocycler_qc_compress_note" not in snap
+    assert "autocycler_qc_compress_hist" not in snap
+
+    with qc.scope("isolate_A"):
+        assert qc.current_scope() == "isolate_A"
+        qc.record("compress", unitigs=7)
+        with qc.scope("isolate_B"):
+            assert qc.current_scope() == "isolate_B"
+        assert qc.current_scope() == "isolate_A"
+    assert qc.current_scope() is None
+    assert qc.entries()[-1]["isolate"] == "isolate_A"
+
+
+def test_summary_sums_numerics_and_groups_isolates():
+    qc.reset()
+    qc.record("trim", cluster="cluster_001", trimmed_bp=10, contigs=4)
+    qc.record("trim", cluster="cluster_002", trimmed_bp=5, contigs=4)
+    with qc.scope("iso1"):
+        qc.record("compress", unitigs=3)
+    s = qc.summary()
+    assert s["trim"]["entries"] == 2
+    assert s["trim"]["trimmed_bp"] == 15
+    assert s["trim"]["contigs"] == 8
+    assert s["isolates"]["iso1"]["compress"]["unitigs"] == 3
+
+
+def test_write_qc_report_atomic_and_empty(tmp_path):
+    qc.reset()
+    assert qc.write_qc_report(tmp_path) is None      # empty journal: no file
+    assert not (tmp_path / qc.QC_REPORT_JSON).exists()
+    qc.record("combine", consensus_bp=123)
+    path = qc.write_qc_report(tmp_path)
+    assert path == tmp_path / qc.QC_REPORT_JSON
+    data = json.loads(path.read_text())
+    assert data["schema"] == 1
+    assert data["entries"][0]["metrics"]["consensus_bp"] == 123
+    assert data["summary"]["combine"]["consensus_bp"] == 123
+    assert not list(tmp_path.glob("*.tmp*"))         # no tempfile leftovers
+
+
+# ---------------- unit: ledger module ----------------
+
+def test_ledger_noop_without_active_run(tmp_path):
+    f = tmp_path / "in.fasta"
+    f.write_text(">x\nACGT\n")
+    ledger.record_inputs([f])
+    ledger.record_stage("compress", outputs=[f])
+    assert ledger.write_ledger(tmp_path) is None     # nothing was recorded
+    assert not (tmp_path / ledger.LEDGER_JSON).exists()
+
+
+def test_ledger_hashes_inputs_and_stages(tmp_path):
+    f = tmp_path / "in.fasta"
+    f.write_text(">x\nACGT\n")
+    out = tmp_path / "out.gfa"
+    out.write_text("H\tVN:Z:1.0\n")
+    trace.start_run(tmp_path / "run", name="t")
+    try:
+        ledger.record_inputs([f, tmp_path / "missing.fasta"])
+        ledger.record_stage("compress", inputs=[f], outputs=[out],
+                            extra_flag=True)
+        built = ledger.build_ledger(command="compress")
+    finally:
+        trace._abort_run_for_tests()
+    assert built["inputs"][str(f)]["sha256"] == _sha256(f)
+    assert str(tmp_path / "missing.fasta") not in built["inputs"]
+    stage = built["stages"][0]
+    assert stage["stage"] == "compress"
+    assert stage["outputs"][str(out)]["sha256"] == _sha256(out)
+    assert stage["extra"] == {"extra_flag": True}
+    assert built["command"] == "compress"
+    assert "python" in built["versions"]
+    assert set(built["caches"]) >= {"parse", "repair", "compile", "probe"}
+
+
+# ---------------- unit: watch follower ----------------
+
+def test_trace_follower_handles_torn_lines_and_replacement(tmp_path):
+    path = tmp_path / "trace.jsonl"
+    fol = watch.TraceFollower(path)
+    assert fol.poll() == []                          # missing file
+
+    path.write_text('{"type":"run","name":"x"}\n{"type":"sp')
+    recs = fol.poll()
+    assert [r["type"] for r in recs] == ["run"]      # torn tail held back
+    with open(path, "a") as f:
+        f.write('an","name":"a","cat":"stage","dur":1.0}\n')
+    recs = fol.poll()
+    assert [r["name"] for r in recs] == ["a"]        # carry + completion
+
+    # file replaced by a smaller, fresh run -> follower restarts from 0
+    path.write_text('{"type":"run","name":"y"}\n')
+    recs = fol.poll()
+    assert recs and recs[0]["name"] == "y"
+
+
+def test_render_frame_shows_tree_device_split_and_qc(tmp_path):
+    records = [
+        {"type": "run", "name": "compress", "t0_epoch": time.time()},
+        {"type": "span", "name": "compress", "cat": "command", "id": 1,
+         "parent": None, "ts": 0.0, "dur": 2.0,
+         "attrs": {"qc": {"compress": {"unitigs": 7}}}},
+        {"type": "span", "name": "kmers", "cat": "device", "id": 2,
+         "parent": 1, "ts": 0.1, "dur": 0.5},
+        {"type": "span", "name": "isolate/s1", "cat": "isolate", "id": 3,
+         "parent": 1, "ts": 0.2, "dur": 1.0, "attrs": {"stage": "compress"}},
+        {"type": "finish", "wall": 2.0},
+    ]
+    frame = watch.render_frame(tmp_path, records)
+    assert "finished" in frame
+    assert "Stage tree" in frame and "kmers" in frame
+    assert "Device vs host" in frame and "1 dispatch" in frame
+    assert "Isolates (1):" in frame and "isolate/s1" in frame
+    assert "QC:" in frame and "unitigs=7" in frame
+
+
+def test_watch_once_missing_dir_fails(tmp_path, capsys):
+    assert watch.watch(tmp_path / "nope") == 1
+    assert "nothing to watch" in capsys.readouterr().err
+
+
+def test_watch_follow_exits_on_finish_and_cycles(tmp_path, capsys):
+    path = tmp_path / "trace.jsonl"
+    path.write_text('{"type":"run","name":"x","t0_epoch":0}\n'
+                    '{"type":"finish","wall":1.0}\n')
+    assert watch.watch(tmp_path, follow=True, interval=0.1, cycles=50) == 0
+    assert "finished" in capsys.readouterr().out
+    # no finish footer: the cycle bound stops the loop
+    path.write_text('{"type":"run","name":"x","t0_epoch":0}\n')
+    assert watch.watch(tmp_path, follow=True, interval=0.1, cycles=2) == 0
+
+
+# ---------------- acceptance: e2e pipeline ledger + QC ----------------
+
+def test_e2e_pipeline_ledger_and_qc_match_outputs(tmp_path, monkeypatch,
+                                                  capsys):
+    asm_dir = make_assemblies(tmp_path, n_assemblies=3, chromosome_len=3000,
+                              plasmid_len=600, seed=7)
+    out_dir = tmp_path / "out"
+    runs = tmp_path / "runs"
+
+    # -- compress --
+    compress_run = _cli(monkeypatch, runs / "compress",
+                        ["compress", "-i", str(asm_dir), "-a", str(out_dir),
+                         "-t", "1"])
+    led = json.loads((compress_run / ledger.LEDGER_JSON).read_text())
+    # every input FASTA hashed, hashes match the files on disk
+    fastas = sorted(asm_dir.glob("*.fasta"))
+    assert len(fastas) == 3
+    for f in fastas:
+        assert led["inputs"][str(f)]["sha256"] == _sha256(f), f
+        assert led["inputs"][str(f)]["bytes"] == f.stat().st_size
+    # the compress stage's output hashes match the artifacts it wrote
+    stage = next(s for s in led["stages"] if s["stage"] == "compress")
+    gfa = out_dir / "input_assemblies.gfa"
+    assert stage["outputs"][str(gfa)]["sha256"] == _sha256(gfa)
+    assert led["command"] == "compress"
+    assert led["caches"]["parse"]["misses"] >= 1     # cold caches this run
+
+    qcr = json.loads((compress_run / qc.QC_REPORT_JSON).read_text())
+    comp = next(e for e in qcr["entries"] if e["stage"] == "compress")
+    unitigs, total_bp = _gfa_stats(gfa)
+    assert comp["metrics"]["unitigs"] == unitigs
+    assert comp["metrics"]["total_bp"] == total_bp
+    assert comp["metrics"]["input_contigs"] == 6     # 3 x (chrom + plasmid)
+    assert comp["metrics"]["n50_bp"] > 0
+    assert sum(comp["metrics"]["depth_hist_bp"].values()) == total_bp
+
+    # -- cluster --
+    cluster_run = _cli(monkeypatch, runs / "cluster",
+                       ["cluster", "-a", str(out_dir)])
+    led = json.loads((cluster_run / ledger.LEDGER_JSON).read_text())
+    stage = next(s for s in led["stages"] if s["stage"] == "cluster")
+    assert stage["inputs"][str(gfa)]["sha256"] == _sha256(gfa)
+    untrimmed = sorted(
+        (out_dir / "clustering").glob("qc_*/cluster_*/1_untrimmed.gfa"))
+    assert untrimmed
+    for u in untrimmed:
+        assert stage["outputs"][str(u)]["sha256"] == _sha256(u), u
+    qcr = json.loads((cluster_run / qc.QC_REPORT_JSON).read_text())
+    clu = next(e for e in qcr["entries"] if e["stage"] == "cluster")
+    pass_dirs = sorted((out_dir / "clustering" / "qc_pass").glob("cluster_*"))
+    assert clu["metrics"]["clusters_pass"] == len(pass_dirs) == 2
+    per_cluster = clu["metrics"]["clusters"]
+    assert all(c["contigs"] == 3 for c in per_cluster if c["passed"])
+
+    # -- trim + resolve per QC-pass cluster --
+    for cdir in pass_dirs:
+        trim_run = _cli(monkeypatch, runs / f"trim_{cdir.name}",
+                        ["trim", "-c", str(cdir), "-t", "1"])
+        qcr = json.loads((trim_run / qc.QC_REPORT_JSON).read_text())
+        t = next(e for e in qcr["entries"] if e["stage"] == "trim")
+        assert t["cluster"] == cdir.name
+        assert t["metrics"]["contigs"] == 3
+        assert t["metrics"]["trim_type"] in ("none", "start_end", "hairpin")
+        assert t["metrics"]["trimmed_contigs"] == len(
+            t["metrics"]["per_contig"])
+        for pc in t["metrics"]["per_contig"]:
+            assert pc["trimmed_bp"] == pc["from_bp"] - pc["to_bp"]
+        led = json.loads((trim_run / ledger.LEDGER_JSON).read_text())
+        stage = next(s for s in led["stages"] if s["stage"] == "trim")
+        trimmed = cdir / "2_trimmed.gfa"
+        assert stage["outputs"][str(trimmed)]["sha256"] == _sha256(trimmed)
+        assert stage["cluster"] == cdir.name
+
+        resolve_run = _cli(monkeypatch, runs / f"resolve_{cdir.name}",
+                           ["resolve", "-c", str(cdir)])
+        qcr = json.loads((resolve_run / qc.QC_REPORT_JSON).read_text())
+        r = next(e for e in qcr["entries"] if e["stage"] == "resolve")
+        assert r["metrics"]["anchors"] >= 1
+        assert r["metrics"]["bridges"] == \
+            r["metrics"]["unique_bridges"] + r["metrics"]["conflicting_bridges"]
+        led = json.loads((resolve_run / ledger.LEDGER_JSON).read_text())
+        stage = next(s for s in led["stages"] if s["stage"] == "resolve")
+        final = cdir / "5_final.gfa"
+        assert stage["outputs"][str(final)]["sha256"] == _sha256(final)
+
+    # -- combine --
+    combine_run = _cli(
+        monkeypatch, runs / "combine",
+        ["combine", "-a", str(out_dir), "-i"]
+        + [str(d / "5_final.gfa") for d in pass_dirs])
+    qcr = json.loads((combine_run / qc.QC_REPORT_JSON).read_text())
+    com = next(e for e in qcr["entries"] if e["stage"] == "combine")
+    consensus_gfa = out_dir / "consensus_assembly.gfa"
+    n_unitigs, n_bp = _gfa_stats(consensus_gfa)
+    assert com["metrics"]["consensus_unitigs"] == n_unitigs
+    assert com["metrics"]["consensus_bp"] == n_bp
+    assert com["metrics"]["clusters"] == 2
+    led = json.loads((combine_run / ledger.LEDGER_JSON).read_text())
+    stage = next(s for s in led["stages"] if s["stage"] == "combine")
+    assert stage["outputs"][str(consensus_gfa)]["sha256"] == \
+        _sha256(consensus_gfa)
+    for d in pass_dirs:
+        assert str(d / "5_final.gfa") in stage["inputs"]
+
+    # -- watch --once renders the finished run with QC highlights --
+    capsys.readouterr()
+    assert cli.main(["watch", str(compress_run)]) == 0
+    out = capsys.readouterr().out
+    assert "finished" in out
+    assert "Stage tree" in out and "compress/build_graph" in out
+    assert "QC:" in out and "unitigs=" in out
+
+    # -- report --json carries qc + ledger; --html writes the document --
+    assert cli.main(["report", str(compress_run), "--json"]) == 0
+    merged = json.loads(capsys.readouterr().out)
+    assert merged["qc"]["entries"][0]["stage"] == "compress"
+    assert str(gfa) in merged["ledger"]["stages"][0]["outputs"]
+
+    assert cli.main(["report", str(compress_run), "--html"]) == 0
+    capsys.readouterr()
+    html_path = compress_run / obs_report.RUN_REPORT_HTML
+    html = html_path.read_text()
+    assert html.startswith("<!DOCTYPE html>")
+    assert "Assembly QC" in html and "Provenance" in html
+    assert "Stage tree" in html
+    assert _sha256(gfa)[:16] in html                  # artifact hash surfaced
+
+
+def test_report_html_explicit_path_and_renderer_schema(tmp_path, capsys):
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    (run_dir / qc.QC_REPORT_JSON).write_text(json.dumps({
+        "schema": 1, "entries": [
+            {"stage": "cluster", "metrics": {
+                "clusters_pass": 1, "clusters_fail": 1,
+                "size_balance_ratio": 1.0,
+                "clusters": [
+                    {"cluster": 1, "passed": True, "contigs": 4,
+                     "total_bp": 100, "distance": 0.01,
+                     "failure_reasons": []},
+                    {"cluster": 2, "passed": False, "contigs": 1,
+                     "total_bp": 10, "distance": 0.3,
+                     "failure_reasons": ["present in too few assemblies"]},
+                ]}}],
+        "summary": {}}))
+    out = tmp_path / "custom.html"
+    assert obs_report.report(run_dir, html=str(out)) == 0
+    capsys.readouterr()
+    html = out.read_text()
+    assert "PASS" in html and "FAIL" in html
+    assert "present in too few assemblies" in html
+    # a qc-only directory is enough telemetry for the text report too
+    built = obs_report.build_report(run_dir)
+    text = obs_report.render_report(built)
+    assert "Assembly QC:" in text and "1 pass / 1 fail" in text

@@ -1,0 +1,102 @@
+"""Edge cases for `autocycler report`: empty or partially-written run
+directories must degrade to a message or a partial report — never a
+traceback. A killed run can leave a torn final trace line, a metrics file
+without a trace, or QC/ledger JSON that is truncated mid-object."""
+
+import json
+
+import pytest
+
+from autocycler_tpu.obs import report as obs_report
+from autocycler_tpu.obs.trace import METRICS_JSON, TRACE_JSONL
+
+pytestmark = pytest.mark.obs
+
+
+def test_report_empty_dir_is_an_error_not_a_crash(tmp_path, capsys):
+    assert obs_report.build_report(tmp_path) is None
+    rc = obs_report.report(tmp_path)
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "no telemetry" in captured.err
+
+
+def test_report_missing_dir(tmp_path, capsys):
+    rc = obs_report.report(tmp_path / "nope")
+    assert rc == 1
+
+
+def test_load_trace_skips_torn_and_garbage_lines(tmp_path):
+    path = tmp_path / TRACE_JSONL
+    path.write_text(
+        json.dumps({"type": "run", "name": "compress"}) + "\n"
+        + json.dumps({"type": "span", "name": "a", "id": 1,
+                      "parent": None, "ts": 0.0, "dur": 1.0}) + "\n"
+        + "{\"type\": \"span\", \"name\": \"torn"  # killed mid-write
+    )
+    trace = obs_report.load_trace(path)
+    assert trace["run"]["name"] == "compress"
+    assert len(trace["spans"]) == 1
+    assert trace["finish"] is None
+
+
+def test_report_metrics_only_dir_renders(tmp_path, capsys):
+    (tmp_path / METRICS_JSON).write_text(json.dumps(
+        {"autocycler_device_dispatch_total": {
+            "type": "counter", "help": "x",
+            "values": [{"labels": {}, "value": 3}]}}))
+    rc = obs_report.report(tmp_path)
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert "Metrics" in captured.out or "metrics" in captured.out
+
+
+def test_report_tolerates_corrupt_sidecar_json(tmp_path, capsys):
+    # trace present and valid; qc/ledger/metrics torn mid-write
+    (tmp_path / TRACE_JSONL).write_text(
+        json.dumps({"type": "run", "name": "trim"}) + "\n"
+        + json.dumps({"type": "span", "name": "trim", "id": 1,
+                      "parent": None, "ts": 0.0, "dur": 0.5}) + "\n"
+        + json.dumps({"type": "finish", "wall": 0.5}) + "\n")
+    (tmp_path / "qc_report.json").write_text('{"entries": [')
+    (tmp_path / "ledger.json").write_text('{"schema"')
+    (tmp_path / METRICS_JSON).write_text("")
+    built = obs_report.build_report(tmp_path)
+    assert built is not None
+    assert "qc" not in built and "ledger" not in built
+    assert obs_report.report(tmp_path) == 0
+    assert obs_report.report(tmp_path, as_json=True) == 0
+    capsys.readouterr()
+
+
+def test_render_never_raises_on_partial_payloads(tmp_path):
+    # Sparse shapes that earlier run formats could have produced: QC
+    # entries without metrics, ledger without stages, spans without cat.
+    partial = {
+        "dir": str(tmp_path),
+        "trace": {"run": {}, "finish": None, "span_count": 1,
+                  "tree": [{"name": "x", "cat": "", "seconds": 0.1,
+                            "count": 1, "mem": None, "children": []}],
+                  "tree_total_s": 0.1},
+        "qc": {"entries": [{"stage": "compress"},
+                           {"stage": "mystery", "metrics": {"k": 1}}]},
+        "ledger": {"schema": 1},
+    }
+    text = obs_report.render_report(partial)
+    assert "Stage tree" in text
+    html = obs_report.render_html(partial)
+    assert html.startswith("<!DOCTYPE html>")
+    # and the absolute minimum report shape
+    minimal = {"dir": str(tmp_path)}
+    assert obs_report.render_report(minimal)
+    assert obs_report.render_html(minimal).startswith("<!DOCTYPE html>")
+
+
+def test_report_html_unwritable_path(tmp_path, capsys):
+    (tmp_path / TRACE_JSONL).write_text(
+        json.dumps({"type": "run", "name": "x"}) + "\n")
+    rc = obs_report.report(tmp_path,
+                           html=str(tmp_path / "no_dir" / "out.html"))
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "could not write" in captured.err

@@ -221,3 +221,33 @@ def test_probe_deadline_env_precedence(monkeypatch):
     assert sentinel.probe_deadline() == 15.0
     monkeypatch.setenv("AUTOCYCLER_PROBE_DEADLINE_S", "5")
     assert sentinel.probe_deadline() == 5.0
+
+
+# ---------------- probe log rotation ----------------
+
+def test_probe_log_rotates_to_newest_entries(tmp_path, monkeypatch):
+    monkeypatch.setenv("AUTOCYCLER_PROBE_LOG_MAX", "5")
+    sentinel.set_probe_log_dir(tmp_path)
+    for i in range(12):
+        sentinel.append_probe_log({"n": i})
+    entries = sentinel.read_probe_log()
+    # only the newest 5 survive, in order, and no tempfiles linger
+    assert [e["n"] for e in entries] == [7, 8, 9, 10, 11]
+    assert not list(tmp_path.glob("*.tmp*"))
+
+
+def test_probe_log_rotation_disabled_with_zero(tmp_path, monkeypatch):
+    monkeypatch.setenv("AUTOCYCLER_PROBE_LOG_MAX", "0")
+    sentinel.set_probe_log_dir(tmp_path)
+    for i in range(10):
+        sentinel.append_probe_log({"n": i})
+    assert len(sentinel.read_probe_log()) == 10
+
+
+def test_probe_log_max_default_and_garbage(monkeypatch):
+    monkeypatch.delenv("AUTOCYCLER_PROBE_LOG_MAX", raising=False)
+    assert sentinel.probe_log_max() == 500
+    monkeypatch.setenv("AUTOCYCLER_PROBE_LOG_MAX", "banana")
+    assert sentinel.probe_log_max() == 500
+    monkeypatch.setenv("AUTOCYCLER_PROBE_LOG_MAX", "-3")
+    assert sentinel.probe_log_max() == 0
