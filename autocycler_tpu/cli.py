@@ -312,6 +312,15 @@ def main(argv=None) -> int:
     if args.command not in ("report", "doctor", "watch"):
         from .obs import sentinel
         sentinel.maybe_start_watcher()
+        # Kick off the device probe on a background thread now, so its
+        # (potentially slow) subprocess attach overlaps host-side load and
+        # parse work. The first device-dispatch point blocks on the future
+        # only for whatever time has not already elapsed. compress/batch
+        # start it themselves right after set_probe_cache_dir(), so the
+        # runner can adopt a persisted negative result from disk.
+        if args.command not in ("compress", "batch"):
+            from .ops.distance import start_background_probe
+            start_background_probe()
     try:
         with trace.span(args.command, cat="command",
                         **({"argv": list(argv)} if argv else {})):

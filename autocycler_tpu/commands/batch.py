@@ -83,8 +83,10 @@ def batch(assemblies_parent, out_parent, k_size: int = 51,
     isolates = find_isolate_dirs(assemblies_parent)
     out_parent = Path(out_parent)
     os.makedirs(out_parent, exist_ok=True)
-    from ..ops.distance import set_probe_cache_dir
+    from ..ops.distance import set_probe_cache_dir, start_background_probe
     set_probe_cache_dir(out_parent / ".cache")
+    # Overlap the device attach with isolate discovery + compress host work.
+    start_background_probe()
     manifest_path = out_parent / MANIFEST_NAME
     manifest = RunManifest.load(manifest_path) if resume \
         else RunManifest(manifest_path)

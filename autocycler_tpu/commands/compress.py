@@ -58,8 +58,12 @@ def compress(assemblies_dir, autocycler_dir, k_size: int = 51,
                     "be used to recover the assemblies (with autocycler decompress) or "
                     "generate a consensus assembly (with autocycler resolve).")
     os.makedirs(autocycler_dir, exist_ok=True)
-    from ..ops.distance import set_probe_cache_dir
+    from ..ops.distance import set_probe_cache_dir, start_background_probe
     set_probe_cache_dir(Path(autocycler_dir) / ".cache")
+    # No-op when cli.main() already started it; covers library callers that
+    # enter compress() directly. Started after the cache dir is set so the
+    # runner can adopt a persisted negative result without spawning jax.
+    start_background_probe()
     metrics = InputAssemblyMetrics()
     with stage_timer("compress/load_and_repair"):
         sequences, assembly_count = load_sequences(

@@ -110,9 +110,10 @@ def recommended_actions(probe_state: dict, neg_cache: dict, env: dict,
 
 def gather(run_dir: str = ".") -> dict:
     """Everything doctor knows, as one dict (the ``--json`` payload)."""
-    from ..ops.distance import device_probe_report
+    from ..ops.distance import device_probe_report, probe_overlap_report
     env = sentinel.environment_snapshot()
     probe_state = device_probe_report()
+    async_probe = probe_overlap_report()
     neg_cache = negative_cache_state(run_dir)
     log_path = Path(run_dir) / sentinel.PROBE_LOG
     if not log_path.exists():
@@ -122,6 +123,7 @@ def gather(run_dir: str = ".") -> dict:
     return {
         "env": env,
         "probe_state": probe_state,
+        "async_probe": async_probe,
         "negative_cache": neg_cache,
         "probe_log": {"path": str(log_path), "entries": history},
         "actions": recommended_actions(probe_state, neg_cache, env, history),
@@ -156,6 +158,24 @@ def _render_text(report: dict) -> None:
         print(f"attached={ps['attached']} kind={ps.get('kind')} "
               f"seconds={ps.get('seconds')} probes={ps.get('probes')}")
         print(f"reason: {ps.get('reason')}")
+
+    ap = report.get("async_probe") or {}
+    print("\nbackground (async) probe")
+    print("------------------------")
+    state = ap.get("state", "unstarted")
+    if state == "unstarted":
+        print("not started in this process (commands start it at launch so "
+              "the attach overlaps host work)")
+    else:
+        print(f"state={state} kind={ap.get('kind')} "
+              f"attempts={ap.get('attempts')} "
+              f"deadline_s={ap.get('deadline_s')}")
+        if ap.get("resolve_s") is not None:
+            print(f"resolved in {ap['resolve_s']:.2f}s; callers blocked "
+                  f"{ap.get('wait_s', 0.0):.2f}s "
+                  f"(overlap saved {ap.get('overlap_saved_s', 0.0):.2f}s, "
+                  f"{ap.get('pending_consults', 0)} pending consult(s) "
+                  "answered host-path)")
 
     nc = report["negative_cache"]
     print("\nnegative cache")

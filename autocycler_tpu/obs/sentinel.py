@@ -451,17 +451,32 @@ def _env_float(name: str, default: float) -> float:
         return default
 
 
-def probe_deadline() -> float:
+#: Default deadline for the BACKGROUND (overlapped) probe. Deliberately
+#: lower than the legacy synchronous 60 s default: the background probe
+#: overlaps host load/parse work, so its deadline bounds attach *lateness*
+#: at the first device-dispatch point, not serial wall time — and a wedged
+#: transport should stop stalling explicit `wait=True` consults after 20 s,
+#: not 60.
+BACKGROUND_PROBE_DEADLINE_S = 20.0
+
+
+def probe_deadline(background: bool = False) -> float:
     """The probe deadline the sentinel shares with ops.distance:
     AUTOCYCLER_PROBE_DEADLINE_S wins, AUTOCYCLER_DEVICE_PROBE_TIMEOUT is
-    the original spelling, default 60 s."""
+    the original spelling. The default depends on how the probe runs:
+    60 s for a synchronous foreground probe (doctor --probe, the watcher,
+    the legacy gate), :data:`BACKGROUND_PROBE_DEADLINE_S` when
+    ``background`` (the overlapped probe started at CLI launch)."""
+    default = BACKGROUND_PROBE_DEADLINE_S if background else 60.0
     raw = os.environ.get("AUTOCYCLER_PROBE_DEADLINE_S")
     if raw is None:
-        raw = os.environ.get("AUTOCYCLER_DEVICE_PROBE_TIMEOUT", "60")
+        raw = os.environ.get("AUTOCYCLER_DEVICE_PROBE_TIMEOUT")
+    if raw is None:
+        return default
     try:
         return float(raw)
     except ValueError:
-        return 60.0
+        return default
 
 
 # ---- the watcher ----

@@ -111,6 +111,29 @@ def _fmt_metrics(metrics: dict) -> str:
     return " ".join(bits)
 
 
+def _async_probe_line(run_dir: Path) -> Optional[str]:
+    """The worker's async-probe state, reconstructed cross-process from
+    ``probe_log.jsonl`` (the watcher cannot see the worker's in-memory
+    future). Returns None when no probe outcome has been logged yet —
+    rendered as pending by the caller."""
+    from . import sentinel
+    entries = sentinel.read_probe_log(Path(run_dir) / sentinel.PROBE_LOG,
+                                      limit=50)
+    last = next((e for e in reversed(entries)
+                 if "attached" in e and "type" not in e), None)
+    if last is None:
+        return None
+    state = "attached" if last.get("attached") else "failed"
+    line = (f"Async probe: {state} kind={last.get('kind')} "
+            f"after {last.get('seconds')}s [{last.get('source')}]")
+    retries = sum(1 for e in entries
+                  if e.get("source") == "background-retry")
+    if retries:
+        line += f" ({retries} retr{'ies' if retries != 1 else 'y'} "
+        line += "before the final outcome)"
+    return line
+
+
 def render_frame(run_dir, records: List[dict]) -> str:
     """One full text frame from the records parsed so far: run state, the
     stage/isolate tree, the device/host split and QC highlights."""
@@ -148,7 +171,22 @@ def render_frame(run_dir, records: List[dict]) -> str:
             split += f" ({100.0 * device_s / wall:.1f}% of wall)"
         lines.append("")
         lines.append(split)
+        wait_s = sum(s.get("dur", 0.0) for s in spans
+                     if s.get("cat") == "device_wait")
+        if wait_s:
+            lines.append(f"Blocked on probe future: "
+                         f"{obs_report._fmt_s(wait_s)} "
+                         "(device wait, excluded from device time)")
 
+    probe_line = _async_probe_line(run_dir)
+    if probe_line:
+        lines.append("")
+        lines.append(probe_line)
+    elif not finish and run:
+        lines.append("")
+        lines.append("Async probe: pending (no outcome logged yet)")
+
+    if spans:
         isolates: Dict[str, dict] = {}
         for s in spans:
             if s.get("cat") != "isolate":

@@ -27,6 +27,8 @@ The reference's remaining walk behaviours are reproduced exactly:
 
 from __future__ import annotations
 
+import functools as _functools
+
 from dataclasses import dataclass
 from typing import List
 
@@ -136,22 +138,141 @@ def _chains_numpy(next_int: np.ndarray):
     return members, chain_off, chain_is_cycle
 
 
-def build_chains(index: KmerIndex, threads=None) -> Chains:
+@_functools.lru_cache(maxsize=None)
+def _chains_fn(bucket: int):
+    """One compiled (node-bucket) executable for chain-following: the
+    predecessor scatter, head/rank pointer doubling, masked cycle
+    min-propagation, cycle breaking at representatives and the re-doubling
+    all fuse into ONE jitted dispatch (static doubling depth
+    ceil(log2(bucket)) + 1, so the executable compiles once per bucket
+    class). Valid because ``next_int`` is functional AND injective (every
+    internal edge has in_count == 1), so the graph is exactly disjoint
+    simple paths and cycles. Pad nodes carry next = -1 and resolve to
+    singleton non-cycle paths, sliced off by the host."""
+    import jax
+    import jax.numpy as jnp
+
+    steps = max(1, int(np.ceil(np.log2(max(bucket, 2)))) + 1)
+
+    def run(next_padded, n_real):
+        node = jnp.arange(bucket, dtype=jnp.int32)
+        real = node < n_real
+        nxt = jnp.where(real, next_padded, jnp.int32(-1))
+        has_next = nxt >= 0
+        # prev scatter (injective: no duplicate real targets); invalid
+        # targets clamp into the extra slot `bucket`
+        tgt = jnp.where(has_next, nxt, bucket)
+        prev = jnp.full(bucket + 1, -1, jnp.int32) \
+            .at[tgt].max(jnp.where(has_next, node, jnp.int32(-1)))[:bucket]
+
+        def double_heads(p):
+            P = jnp.where(p < 0, node, p)
+            R = (p >= 0).astype(jnp.int32)
+            # fori_loop keeps the HLO graph O(1) in the doubling depth —
+            # unrolling `steps` gather stages made XLA CPU compiles crawl
+            P, R = jax.lax.fori_loop(
+                0, steps, lambda _, s: (s[0][s[0]], s[1] + s[1][s[0]]),
+                (P, R))
+            return P, R
+
+        head, rank = double_heads(prev)
+        in_cycle = prev[head] >= 0
+
+        # cycle representatives (= smallest member id): masked full-array
+        # min-propagation — non-cycle nodes carry an out-of-band sentinel
+        # and self-loop pointers, so they never contaminate a cycle's min
+        cmin = jnp.where(in_cycle, node, jnp.int32(bucket))
+        P = jnp.where(in_cycle, prev, node)
+        cmin, P = jax.lax.fori_loop(
+            0, steps,
+            lambda _, s: (jnp.minimum(s[0], s[0][s[1]]), s[1][s[1]]),
+            (cmin, P))
+        rep = in_cycle & (cmin == node)
+        # break each cycle at its representative: dropping the rep's
+        # predecessor is sufficient — the re-doubling only consults prev
+        # (exactly as _chains_numpy's head/rank pass does)
+        prev2 = jnp.where(rep, jnp.int32(-1), prev)
+        head2, rank2 = double_heads(prev2)
+        return head2, rank2, in_cycle
+
+    return jax.jit(run)
+
+
+def _chains_device(next_int: np.ndarray):
+    """Device chain-following: one upload of ``next_int``, one fused
+    dispatch (:func:`_chains_fn`), one download of (head, rank, in_cycle);
+    the O(U) ordering scatters finish on host exactly as
+    :func:`_chains_numpy` orders its members — bit-identical by
+    construction (chain ids are assigned in head-node order either way)."""
+    import jax.numpy as jnp
+
+    from ..utils.timing import device_dispatch
+    from .kmers import _RADIX_DEVICE_ROW_FLOOR, _bucket_size
+
+    U = len(next_int)
+    b = _bucket_size(max(U, 1), floor=_RADIX_DEVICE_ROW_FLOOR)
+    pad_next = np.full(b, -1, np.int32)
+    pad_next[:U] = next_int
+    with device_dispatch("chain pointer doubling",
+                         bytes_moved=float(4 * b * (2 * np.ceil(np.log2(max(b, 2))) + 4))):
+        head_d, rank_d, cyc_d = _chains_fn(b)(jnp.asarray(pad_next),
+                                              jnp.int32(U))
+        head = np.asarray(head_d)[:U].astype(np.int64)
+        rank = np.asarray(rank_d)[:U].astype(np.int64)
+        in_cycle = np.asarray(cyc_d)[:U]
+
+    is_head = head == np.arange(U)
+    cid_of_head = np.cumsum(is_head) - 1
+    C = int(is_head.sum())
+    chain_id = cid_of_head[head]
+    sizes = np.bincount(chain_id, minlength=C)
+    chain_off = np.zeros(C + 1, np.int64)
+    chain_off[1:] = np.cumsum(sizes)
+    members = np.empty(U, np.int64)
+    members[chain_off[chain_id] + rank] = np.arange(U)
+    chain_is_cycle = in_cycle[members[chain_off[:-1]]] if C \
+        else np.zeros(0, bool)
+    return members, chain_off, chain_is_cycle
+
+
+def build_chains(index: KmerIndex, threads=None,
+                 use_jax=None) -> Chains:
     U = index.num_kmers
     if U == 0:
         return Chains(np.zeros(0, np.int64), np.zeros(1, np.int64), np.zeros(0, bool))
 
-    from .kmers import _effective_workers, _resolve_threads
+    from .kmers import (_effective_workers, _resolve_threads,
+                        _resolve_use_jax)
     workers = _effective_workers(_resolve_threads(threads))
+    use_jax_r = _resolve_use_jax(use_jax)
     from ..utils.timing import substage
     with substage("chains"):
         next_int = internal_edges(index, workers)
-        from .. import native
-        walked = native.chain_walk(next_int) if native.available() else None
-        if walked is not None:
-            members, chain_off, chain_is_cycle = walked
-        else:
-            members, chain_off, chain_is_cycle = _chains_numpy(next_int)
+        members = None
+        if use_jax_r:
+            # an explicitly requested device mode takes precedence over the
+            # native walk so the compress hot path stays device-resident
+            try:
+                members, chain_off, chain_is_cycle = \
+                    _chains_device(next_int)
+            except Exception as e:  # noqa: BLE001 — host fallback guarantee
+                import sys
+
+                from ..utils.timing import record_device_failure
+                what = (f"device chain following failed "
+                        f"({type(e).__name__}: {e})")
+                record_device_failure(what, exc=e)
+                print(f"autocycler: {what}; falling back to host chain "
+                      "walk", file=sys.stderr)
+                members = None
+        if members is None:
+            from .. import native
+            walked = native.chain_walk(next_int) if native.available() \
+                else None
+            if walked is not None:
+                members, chain_off, chain_is_cycle = walked
+            else:
+                members, chain_off, chain_is_cycle = _chains_numpy(next_int)
 
     C = len(chain_off) - 1
     sizes = np.diff(chain_off)

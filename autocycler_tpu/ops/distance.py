@@ -37,6 +37,20 @@ _PROBE_LOCK = _threading.Lock()
 # {"attached", "seconds", "reason", "at" (monotonic), "probes"}
 _probe_state: dict = {"probes": 0}
 
+# background (asynchronous) probe bookkeeping — the probe future that lets
+# attach cost overlap the host load/parse/encode stage instead of
+# serializing in front of the first device dispatch:
+# {"started", "resolved", "event", "started_at" (monotonic), "deadline",
+#  "resolve_s", "wait_s", "pending_consults", "attempts"}
+_bg_state: dict = {}
+
+# the BACKGROUND probe's default deadline is deliberately lower than the
+# legacy synchronous 60 s: it runs concurrently with host work, so a
+# shorter deadline only bounds how late a slow-attaching device can still
+# join the run — it never adds wall time. AUTOCYCLER_PROBE_DEADLINE_S /
+# AUTOCYCLER_DEVICE_PROBE_TIMEOUT still win when set.
+BACKGROUND_PROBE_DEADLINE_S = 20.0
+
 # on-disk negative-probe cache: one wedged-transport probe costs a full
 # deadline; persisting the failure (short TTL) under the run's autocycler
 # dir stops every SUBSEQUENT process (batch isolates, CLI stage-per-process
@@ -211,6 +225,7 @@ def _probe_reset() -> None:
     with _PROBE_LOCK:
         _probe_state.clear()
         _probe_state["probes"] = 0
+        _bg_state.clear()
         _probe_cache_dir = None
 
 
@@ -309,13 +324,47 @@ def _tpu_attached() -> bool:
             _probe_state["probing"] = False
         return False
 
+    try:
+        attached, reason, kind, detail, elapsed = _probe_attempt(timeout)
+        _record_probe(attached, elapsed, reason, cache=True, kind=kind,
+                      detail=detail)
+        _disk_probe_store(attached, reason, kind)
+        try:
+            from ..obs import sentinel
+            sentinel.record_outcome(
+                dict(detail or {}, attached=attached, kind=kind,
+                     reason=reason, seconds=round(elapsed, 3)),
+                source="gate")
+        except Exception:  # noqa: BLE001 — forensics must not break the gate
+            pass
+    finally:
+        with _PROBE_LOCK:
+            _probe_state["probing"] = False
+    return attached
+
+
+def _probe_mode() -> str:
+    """"subprocess" (default): the probe runs in a killable child that
+    captures PJRT/libtpu init stderr into the diagnosis (obs.sentinel) —
+    a wedged transport becomes kind="timeout" WITH the init chatter that
+    explains it. "inline" keeps the in-process thread probe (tests pin
+    it; also the mode for hosts where fork/exec is unwelcome)."""
+    import os
+    return os.environ.get("AUTOCYCLER_PROBE_MODE",
+                          "subprocess").strip().lower()
+
+
+def _probe_attempt(timeout: float, mode: str = None
+                   ) -> Tuple[bool, str, str, dict, float]:
+    """One REAL probe attempt with the given deadline, shared by the
+    synchronous gate (:func:`_tpu_attached`) and the background runner.
+    Returns ``(attached, reason, kind, detail, elapsed)``. Exactly one
+    ``_threading.Thread`` is constructed per attempt (tests count these
+    constructions to pin probe/cache semantics)."""
+    import sys
+    if mode is None:
+        mode = _probe_mode()
     result: List[Tuple[bool, str, str, dict]] = []
-    # "subprocess" (default): the probe runs in a killable child that
-    # captures PJRT/libtpu init stderr into the diagnosis (obs.sentinel) —
-    # a wedged transport becomes kind="timeout" WITH the init chatter that
-    # explains it. "inline" keeps the in-process thread probe (tests pin
-    # it; also the mode for hosts where fork/exec is unwelcome).
-    mode = os.environ.get("AUTOCYCLER_PROBE_MODE", "subprocess").strip().lower()
 
     def probe() -> None:
         if mode != "inline":
@@ -346,45 +395,252 @@ def _tpu_attached() -> bool:
                            "error", {}))
 
     t0 = _time.perf_counter()
+    t = _threading.Thread(target=probe, daemon=True, name="tpu-probe")
+    t.start()
+    # the subprocess probe enforces the deadline itself (kill + stderr
+    # capture), so its thread gets a small grace on top; the inline
+    # probe can truly wedge and gets exactly the deadline
+    grace = 0.0 if mode == "inline" else min(5.0, 0.5 + 0.1 * timeout)
+    t.join(timeout + grace)
+    if result:
+        attached, reason, kind, detail = result[0]
+    else:
+        attached = False
+        kind = "timeout"
+        detail = {}
+        reason = (f"probe did not respond within {timeout:.0f}s "
+                  "(wedged transport?)")
+        print(f"autocycler: device {reason}; falling back to host "
+              "backends", file=sys.stderr)
+    return attached, reason, kind, detail, _time.perf_counter() - t0
+
+
+# test hook: keeps the pre-round-5 `_tpu_attached.cache_clear()` call sites
+# (tests/test_device_probe.py) working against the stateful probe
+_tpu_attached.cache_clear = _probe_reset  # type: ignore[attr-defined]
+
+
+# ---- asynchronous probe (the probe future) ----
+# `start_background_probe()` runs the device probe concurrently with the
+# host load/parse/encode stage; `device_attached()` is the consult at the
+# first device-dispatch point. A wedged probe therefore costs ZERO added
+# wall time on the host fallback path: the default consult is a
+# non-blocking peek that answers False while the probe is still pending.
+
+
+def _background_deadline() -> float:
+    """The background probe's deadline: the operator knobs win when set,
+    otherwise :data:`BACKGROUND_PROBE_DEADLINE_S` (lower than the legacy
+    synchronous 60 s default — the probe overlaps host work, so the
+    deadline bounds attach lateness, not wall time). Delegates to
+    obs.sentinel.probe_deadline(background=True) so the knob precedence
+    lives in exactly one place."""
     try:
-        t = _threading.Thread(target=probe, daemon=True, name="tpu-probe")
-        t.start()
-        # the subprocess probe enforces the deadline itself (kill + stderr
-        # capture), so its thread gets a small grace on top; the inline
-        # probe can truly wedge and gets exactly the deadline
-        grace = 0.0 if mode == "inline" else min(5.0, 0.5 + 0.1 * timeout)
-        t.join(timeout + grace)
-        if result:
-            attached, reason, kind, detail = result[0]
-        else:
-            attached = False
-            kind = "timeout"
-            detail = {}
-            reason = (f"probe did not respond within {timeout:.0f}s "
-                      "(wedged transport?)")
-            print(f"autocycler: device {reason}; falling back to host "
-                  "backends", file=sys.stderr)
-        elapsed = _time.perf_counter() - t0
-        _record_probe(attached, elapsed, reason, cache=True, kind=kind,
+        from ..obs import sentinel
+        return sentinel.probe_deadline(background=True)
+    except Exception:  # noqa: BLE001 — sentinel must never break dispatch
+        return BACKGROUND_PROBE_DEADLINE_S
+
+
+def _probe_retries() -> Tuple[int, float]:
+    """(bounded retry count, initial backoff seconds) for the background
+    probe — retries happen BEFORE the persisted negative cache is written,
+    so one transient wedge doesn't poison warm runs for the full TTL."""
+    import os
+    import sys
+    try:
+        retries = max(0, int(os.environ.get("AUTOCYCLER_PROBE_RETRIES", "1")))
+    except ValueError:
+        print("autocycler: ignoring malformed AUTOCYCLER_PROBE_RETRIES",
+              file=sys.stderr)
+        retries = 1
+    try:
+        backoff = float(os.environ.get("AUTOCYCLER_PROBE_RETRY_BACKOFF_S",
+                                       "2.0"))
+    except ValueError:
+        print("autocycler: ignoring malformed "
+              "AUTOCYCLER_PROBE_RETRY_BACKOFF_S", file=sys.stderr)
+        backoff = 2.0
+    return retries, max(0.0, backoff)
+
+
+def _background_runner(deadline: float, mode: str) -> None:
+    """The background probe thread: bounded retry-with-backoff around
+    :func:`_probe_attempt`; only the FINAL outcome reaches the in-memory
+    cache, the persisted negative cache and the sentinel log (intermediate
+    failed attempts log as source="background-retry")."""
+    attached, reason, kind, detail = False, "probe never ran", "error", {}
+    t0 = _time.perf_counter()
+    attempts = 0
+    try:
+        persisted = _disk_probe_load()
+        if persisted is not None:
+            # a recent process already paid the deadline against this
+            # wedged transport: adopt its negative outcome
+            _record_probe(False, 0.0,
+                          f"persisted negative probe: {persisted['reason']}",
+                          cache=True, kind=persisted["kind"])
+            return
+        retries, backoff = _probe_retries()
+        for i in range(retries + 1):
+            attempts += 1
+            with _PROBE_LOCK:
+                _bg_state["attempts"] = attempts
+            attached, reason, kind, detail, elapsed = \
+                _probe_attempt(deadline, mode)
+            if attached or kind not in ("timeout", "error"):
+                break
+            if i < retries:
+                try:
+                    from ..obs import sentinel
+                    sentinel.record_outcome(
+                        dict(detail or {}, attached=False, kind=kind,
+                             reason=reason, seconds=round(elapsed, 3),
+                             retry=i + 1),
+                        source="background-retry")
+                except Exception:  # noqa: BLE001 — forensics only
+                    pass
+                _time.sleep(backoff * (2 ** i))
+        total = _time.perf_counter() - t0
+        _record_probe(attached, total, reason, cache=True, kind=kind,
                       detail=detail)
         _disk_probe_store(attached, reason, kind)
         try:
             from ..obs import sentinel
             sentinel.record_outcome(
                 dict(detail or {}, attached=attached, kind=kind,
-                     reason=reason, seconds=round(elapsed, 3)),
-                source="gate")
+                     reason=reason, seconds=round(total, 3),
+                     attempts=attempts),
+                source="background")
         except Exception:  # noqa: BLE001 — forensics must not break the gate
             pass
     finally:
         with _PROBE_LOCK:
             _probe_state["probing"] = False
-    return attached
+            _bg_state["resolved"] = True
+            _bg_state["resolve_s"] = round(_time.perf_counter() - t0, 3)
+            event = _bg_state.get("event")
+        if event is not None:
+            event.set()
 
 
-# test hook: keeps the pre-round-5 `_tpu_attached.cache_clear()` call sites
-# (tests/test_device_probe.py) working against the stateful probe
-_tpu_attached.cache_clear = _probe_reset  # type: ignore[attr-defined]
+def start_background_probe() -> bool:
+    """Kick off the device probe in a daemon thread so its cost overlaps
+    the host load/parse/encode stage. Idempotent: the first call per
+    process starts (or short-circuits) the probe, later calls are no-ops.
+    Returns True when a background thread was actually started.
+
+    Short-circuit cases resolve synchronously WITHOUT a thread or a jax
+    import: a pinned non-TPU platform, a disabled deadline (<= 0), or an
+    already-cached probe outcome."""
+    import os
+    with _PROBE_LOCK:
+        if _bg_state.get("started"):
+            return False
+        _bg_state.update(started=True, resolved=False, wait_s=0.0,
+                         pending_consults=0, attempts=0,
+                         started_at=_time.monotonic(),
+                         event=_threading.Event())
+        already = _probe_state.get("cached")
+        probing = _probe_state.get("probing")
+    deadline = _background_deadline()
+    platforms = os.environ.get("JAX_PLATFORMS", "").strip().lower()
+    pinned = platforms and "tpu" not in platforms and "axon" not in platforms
+    if already or probing or pinned or deadline <= 0:
+        # resolve immediately: either the answer is already known/cheap
+        # (pinned/cached — _tpu_attached answers without a probe) or the
+        # device path is switched off; a concurrent synchronous probe
+        # (probing) keeps its own thread and resolves the shared state
+        if not probing:
+            _tpu_attached()
+        with _PROBE_LOCK:
+            _bg_state["resolved"] = True
+            _bg_state["resolve_s"] = 0.0
+            event = _bg_state.get("event")
+        event.set()
+        return False
+    with _PROBE_LOCK:
+        _probe_state["probing"] = True
+        _bg_state["deadline"] = deadline
+    t = _threading.Thread(target=_background_runner,
+                          args=(deadline, _probe_mode()),
+                          daemon=True, name="tpu-probe-background")
+    t.start()
+    return True
+
+
+def device_attached(wait: bool = False) -> bool:
+    """The probe-future consult used at device-dispatch points.
+
+    With the background probe pending: ``wait=False`` (the default, for
+    auto-mode dispatch heuristics) answers False immediately — the caller
+    takes the bit-identical host path and the pending consult is counted
+    for :func:`probe_overlap_report`. ``wait=True`` (for explicit operator
+    device requests) blocks until the probe resolves, bounded by the
+    probe's remaining deadline budget; the wait is accounted under the
+    DEVICE_WAIT metric (utils.timing.device_wait), NOT device_seconds.
+
+    With no background probe in flight this is exactly the legacy
+    synchronous gate (:func:`_tpu_attached`)."""
+    with _PROBE_LOCK:
+        pending = _bg_state.get("started") and not _bg_state.get("resolved")
+        if pending and not wait:
+            _bg_state["pending_consults"] = \
+                _bg_state.get("pending_consults", 0) + 1
+            return bool(_probe_state.get("attached", False))
+        event = _bg_state.get("event")
+        started_at = _bg_state.get("started_at", 0.0)
+        deadline = _bg_state.get("deadline", 0.0)
+    if pending and event is not None:
+        # remaining budget: the full retry schedule (attempts + backoffs)
+        # plus thread grace; a wedged background probe never blocks the
+        # caller past this bound
+        retries, backoff = _probe_retries()
+        budget = (retries + 1) * (deadline + 5.0) \
+            + sum(backoff * (2 ** i) for i in range(retries))
+        remaining = max(0.5, budget - (_time.monotonic() - started_at))
+        from ..utils.timing import device_wait
+        t0 = _time.perf_counter()
+        with device_wait("probe future"):
+            event.wait(remaining)
+        with _PROBE_LOCK:
+            _bg_state["wait_s"] = round(
+                _bg_state.get("wait_s", 0.0)
+                + (_time.perf_counter() - t0), 3)
+    return _tpu_attached()
+
+
+def probe_overlap_report() -> dict:
+    """The async-probe ledger for artifacts/doctor/watch: ``state``
+    (unstarted | pending | attached | failed), ``kind`` (probe taxonomy),
+    ``resolve_s`` (probe wall from start to resolution), ``wait_s``
+    (host seconds callers actually blocked on the future),
+    ``overlap_saved_s`` (resolve_s - wait_s: attach latency hidden behind
+    host work), ``pending_consults`` (device-dispatch points that answered
+    host-path while pending) and ``attempts``."""
+    with _PROBE_LOCK:
+        started = _bg_state.get("started", False)
+        resolved = _bg_state.get("resolved", False)
+        resolve_s = _bg_state.get("resolve_s")
+        wait_s = _bg_state.get("wait_s", 0.0)
+        attached = _probe_state.get("attached")
+        kind = _probe_state.get("kind")
+        pending_consults = _bg_state.get("pending_consults", 0)
+        attempts = _bg_state.get("attempts", 0)
+        deadline = _bg_state.get("deadline")
+    if not started:
+        state = "unstarted"
+    elif not resolved:
+        state = "pending"
+    else:
+        state = "attached" if attached else "failed"
+    overlap = None
+    if resolve_s is not None:
+        overlap = round(max(0.0, resolve_s - wait_s), 3)
+    return {"state": state, "kind": kind, "resolve_s": resolve_s,
+            "wait_s": round(wait_s, 3), "overlap_saved_s": overlap,
+            "pending_consults": pending_consults, "attempts": attempts,
+            "deadline_s": deadline}
 
 
 def exceeds_int32_accumulation(weighted: np.ndarray) -> bool:
@@ -440,7 +696,10 @@ def pairwise_distance_matrix(M: np.ndarray, w: np.ndarray,
         elif M.size < _TPU_THRESHOLD:
             use_jax = False         # too small everywhere; keep jax unloaded
         else:
-            use_jax = _tpu_attached()
+            # auto mode consults the probe future non-blockingly: while the
+            # background probe is pending this answers False (host matmul,
+            # bit-identical) rather than stalling the stage on attach
+            use_jax = device_attached()
     Mw = M.astype(np.int64) * w[None, :]
     if use_jax and exceeds_int32_accumulation(Mw):
         use_jax = False

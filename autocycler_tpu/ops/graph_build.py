@@ -193,11 +193,13 @@ def build_unitig_graph(sequences: List[Sequence], k: int,
                        use_jax=None, threads=None) -> UnitigGraph:
     """Sequences (padded, end-repaired) -> compacted unitig graph.
     ``threads`` flows into the k-mer grouping (the radix-partitioned
-    parallel path engages above one worker on large inputs); results are
-    bit-identical at every thread count."""
+    parallel path engages above one worker on large inputs); ``use_jax``
+    flows into grouping, adjacency AND chain-following, so a device run
+    keeps the whole compress hot path on the accelerator. Results are
+    bit-identical at every thread count and on every backend."""
     from ..utils import log
     index = build_kmer_index(sequences, k, use_jax=use_jax, threads=threads)
     log.message(f"Graph contains {index.num_kmers} k-mers")
     log.message()
-    chains = build_chains(index, threads=threads)
+    chains = build_chains(index, threads=threads, use_jax=use_jax)
     return unitig_graph_from_chains(index, chains)

@@ -63,9 +63,12 @@ def _resolve_use_jax(use_jax: UseJax) -> UseJax:
         return use_jax
     value = os.environ.get("AUTOCYCLER_DEVICE_GROUPING", "").strip().lower()
     if value in ("1", "true", "yes", "on"):
-        from .distance import (_tpu_attached, jax_backend_safe,
+        from .distance import (device_attached, jax_backend_safe,
                                warn_backend_unsafe_once)
-        if _tpu_attached():
+        # an explicit operator enable is worth a bounded wait on the probe
+        # future (the background probe may still be attaching); the wait is
+        # accounted under DEVICE_WAIT, never device_seconds
+        if device_attached(wait=True):
             return "pallas"
         if jax_backend_safe():
             return "bucketed"
@@ -614,23 +617,63 @@ def _radix_sharded_rank_fn(rows: int, bucket: int, codes_bucket: int,
     return jax.jit(run)
 
 
-def _pack_and_rank_jax_radix(codes: np.ndarray, starts: np.ndarray, k: int,
-                             threads=None):
-    """Radix-partitioned device grouping: the same host-side base-5
-    partition as the parallel host path splits windows into equal-count
-    key-aligned buckets; buckets pad to one shared fixed shape, stack to
-    [rows, bucket] and sort per row on device, with the leading axis laid
-    across the mesh (parallel/mesh.shard_leading_axis) when more than one
-    device is attached. Per-bucket (order, gid) results stitch to global
-    lexicographic ranks on the host exactly as in the host radix path."""
+@functools.lru_cache(maxsize=None)
+def _radix_sharded_stats_fn(rows: int, bucket: int, codes_bucket: int,
+                            kk: int):
+    """The fused pack+rank+group-stats executable: one jitted per-bucket
+    kernel that, in the SAME dispatch as the sort, scatters per-group depth
+    (segment count) and first-occurrence (segment min of the stable order)
+    on device — so the caller's statistics need no host _derive_stats pass
+    and the bucket data makes exactly one host->device round trip.
+
+    Scatter indices clamp pad rows into an extra slot (index ``bucket``):
+    pad windows pack to INT32_MAX and sort last, so their gid would land
+    exactly at n_groups — inside the real range only when a row is full,
+    but the extra slot makes the no-corruption argument unconditional."""
     import jax
     import jax.numpy as jnp
 
-    from ..parallel.mesh import shard_leading_axis
+    int32_max = jnp.int32(2**31 - 1)
 
-    from ..utils.timing import device_dispatch, substage
+    def run(codes_d, starts_mat, n_real):
+        def one(starts_row, m):
+            pos = jnp.arange(bucket)
+            real = pos < m
+            order, gid_sorted = _rank_windows_traced(codes_d, starts_row, kk,
+                                                     real=real)
+            # `real` indexes the SORTED view here: pads sort strictly last,
+            # so sorted positions >= m are exactly the pad entries
+            gid_c = jnp.where(real, gid_sorted, bucket)
+            depth = jnp.zeros(bucket + 1, jnp.int32).at[gid_c].add(
+                jnp.where(real, 1, 0))
+            # stable sort => within a group the carried original indices
+            # ascend, so the segment-min of `order` is the group's first
+            # occurrence (row-local index)
+            first_local = jnp.full(bucket + 1, int32_max, jnp.int32) \
+                .at[gid_c].min(jnp.where(real, order.astype(jnp.int32),
+                                         int32_max))
+            n_groups = jnp.where(m > 0,
+                                 gid_sorted[jnp.maximum(m - 1, 0)] + 1, 0)
+            return (order, gid_sorted, depth[:bucket], first_local[:bucket],
+                    n_groups.astype(jnp.int32))
 
-    n = len(starts)
+        return jax.vmap(one)(starts_mat, n_real)
+
+    return jax.jit(run)
+
+
+def _radix_device_layout(codes: np.ndarray, starts: np.ndarray, k: int,
+                         threads=None):
+    """Host-side partition + fixed-shape padding shared by the radix-sharded
+    device paths: the same base-5 partition as the parallel host path splits
+    windows into equal-count key-aligned buckets, which pad to one shared
+    fixed shape and stack to [rows, bucket] (rows padded to a device
+    multiple so the leading axis shards across the mesh). Returns
+    ``(part, offs, rows, b, cb, starts_mat, n_real, pad_codes)``."""
+    import jax
+
+    from ..utils.timing import substage
+
     workers = _effective_workers(_resolve_threads(threads))
     n_dev = max(1, len(jax.devices()))
     with substage("partition"):
@@ -650,6 +693,27 @@ def _pack_and_rank_jax_radix(codes: np.ndarray, starts: np.ndarray, k: int,
         n_real[c] = hi - lo
     pad_codes = np.zeros(cb, codes.dtype)
     pad_codes[:len(codes)] = codes
+    return part, offs, rows, b, cb, starts_mat, n_real, pad_codes
+
+
+def _pack_and_rank_jax_radix(codes: np.ndarray, starts: np.ndarray, k: int,
+                             threads=None):
+    """Radix-partitioned device grouping: the same host-side base-5
+    partition as the parallel host path splits windows into equal-count
+    key-aligned buckets; buckets pad to one shared fixed shape, stack to
+    [rows, bucket] and sort per row on device, with the leading axis laid
+    across the mesh (parallel/mesh.shard_leading_axis) when more than one
+    device is attached. Per-bucket (order, gid) results stitch to global
+    lexicographic ranks on the host exactly as in the host radix path."""
+    import jax.numpy as jnp
+
+    from ..parallel.mesh import shard_leading_axis
+    from ..utils.timing import device_dispatch, substage
+
+    n = len(starts)
+    part, offs, rows, b, cb, starts_mat, n_real, pad_codes = \
+        _radix_device_layout(codes, starts, k, threads)
+    C = len(offs) - 1
 
     with device_dispatch("k-mer grouping sort (radix-sharded)"), \
             substage("sort"):
@@ -675,6 +739,64 @@ def _pack_and_rank_jax_radix(codes: np.ndarray, starts: np.ndarray, k: int,
             gid_sorted[lo:hi] = gids[c, :m].astype(np.int64) + g_off
             g_off += int(gids[c, m - 1]) + 1
     return order, gid_sorted
+
+
+def _radix_rank_stats_device(codes: np.ndarray, starts: np.ndarray, k: int,
+                             threads=None):
+    """Device counterpart of :func:`_radix_rank_stats`: one fused jitted
+    kernel per bucket row produces (order, gid, depth, first_occ) with a
+    single host->device upload per bucket and a single download of the
+    final group ids/stats — no host _derive_stats pass. Bit-identical to
+    the host radix path: the partition is shared, the sort is stable, and
+    the device segment ops mirror the bucket-local statistics exactly."""
+    import jax.numpy as jnp
+
+    from ..parallel.mesh import shard_leading_axis
+    from ..utils.timing import device_dispatch, substage
+
+    n = len(starts)
+    part, offs, rows, b, cb, starts_mat, n_real, pad_codes = \
+        _radix_device_layout(codes, starts, k, threads)
+    C = len(offs) - 1
+
+    with device_dispatch("k-mer grouping sort+stats (radix-sharded)"), \
+            substage("sort"):
+        codes_d, mat_d, nr_d = shard_leading_axis(
+            jnp.asarray(pad_codes), starts_mat, n_real)
+        orders, gids, depths, firsts, ngroups = \
+            _radix_sharded_stats_fn(rows, b, cb, k)(codes_d, mat_d, nr_d)
+        orders = np.asarray(orders)
+        gids = np.asarray(gids)
+        depths = np.asarray(depths)
+        firsts = np.asarray(firsts)
+        ngroups = np.asarray(ngroups)
+
+    with substage("stitch"):
+        order = np.empty(n, np.int64)
+        gid_sorted = np.empty(n, np.int64)
+        depth_parts, first_parts = [], []
+        g_off = 0
+        for c in range(C):
+            lo, hi = int(offs[c]), int(offs[c + 1])
+            m = hi - lo
+            idx = part[lo:hi]
+            o_row = orders[c, :m].astype(np.int64)
+            order[lo:hi] = idx[o_row]
+            gid_sorted[lo:hi] = gids[c, :m].astype(np.int64) + g_off
+            g_c = int(ngroups[c])
+            depth_parts.append(depths[c, :g_c].astype(np.int64))
+            # first_local holds row-local ORIGINAL window indices (the
+            # partition preserves original order within equal keys, so the
+            # row-local minimum maps to the global minimum through idx)
+            first_parts.append(idx[firsts[c, :g_c].astype(np.int64)])
+            g_off += g_c
+        depth = np.concatenate(depth_parts) if depth_parts \
+            else np.zeros(0, np.int64)
+        first_occ = np.concatenate(first_parts) if first_parts \
+            else np.zeros(0, np.int64)
+        gid = np.empty(n, np.int64)
+        gid[order] = gid_sorted
+    return gid, order, depth, first_occ
 
 
 def group_windows_full(codes: np.ndarray, starts: np.ndarray, k: int,
@@ -784,6 +906,24 @@ def group_windows_stats(codes: np.ndarray, starts: np.ndarray, k: int,
     if n and k > 0:
         use_jax_r = _resolve_use_jax(use_jax)
         workers = _effective_workers(_resolve_threads(threads))
+        if use_jax_r == "radix":
+            # the fused device kernel produces the statistics in the same
+            # dispatch as the sort (no host _derive_stats pass); any device
+            # failure falls back to the exact host paths, visibly
+            from ..utils.jaxcache import configure_compile_cache
+            configure_compile_cache()
+            try:
+                return _radix_rank_stats_device(codes, starts, k, threads)
+            except Exception as e:  # noqa: BLE001 — host fallback guarantee
+                import sys
+
+                from ..utils.timing import record_device_failure
+                what = (f"device k-mer grouping stats failed "
+                        f"({type(e).__name__}: {e})")
+                record_device_failure(what, exc=e)
+                print(f"autocycler: {what}; falling back to host backend",
+                      file=sys.stderr)
+                use_jax = False
         if not use_jax_r and _host_radix_enabled(n, k, workers, partitions):
             return _radix_rank_stats(codes, starts, k, workers, partitions)
     gid, order = group_windows_full(codes, starts, k, use_jax, threads,
@@ -922,13 +1062,82 @@ class KmerIndex:
         return len(self.depth)
 
 
+@functools.lru_cache(maxsize=None)
+def _adjacency_fn(bucket: int, gram_bucket: int):
+    """One compiled (U-bucket, gram-bucket) executable for the adjacency
+    segment ops: bincounts become scatter-adds, the successor table a
+    scatter-max (`.at[p].max(arange)` over ascending indices equals numpy's
+    last-write-wins `succ_by_gram[prefix_gid] = arange(U)` bit for bit),
+    and the three gathers fuse into the same dispatch. Pad rows scatter
+    into the extra slot ``gram_bucket`` so a full gram range (G ==
+    gram_bucket) cannot be corrupted."""
+    import jax
+    import jax.numpy as jnp
+
+    def run(prefix_d, suffix_d, n_real):
+        real = jnp.arange(bucket) < n_real
+        p = jnp.where(real, prefix_d, gram_bucket)
+        s = jnp.where(real, suffix_d, gram_bucket)
+        one = jnp.where(real, 1, 0).astype(jnp.int32)
+        cnt_prefix = jnp.zeros(gram_bucket + 1, jnp.int32).at[p].add(one)
+        cnt_suffix = jnp.zeros(gram_bucket + 1, jnp.int32).at[s].add(one)
+        succ_by_gram = jnp.full(gram_bucket + 1, -1, jnp.int32) \
+            .at[p].max(jnp.where(real, jnp.arange(bucket, dtype=jnp.int32),
+                                 jnp.int32(-1)))
+        out_count = cnt_prefix[s]
+        in_count = cnt_suffix[p]
+        succ = succ_by_gram[s]
+        return out_count, in_count, succ
+
+    return jax.jit(run)
+
+
+def _adjacency_jax(prefix_gid: np.ndarray, suffix_gid: np.ndarray, G: int):
+    """Device adjacency: one upload of the two gram-id vectors, one fused
+    dispatch of the segment ops, one download of (out_count, in_count,
+    succ). Shapes pad to buckets so the executable compiles once per bucket
+    class; the pad tail is sliced off before returning."""
+    import jax.numpy as jnp
+
+    from ..utils.timing import device_dispatch
+
+    U = len(prefix_gid)
+    b = _bucket_size(max(U, 1), floor=_RADIX_DEVICE_ROW_FLOOR)
+    gb = _bucket_size(max(G, 1), floor=_RADIX_DEVICE_ROW_FLOOR)
+    pad_p = np.zeros(b, np.int32)
+    pad_p[:U] = prefix_gid
+    pad_s = np.zeros(b, np.int32)
+    pad_s[:U] = suffix_gid
+    with device_dispatch("adjacency segment ops",
+                         bytes_moved=2.0 * b * 4 + 3.0 * b * 4):
+        out_c, in_c, succ = _adjacency_fn(b, gb)(
+            jnp.asarray(pad_p), jnp.asarray(pad_s), jnp.int32(U))
+        out_count = np.asarray(out_c)[:U].astype(np.int64)
+        in_count = np.asarray(in_c)[:U].astype(np.int64)
+        succ = np.asarray(succ)[:U].astype(np.int64)
+    return out_count, in_count, succ
+
+
 def _adjacency(prefix_gid: np.ndarray, suffix_gid: np.ndarray, G: int,
-               workers: int = 1):
+               workers: int = 1, use_jax: bool = False):
     """Neighbour counts over UNIQUE k-mers (next_kmers/prev_kmers semantics,
-    kmer_graph.rs:136-166) by (k-1)-gram id equality. The bincounts and
-    gathers chunk over the shared pool (utils.pool) above one worker —
-    bit-identical by construction (disjoint output ranges; integer count
-    sums are order-independent)."""
+    kmer_graph.rs:136-166) by (k-1)-gram id equality. With ``use_jax`` the
+    segment ops run as one fused jitted device kernel
+    (:func:`_adjacency_jax`), any failure falling back here visibly; on
+    host the bincounts and gathers chunk over the shared pool (utils.pool)
+    above one worker — bit-identical by construction (disjoint output
+    ranges; integer count sums are order-independent)."""
+    if use_jax and len(prefix_gid):
+        try:
+            return _adjacency_jax(prefix_gid, suffix_gid, G)
+        except Exception as e:  # noqa: BLE001 — host fallback guarantee
+            import sys
+
+            from ..utils.timing import record_device_failure
+            what = f"device adjacency failed ({type(e).__name__}: {e})"
+            record_device_failure(what, exc=e)
+            print(f"autocycler: {what}; falling back to host segment ops",
+                  file=sys.stderr)
     from ..utils.pool import parallel_bincount, parallel_gather
     U = len(prefix_gid)
     cnt_prefix = parallel_bincount(prefix_gid, G, workers)
@@ -1103,7 +1312,8 @@ def build_kmer_index(sequences, k: int, use_jax: UseJax = None,
     from ..utils.timing import substage
     with substage("adjacency"):
         out_count, in_count, succ = _adjacency(prefix_gid, suffix_gid, G,
-                                               workers)
+                                               workers,
+                                               use_jax=bool(use_jax))
 
     return KmerIndex(
         k=k, half_k=half_k, buf=buf, seq_ids=seq_ids, seq_len=seq_len,

@@ -57,8 +57,26 @@ def _devices_with_deadline():
         print("autocycler: ignoring malformed AUTOCYCLER_MESH_INIT_TIMEOUT",
               file=sys.stderr)
         timeout = 600.0
+    # consult the (possibly background-resolved) device probe before paying
+    # for a watchdog thread: a probe that already attached (or pinned the
+    # backend to host) proves jax.devices() returns promptly, and a probe
+    # that TIMED OUT proves the tunnel is wedged — fail fast instead of
+    # blocking this process for the full mesh-init window.
+    probe_kind = None
+    try:
+        from ..ops.distance import device_probe_report
+        report = device_probe_report()
+        if report.get("attached") is not None:   # a probe has resolved
+            probe_kind = report.get("kind")
+    except Exception:  # noqa: BLE001 — probe state is advisory here
+        probe_kind = None
+    if probe_kind == "timeout":
+        raise RuntimeError(
+            "device probe already timed out this run (wedged tunnel?); "
+            "refusing to block on mesh init — set JAX_PLATFORMS=cpu to run "
+            "on host devices, or clear the probe cache to retry")
     import jax
-    if timeout <= 0:
+    if timeout <= 0 or probe_kind in ("ok", "no-tpu", "pinned"):
         return jax.devices()
     result = []
 

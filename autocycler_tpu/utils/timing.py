@@ -27,6 +27,7 @@ from .misc import format_duration
 # metric names (the single source of truth for every accessor below and
 # for obs.report's device/stage summaries)
 DEVICE_SECONDS = "autocycler_device_seconds_total"
+DEVICE_WAIT = "autocycler_device_wait_seconds_total"
 DEVICE_DISPATCHES = "autocycler_device_dispatches_total"
 DEVICE_FAILURES = "autocycler_device_failures_total"
 DEVICE_FAILURE_LAST = "autocycler_device_failure_last"
@@ -181,6 +182,31 @@ def device_kernel_snapshot() -> dict:
 def device_seconds() -> float:
     """Total host-observed seconds spent in device dispatches so far."""
     return metrics_registry.registry().value(DEVICE_SECONDS)
+
+
+@contextlib.contextmanager
+def device_wait(what: str = ""):
+    """Times one bounded block on the device-attach future (the async probe)
+    into DEVICE_WAIT — deliberately NOT :data:`DEVICE_SECONDS`: waiting for
+    the transport to attach is latency the device has not yet earned, and
+    folding it into ``device_seconds`` would inflate ``device_fraction``
+    with seconds no kernel ran. Opens a "device_wait" span so the trace
+    shows where a stage stalled on attach rather than on compute."""
+    label = what or "probe future"
+    start = time.perf_counter()
+    try:
+        with trace.span(label, cat="device_wait"):
+            yield
+    finally:
+        metrics_registry.registry().counter_inc(
+            DEVICE_WAIT, time.perf_counter() - start,
+            help="host seconds blocked on the device-attach future "
+                 "(probe wait, excluded from device_seconds)")
+
+
+def device_wait_seconds() -> float:
+    """Total host seconds blocked on the device-attach future so far."""
+    return metrics_registry.registry().value(DEVICE_WAIT)
 
 
 def device_calls() -> int:

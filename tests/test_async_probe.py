@@ -1,0 +1,321 @@
+"""The asynchronous device probe (ops/distance.start_background_probe):
+the probe future, its overlap accounting, retry-before-persist, and the
+surfaces that report it (doctor, watch, mesh, bench guard helpers).
+
+The conftest pins JAX_PLATFORMS=cpu; tests that need the probe thread to
+actually run monkeypatch JAX_PLATFORMS=axon AND replace
+distance._probe_attempt with a stub, so no test ever initialises a real
+backend off the pinned one.
+"""
+
+import json
+import time
+
+import pytest
+
+
+@pytest.fixture
+def fresh(monkeypatch):
+    """Reset probe + background-future + sentinel state around each test."""
+    from autocycler_tpu.obs import sentinel
+    from autocycler_tpu.ops import distance
+
+    distance._tpu_attached.cache_clear()
+    distance.set_probe_cache_dir(None)
+    sentinel._reset_for_tests()
+    yield distance
+    # let an in-flight background runner resolve before the next test
+    # rebinds the shared state (stub attempts are sub-second)
+    with distance._PROBE_LOCK:
+        event = distance._bg_state.get("event")
+    if event is not None:
+        event.wait(5.0)
+    distance._tpu_attached.cache_clear()
+    distance.set_probe_cache_dir(None)
+    sentinel._reset_for_tests()
+
+
+def _stub_attempt(outcomes, delay=0.0):
+    """A _probe_attempt stand-in yielding scripted outcomes in order (the
+    last repeats). Each outcome is (attached, kind)."""
+    calls = []
+
+    def attempt(timeout, mode=None):
+        t0 = time.perf_counter()
+        if delay:
+            time.sleep(delay)
+        attached, kind = outcomes[min(len(calls), len(outcomes) - 1)]
+        calls.append((timeout, mode))
+        reason = f"stub probe ({kind})"
+        return attached, reason, kind, {"stub": True}, \
+            time.perf_counter() - t0
+
+    attempt.calls = calls
+    return attempt
+
+
+def _wait_resolved(distance, timeout=10.0):
+    with distance._PROBE_LOCK:
+        event = distance._bg_state.get("event")
+    assert event is not None
+    assert event.wait(timeout), "background probe never resolved"
+
+
+def test_pinned_short_circuits_without_thread(fresh, monkeypatch):
+    """Under the pinned CPU backend the 'background' probe resolves
+    synchronously: no thread, immediate failed/pinned state, zero
+    resolve time, and the call is idempotent."""
+    distance = fresh
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert distance.start_background_probe() is False
+    report = distance.probe_overlap_report()
+    assert report["state"] == "failed"
+    assert report["kind"] == "pinned"
+    assert report["resolve_s"] == 0.0
+    assert distance.device_attached() is False
+    assert distance.device_attached(wait=True) is False
+    assert distance.start_background_probe() is False  # idempotent
+
+
+def test_unstarted_report_state(fresh):
+    assert fresh.probe_overlap_report()["state"] == "unstarted"
+
+
+def test_pending_peek_costs_no_wall_time(fresh, monkeypatch):
+    """While the probe is pending, the default consult answers host-path
+    immediately (zero added wall time) and the consult is counted."""
+    distance = fresh
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    monkeypatch.setattr(distance, "_probe_attempt",
+                        _stub_attempt([(True, "ok")], delay=0.4))
+    assert distance.start_background_probe() is True
+    t0 = time.perf_counter()
+    assert distance.device_attached() is False          # peek: host path
+    assert distance.device_attached() is False
+    assert time.perf_counter() - t0 < 0.2, "peek must not block"
+    assert distance.probe_overlap_report()["state"] == "pending"
+    assert distance.probe_overlap_report()["pending_consults"] == 2
+    _wait_resolved(distance)
+    # resolved: the future now answers the probe's real outcome
+    assert distance.device_attached() is True
+    report = distance.probe_overlap_report()
+    assert report["state"] == "attached"
+    assert report["kind"] == "ok"
+
+
+def test_wait_blocks_and_accounts_device_wait(fresh, monkeypatch):
+    """wait=True blocks on the future; the blocked seconds land under the
+    DEVICE_WAIT metric (and a device_wait trace span), NOT device_seconds,
+    and overlap_saved_s reports the attach latency hidden by host work."""
+    from autocycler_tpu.utils import timing
+
+    distance = fresh
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    monkeypatch.setattr(distance, "_probe_attempt",
+                        _stub_attempt([(True, "ok")], delay=0.3))
+    device_s0 = timing.device_seconds()
+    wait_s0 = timing.device_wait_seconds()
+    assert distance.start_background_probe() is True
+    time.sleep(0.2)                     # host work overlapping the attach
+    assert distance.device_attached(wait=True) is True
+    report = distance.probe_overlap_report()
+    assert report["state"] == "attached"
+    assert report["wait_s"] < report["resolve_s"]
+    assert report["overlap_saved_s"] == pytest.approx(
+        report["resolve_s"] - report["wait_s"], abs=0.02)
+    assert report["overlap_saved_s"] > 0.1
+    assert timing.device_wait_seconds() - wait_s0 >= report["wait_s"] - 0.02
+    assert timing.device_seconds() == device_s0, \
+        "probe wait must not inflate device kernel seconds"
+
+
+def test_retry_succeeds_without_persisting_negative(fresh, monkeypatch,
+                                                    tmp_path):
+    """A transient first-timeout followed by a successful retry must leave
+    NO persisted negative cache — retries happen before the disk write."""
+    distance = fresh
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    monkeypatch.setenv("AUTOCYCLER_PROBE_RETRIES", "1")
+    monkeypatch.setenv("AUTOCYCLER_PROBE_RETRY_BACKOFF_S", "0.01")
+    distance.set_probe_cache_dir(tmp_path)
+    stub = _stub_attempt([(False, "timeout"), (True, "ok")])
+    monkeypatch.setattr(distance, "_probe_attempt", stub)
+    assert distance.start_background_probe() is True
+    assert distance.device_attached(wait=True) is True
+    report = distance.probe_overlap_report()
+    assert report["state"] == "attached"
+    assert report["attempts"] == 2
+    assert not (tmp_path / "device_probe.json").exists(), \
+        "intermediate timeout must not write the negative cache"
+    # the intermediate failure is logged for forensics, the final outcome
+    # as source="background"
+    from autocycler_tpu.obs import sentinel
+    entries = sentinel.read_probe_log(tmp_path / "probe_log.jsonl")
+    sources = [e.get("source") for e in entries]
+    assert "background-retry" in sources
+    final = next(e for e in reversed(entries) if "attached" in e)
+    assert final["source"] == "background"
+    assert final["attached"] is True
+    assert final["attempts"] == 2
+    # the false -> true transition also fired the recovery note
+    assert any(e.get("type") == "recovery" for e in entries)
+
+
+def test_retries_exhausted_persist_final_negative(fresh, monkeypatch,
+                                                  tmp_path):
+    """Only after the bounded retry schedule is exhausted does the negative
+    outcome reach the in-memory cache AND the persisted disk cache."""
+    distance = fresh
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    monkeypatch.setenv("AUTOCYCLER_PROBE_RETRIES", "1")
+    monkeypatch.setenv("AUTOCYCLER_PROBE_RETRY_BACKOFF_S", "0.01")
+    distance.set_probe_cache_dir(tmp_path)
+    stub = _stub_attempt([(False, "timeout")])
+    monkeypatch.setattr(distance, "_probe_attempt", stub)
+    assert distance.start_background_probe() is True
+    assert distance.device_attached(wait=True) is False
+    report = distance.probe_overlap_report()
+    assert report["state"] == "failed"
+    assert report["kind"] == "timeout"
+    assert report["attempts"] == 2
+    entry = json.loads((tmp_path / "device_probe.json").read_text())
+    assert entry["kind"] == "timeout"
+
+
+def test_background_adopts_persisted_negative(fresh, monkeypatch, tmp_path):
+    """A fresh persisted negative resolves the background probe without a
+    single probe attempt (warm-run fast path)."""
+    distance = fresh
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    distance.set_probe_cache_dir(tmp_path)
+    (tmp_path / "device_probe.json").write_text(json.dumps(
+        {"kind": "timeout", "reason": "wedged earlier", "at": time.time()}))
+    stub = _stub_attempt([(True, "ok")])
+    monkeypatch.setattr(distance, "_probe_attempt", stub)
+    assert distance.start_background_probe() is True
+    assert distance.device_attached(wait=True) is False
+    assert stub.calls == [], "persisted negative must skip probe attempts"
+    report = distance.device_probe_report()
+    assert report["kind"] == "timeout"
+    assert "persisted negative" in report["reason"]
+
+
+def test_background_deadline_default_and_override(fresh, monkeypatch):
+    """The background probe defaults to the LOWER 20 s deadline; the
+    operator knobs still win for both flavours."""
+    from autocycler_tpu.obs import sentinel
+    monkeypatch.delenv("AUTOCYCLER_PROBE_DEADLINE_S", raising=False)
+    monkeypatch.delenv("AUTOCYCLER_DEVICE_PROBE_TIMEOUT", raising=False)
+    assert sentinel.probe_deadline() == 60.0
+    assert sentinel.probe_deadline(background=True) == \
+        sentinel.BACKGROUND_PROBE_DEADLINE_S == 20.0
+    assert fresh._background_deadline() == 20.0
+    monkeypatch.setenv("AUTOCYCLER_PROBE_DEADLINE_S", "7.5")
+    assert sentinel.probe_deadline(background=True) == 7.5
+    assert fresh._background_deadline() == 7.5
+
+
+def test_doctor_surfaces_async_probe(fresh, monkeypatch, tmp_path, capsys):
+    """`doctor --json` carries the async_probe ledger; the text rendering
+    names the background probe section."""
+    from autocycler_tpu.commands import doctor
+
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    fresh.start_background_probe()
+    report = doctor.gather(str(tmp_path))
+    assert report["async_probe"]["state"] == "failed"
+    assert report["async_probe"]["kind"] == "pinned"
+    doctor._render_text(report)
+    out = capsys.readouterr().out
+    assert "background (async) probe" in out
+    assert "state=failed" in out
+
+
+def test_watch_renders_probe_state(tmp_path):
+    """The watch frame reconstructs the worker's async-probe state from
+    probe_log.jsonl (pending until an outcome lands)."""
+    from autocycler_tpu.obs import watch
+
+    run = [{"type": "run", "name": "compress", "t0_epoch": time.time()}]
+    frame = watch.render_frame(tmp_path, run)
+    assert "Async probe: pending" in frame
+    (tmp_path / "probe_log.jsonl").write_text(
+        json.dumps({"ts": 1.0, "source": "background-retry",
+                    "attached": False, "kind": "timeout", "seconds": 20.0,
+                    "reason": "wedged"}) + "\n"
+        + json.dumps({"ts": 2.0, "source": "background", "attached": True,
+                      "kind": "ok", "seconds": 3.2, "reason": "healthy"})
+        + "\n")
+    frame = watch.render_frame(tmp_path, run)
+    assert "Async probe: attached kind=ok" in frame
+    assert "1 retry" in frame
+
+
+def test_mesh_fails_fast_on_timed_out_probe(fresh, monkeypatch):
+    """A resolved kind=timeout probe makes mesh init fail fast instead of
+    paying the (up to 600 s) watchdog against the same wedged tunnel."""
+    from autocycler_tpu.parallel import mesh
+
+    distance = fresh
+    with distance._PROBE_LOCK:
+        distance._probe_state.update(cached=True, attached=False,
+                                     kind="timeout", reason="wedged",
+                                     seconds=60.0)
+    with pytest.raises(RuntimeError, match="probe already timed out"):
+        mesh._devices_with_deadline()
+
+
+def test_mesh_skips_watchdog_on_safe_probe(fresh, monkeypatch):
+    """A known-safe probe kind (pinned/no-tpu/ok) proves jax.devices()
+    returns promptly, so mesh init skips the watchdog thread entirely."""
+    import threading
+
+    from autocycler_tpu.parallel import mesh
+
+    distance = fresh
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    distance._tpu_attached()
+    assert distance.device_probe_report()["kind"] == "pinned"
+    spawned = []
+    real_thread = threading.Thread
+
+    class CountingThread(real_thread):
+        def __init__(self, *a, **kw):
+            spawned.append(kw.get("name"))
+            super().__init__(*a, **kw)
+
+    monkeypatch.setattr(threading, "Thread", CountingThread)
+    devices = mesh._devices_with_deadline()
+    assert len(devices) >= 1
+    assert "mesh-init" not in spawned
+
+
+def test_bench_guard_floor_and_trend_probe_fields():
+    """Pure bench helpers: the device floor fires only on kind=='ok', and
+    trend rows tolerate artifacts with and without probe_overlap."""
+    import importlib
+    import sys
+    from pathlib import Path
+
+    root = str(Path(__file__).resolve().parents[1])
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    bench = importlib.import_module("bench")
+
+    baseline = {"device_fraction_floor": 0.2}
+    low = {"device_fraction": 0.05}
+    assert bench.guard_device_floor(baseline, low, "ok")
+    assert not bench.guard_device_floor(baseline, low, "timeout")
+    assert not bench.guard_device_floor(baseline, low, None)
+    assert not bench.guard_device_floor(
+        baseline, {"device_fraction": 0.5}, "ok")
+
+    rows = bench.trend_rows([
+        {"round": 7, "path": "BENCH_r07.json", "parsed": {
+            "median_s": 5.0, "device_probe": {"kind": "ok"},
+            "probe_overlap": {"overlap_saved_s": 12.5}}},
+        {"round": 1, "path": "BENCH_r01.json", "parsed": {"value": 9.0}},
+    ])
+    assert rows[0]["probe_kind"] == "ok"
+    assert rows[0]["probe_overlap_saved_s"] == 12.5
+    assert rows[1]["probe_overlap_saved_s"] is None

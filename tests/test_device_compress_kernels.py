@@ -1,0 +1,215 @@
+"""Parity suite for the device-resident compress hot path: the fused
+grouping sort+stats kernel, the adjacency segment-op kernel and the
+chain-following pointer-doubling kernel must be bit-identical to their
+numpy oracles (jit runs under the conftest's JAX_PLATFORMS=cpu pin), and
+an end-to-end compress with the device grouping forced must write a
+byte-identical unitig GFA to the host run — on random AND adversarial
+inputs.
+"""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+
+# ---- adjacency ----
+
+def _adjacency_case(seed, U=5000, G=3000):
+    rng = np.random.default_rng(seed)
+    prefix = rng.integers(0, G, size=U).astype(np.int64)
+    suffix = rng.integers(0, G, size=U).astype(np.int64)
+    return prefix, suffix, G
+
+
+def _adjacency_adversarial():
+    """(name, prefix, suffix, G): one shared gram (G=1 — every k-mer is
+    everyone's neighbour), the full gram range in ascending/descending
+    order (exercises the scatter-max last-write-wins equivalence), and a
+    single k-mer."""
+    U = 700
+    ones = np.zeros(U, np.int64)
+    cases = [("all_same_gram", ones, ones.copy(), 1)]
+    asc = np.arange(U, dtype=np.int64)
+    cases.append(("full_range_asc_desc", asc, asc[::-1].copy(), U))
+    cases.append(("single_kmer", np.zeros(1, np.int64),
+                  np.zeros(1, np.int64), 1))
+    dup = np.repeat(np.arange(7, dtype=np.int64), 100)
+    cases.append(("heavy_duplicates", dup, dup[::-1].copy(), 7))
+    return cases
+
+
+def test_adjacency_device_matches_numpy(capsys):
+    from autocycler_tpu.ops.kmers import _adjacency
+
+    cases = [(f"random{seed}", *_adjacency_case(seed)) for seed in (0, 1)]
+    cases += _adjacency_adversarial()
+    for name, prefix, suffix, G in cases:
+        exp = _adjacency(prefix, suffix, G, workers=1, use_jax=False)
+        got = _adjacency(prefix, suffix, G, workers=1, use_jax=True)
+        assert "falling back" not in capsys.readouterr().err, name
+        for e, g, what in zip(exp, got, ("out_count", "in_count", "succ")):
+            assert e.dtype == g.dtype, (name, what)
+            assert (e == g).all(), (name, what)
+
+
+def test_adjacency_device_counts_device_time():
+    from autocycler_tpu.ops.kmers import _adjacency
+    from autocycler_tpu.utils import timing
+
+    prefix, suffix, G = _adjacency_case(2)
+    before = timing.device_seconds()
+    _adjacency(prefix, suffix, G, workers=1, use_jax=True)
+    assert timing.device_seconds() > before
+
+
+# ---- chain following ----
+
+def _chain_cases():
+    """next arrays that are functional AND injective (the _chains_numpy
+    precondition): random partial permutations, one pure cycle, isolated
+    nodes, one long path, 2-cycles and self-loops."""
+    cases = []
+    for seed in (0, 1, 2):
+        rng = np.random.default_rng(seed)
+        U = 5000
+        perm = rng.permutation(U)
+        nxt = np.full(U, -1, np.int64)
+        mask = rng.random(U) < 0.7
+        nxt[mask] = perm[mask]
+        cases.append((f"random{seed}", nxt))
+    cases.append(("one_cycle", np.roll(np.arange(17), -1).astype(np.int64)))
+    cases.append(("isolated", np.full(100, -1, np.int64)))
+    path = np.append(np.arange(1, 101), -1).astype(np.int64)
+    cases.append(("path", path))
+    cases.append(("two_cycles", (np.arange(50) ^ 1).astype(np.int64)))
+    cases.append(("self_loops", np.arange(10, dtype=np.int64)))
+    return cases
+
+
+def test_chains_device_matches_numpy():
+    from autocycler_tpu.ops.debruijn import _chains_device, _chains_numpy
+
+    for name, nxt in _chain_cases():
+        em, eo, ec = _chains_numpy(nxt.copy())
+        dm, do, dc = _chains_device(nxt.copy())
+        assert (em == dm).all(), (name, "members")
+        assert (eo == do).all(), (name, "chain_off")
+        assert (ec == dc).all(), (name, "chain_is_cycle")
+
+
+def test_build_chains_device_mode_matches_host(tmp_path, monkeypatch):
+    """build_chains with the device mode forced equals the host walk on a
+    real KmerIndex (members/offsets/cycle flags and the mirror-pair
+    emission downstream of them)."""
+    import sys
+    from pathlib import Path
+    tests_dir = str(Path(__file__).resolve().parent)
+    if tests_dir not in sys.path:
+        sys.path.insert(0, tests_dir)
+    from synthetic import make_assemblies_fast
+
+    from autocycler_tpu.commands.compress import load_sequences
+    from autocycler_tpu.metrics import InputAssemblyMetrics
+    from autocycler_tpu.ops.debruijn import build_chains
+    from autocycler_tpu.ops.kmers import build_kmer_index
+
+    asm = make_assemblies_fast(tmp_path, n_assemblies=2,
+                               chromosome_len=20_000, plasmid_len=2_000,
+                               n_snps=4)
+    sequences, _ = load_sequences(asm, 51, InputAssemblyMetrics(), 25, 1)
+    index = build_kmer_index(sequences, 51, use_jax=False, threads=1)
+    host = build_chains(index, use_jax=False)
+    monkeypatch.setenv("AUTOCYCLER_RADIX_MIN_WINDOWS", "0")
+    dev = build_chains(index, use_jax="radix")
+    assert (host.members == dev.members).all()
+    assert (host.chain_off == dev.chain_off).all()
+    assert (host.is_cycle == dev.is_cycle).all()
+
+
+# ---- fused grouping sort+stats ----
+
+def _case(seed, n_codes=3000, n_windows=2500, k=21):
+    rng = np.random.default_rng(seed)
+    codes = rng.integers(0, 5, size=n_codes).astype(np.uint8)
+    starts = rng.integers(0, n_codes - k, size=n_windows).astype(np.int64)
+    return codes, starts, k
+
+
+def test_device_rank_stats_matches_host(monkeypatch, capsys):
+    """The fused per-bucket sort+stats kernel (order, gid, depth,
+    first_occ) against the host radix statistics, random + adversarial."""
+    from autocycler_tpu.ops.kmers import (_radix_rank_stats_device,
+                                          group_windows_stats)
+
+    k9 = 9
+    adversarial = [
+        ("all_same", np.full(500, 3, np.uint8),
+         np.arange(492, dtype=np.int64), k9),
+        ("tiny_n", *_case(3, n_codes=200, n_windows=11, k=5)),
+    ]
+    cases = [("random", *_case(20)), ("random_threads", *_case(21))]
+    cases += adversarial
+    for name, codes, starts, k in cases:
+        monkeypatch.setenv("AUTOCYCLER_HOST_GROUPING", "numpy")
+        exp = group_windows_stats(codes, starts, k, use_jax=False, threads=1)
+        monkeypatch.delenv("AUTOCYCLER_HOST_GROUPING", raising=False)
+        threads = 2 if name == "random_threads" else 1
+        got = _radix_rank_stats_device(codes, starts, k, threads=threads)
+        assert "falling back" not in capsys.readouterr().err, name
+        for e, g, what in zip(exp, got, ("gid", "order", "depth", "first")):
+            assert (np.asarray(e) == np.asarray(g)).all(), (name, what)
+
+
+def test_group_windows_stats_device_mode(monkeypatch, capsys):
+    """use_jax='radix' routes group_windows_stats through the device
+    kernel (no fallback note) and matches the host result."""
+    from autocycler_tpu.ops.kmers import group_windows_stats
+
+    codes, starts, k = _case(22)
+    monkeypatch.setenv("AUTOCYCLER_HOST_GROUPING", "numpy")
+    exp = group_windows_stats(codes, starts, k, use_jax=False, threads=1)
+    monkeypatch.delenv("AUTOCYCLER_HOST_GROUPING", raising=False)
+    got = group_windows_stats(codes, starts, k, use_jax="radix", threads=1)
+    assert "falling back" not in capsys.readouterr().err
+    for e, g in zip(exp, got):
+        assert (np.asarray(e) == np.asarray(g)).all()
+
+
+# ---- end-to-end byte identity + device accounting ----
+
+@pytest.mark.slow
+def test_compress_device_grouping_gfa_byte_identical(tmp_path, monkeypatch):
+    """compress with the device grouping forced (AUTOCYCLER_DEVICE_GROUPING
+    =radix, pad floors dropped so the tiny input engages it) writes a
+    byte-identical input_assemblies.gfa to the host run, and actually
+    accumulates device seconds."""
+    import sys
+    from pathlib import Path
+    tests_dir = str(Path(__file__).resolve().parent)
+    if tests_dir not in sys.path:
+        sys.path.insert(0, tests_dir)
+    from synthetic import make_assemblies_fast
+
+    from autocycler_tpu.commands.compress import compress
+    from autocycler_tpu.utils import timing
+
+    gfas = {}
+    for mode in ("host", "device"):
+        tmp = tmp_path / mode
+        tmp.mkdir()
+        asm = make_assemblies_fast(tmp, n_assemblies=2,
+                                   chromosome_len=30_000, plasmid_len=3_000,
+                                   n_snps=5)
+        if mode == "device":
+            monkeypatch.setenv("AUTOCYCLER_DEVICE_GROUPING", "radix")
+            monkeypatch.setenv("AUTOCYCLER_RADIX_MIN_WINDOWS", "0")
+            before = timing.device_seconds()
+        compress(asm, tmp / "out", threads=1)
+        if mode == "device":
+            assert timing.device_seconds() > before, \
+                "device grouping must accumulate device seconds"
+            monkeypatch.delenv("AUTOCYCLER_DEVICE_GROUPING", raising=False)
+            monkeypatch.delenv("AUTOCYCLER_RADIX_MIN_WINDOWS", raising=False)
+        gfas[mode] = (tmp / "out" / "input_assemblies.gfa").read_bytes()
+    assert gfas["host"] == gfas["device"]

@@ -31,7 +31,7 @@ def _clean_sentinel():
 def test_gather_schema(tmp_path):
     report = doctor.gather(str(tmp_path))
     for key in ("env", "probe_state", "negative_cache", "probe_log",
-                "actions"):
+                "async_probe", "actions"):
         assert key in report, key
     assert "jax_platforms" in report["env"]
     assert "kind" in report["probe_state"]
@@ -136,7 +136,7 @@ def test_doctor_json_stdout_is_one_report(tmp_path, capsys):
     assert rc == 0
     report = json.loads(capsys.readouterr().out)
     assert set(report) == {"env", "probe_state", "negative_cache",
-                           "probe_log", "actions"}
+                           "probe_log", "async_probe", "actions"}
 
 
 def test_doctor_text_render(tmp_path, capsys):

@@ -227,9 +227,15 @@ def test_mesh_init_deadline(monkeypatch, capsys):
 
     import pytest
 
+    from autocycler_tpu.ops import distance
     from autocycler_tpu.parallel import mesh as mesh_mod
 
     monkeypatch.setenv("AUTOCYCLER_MESH_INIT_TIMEOUT", "0.1")
+    # a resolved safe probe (e.g. the pinned-CPU short-circuit) makes mesh
+    # init skip the watchdog entirely; report it unresolved so the deadline
+    # path is actually exercised
+    monkeypatch.setattr(distance, "device_probe_report",
+                        lambda: {"attached": None})
 
     real_thread = threading.Thread
 
