@@ -48,12 +48,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-t", "--threads", type=int, default=8)
 
     p = sub.add_parser("clean",
-                       help="manual manipulation of the final consensus assembly graph")
-    p.add_argument("-i", "--in_gfa", required=True)
-    p.add_argument("-o", "--out_gfa", required=True)
+                       help="manual manipulation of the final consensus assembly graph "
+                            "(and warm-start cache purging with --cache)")
+    p.add_argument("-i", "--in_gfa")
+    p.add_argument("-o", "--out_gfa")
     p.add_argument("-r", "--remove")
     p.add_argument("-d", "--duplicate")
     p.add_argument("-m", "--min_depth", type=float)
+    p.add_argument("--cache", metavar="DIR",
+                   help="purge the warm-start cache under DIR (an "
+                        "autocycler dir or a .cache dir); may be used "
+                        "alone, without -i/-o")
 
     p = sub.add_parser("cluster",
                        help="cluster contigs in the unitig graph based on similarity")
@@ -153,6 +158,54 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-c", "--cluster_dir", required=True)
     p.add_argument("--verbose", action="store_true")
 
+    p = sub.add_parser("serve",
+                       help="assembly-as-a-service daemon: accept isolate "
+                            "jobs over a local HTTP endpoint with warm "
+                            "JIT/parse/repair caches, a bounded work queue "
+                            "and live /metrics + /healthz")
+    p.add_argument("-a", "--dir", dest="serve_dir", required=True,
+                   help="daemon root: job run dirs, the shared warm-start "
+                        "cache and serve_manifest.json live here")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="TCP bind address (default 127.0.0.1)")
+    p.add_argument("--port", type=int, default=8642,
+                   help="TCP port (default 8642; 0 picks a free port)")
+    p.add_argument("--socket", dest="socket_path",
+                   help="serve on a Unix domain socket at this path "
+                        "instead of TCP")
+    p.add_argument("--queue-size", dest="queue_size", type=int, default=16,
+                   help="bounded work queue capacity; submissions past it "
+                        "get HTTP 503 (default 16)")
+
+    p = sub.add_parser("submit",
+                       help="submit one isolate job to a running "
+                            "`autocycler serve` daemon")
+    p.add_argument("-i", "--assemblies_dir", required=True)
+    p.add_argument("-a", "--out_dir",
+                   help="assembly output directory (default: the job's "
+                        "run dir under the daemon root)")
+    p.add_argument("--server",
+                   help="daemon endpoint URL (default: discovery via "
+                        "--dir, AUTOCYCLER_SERVE, or localhost:8642)")
+    p.add_argument("--socket", dest="socket_path",
+                   help="daemon Unix socket path")
+    p.add_argument("-d", "--dir", dest="serve_dir",
+                   help="daemon root — reads its serve.json discovery file")
+    p.add_argument("--command", dest="job_command", default="compress",
+                   choices=["compress", "pipeline"],
+                   help="compress only, or the full per-isolate pipeline "
+                        "(cluster + trim + resolve + combine)")
+    p.add_argument("-k", "--kmer", type=int, default=51)
+    p.add_argument("--max_contigs", type=int, default=25)
+    p.add_argument("-t", "--threads", type=int, default=8)
+    p.add_argument("--wait", action="store_true",
+                   help="block until the job finishes; exit 1 on failure")
+    p.add_argument("--follow", action="store_true",
+                   help="follow the job's span stream live (implies "
+                        "--wait; renders `autocycler watch` frames)")
+    p.add_argument("--timeout", type=float,
+                   help="--wait/--follow: give up after this many seconds")
+
     p = sub.add_parser("subsample", help="subsample a long-read set")
     p.add_argument("-r", "--reads", required=True)
     p.add_argument("-o", "--out_dir", required=True)
@@ -208,7 +261,8 @@ def dispatch(args) -> int:
                      threads=args.threads)
     elif args.command == "clean":
         from .commands.clean import clean
-        clean(args.in_gfa, args.out_gfa, args.remove, args.duplicate, args.min_depth)
+        clean(args.in_gfa, args.out_gfa, args.remove, args.duplicate,
+              args.min_depth, cache=args.cache)
     elif args.command == "cluster":
         from .commands.cluster import cluster
         cluster(args.autocycler_dir, args.cutoff, args.min_assemblies,
@@ -245,6 +299,19 @@ def dispatch(args) -> int:
     elif args.command == "resolve":
         from .commands.resolve import resolve
         resolve(args.cluster_dir, args.verbose)
+    elif args.command == "serve":
+        from .serve.server import serve
+        return serve(args.serve_dir, host=args.host, port=args.port,
+                     socket_path=args.socket_path,
+                     queue_size=args.queue_size)
+    elif args.command == "submit":
+        from .serve.client import submit
+        return submit(args.assemblies_dir, server=args.server,
+                      socket_path=args.socket_path, serve_dir=args.serve_dir,
+                      command=args.job_command, out_dir=args.out_dir,
+                      kmer=args.kmer, max_contigs=args.max_contigs,
+                      threads=args.threads, wait=args.wait,
+                      follow=args.follow, timeout=args.timeout)
     elif args.command == "subsample":
         from .commands.subsample import subsample
         subsample(args.reads, args.out_dir, args.genome_size, args.count,
@@ -302,23 +369,26 @@ def main(argv=None) -> int:
     # `report` and `watch` read a previous/other run's telemetry — tracing
     # them would clutter (or clobber) the very artifacts they render.
     # `doctor` likewise only inspects state (and must stay side-effect-free
-    # on a wedged host).
-    owns_run = (args.command not in ("report", "doctor", "watch")
+    # on a wedged host). `serve` owns one trace run PER JOB (each job's run
+    # dir gets its own trace/QC/ledger), and `submit` is a thin client.
+    owns_run = (args.command not in ("report", "doctor", "watch", "serve",
+                                     "submit")
                 and trace.maybe_start_run(name=args.command))
     if owns_run:
         from .obs import ledger, qc
         qc.reset()
         ledger.reset()
-    if args.command not in ("report", "doctor", "watch"):
+    if args.command not in ("report", "doctor", "watch", "submit"):
         from .obs import sentinel
         sentinel.maybe_start_watcher()
         # Kick off the device probe on a background thread now, so its
         # (potentially slow) subprocess attach overlaps host-side load and
         # parse work. The first device-dispatch point blocks on the future
         # only for whatever time has not already elapsed. compress/batch
-        # start it themselves right after set_probe_cache_dir(), so the
-        # runner can adopt a persisted negative result from disk.
-        if args.command not in ("compress", "batch"):
+        # (and serve, at daemon start) start it themselves right after
+        # set_probe_cache_dir(), so the runner can adopt a persisted
+        # negative result from disk.
+        if args.command not in ("compress", "batch", "serve"):
             from .ops.distance import start_background_probe
             start_background_probe()
     try:

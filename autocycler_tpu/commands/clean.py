@@ -13,6 +13,7 @@ from typing import List, Optional
 from ..models import UnitigGraph
 from ..models.simplify import merge_linear_paths
 from ..utils import log, quit_with_error
+from ..utils.cache import purge_cache
 
 
 def parse_tig_numbers(tig_num_str: Optional[str]) -> List[int]:
@@ -28,8 +29,27 @@ def parse_tig_numbers(tig_num_str: Optional[str]) -> List[int]:
     return sorted(out)
 
 
+def clean_cache(cache_dir) -> None:
+    """`autocycler clean --cache <dir>`: purge the warm-start cache under
+    an autocycler dir (or a cache dir itself). A daemon's shared cache is
+    LRU-capped automatically; this is the manual full reset."""
+    if not os.path.isdir(cache_dir):
+        quit_with_error(f"directory does not exist: {cache_dir}")
+    removed, reclaimed = purge_cache(cache_dir)
+    log.message(f"Purged warm-start cache under {cache_dir}: "
+                f"{removed} entr{'y' if removed == 1 else 'ies'}, "
+                f"{reclaimed} bytes reclaimed")
+    log.message()
+
+
 def clean(in_gfa, out_gfa, remove: Optional[str] = None, duplicate: Optional[str] = None,
-          min_depth: Optional[float] = None) -> None:
+          min_depth: Optional[float] = None, cache: Optional[str] = None) -> None:
+    if cache is not None:
+        clean_cache(cache)
+        if in_gfa is None and out_gfa is None:
+            return
+    if in_gfa is None or out_gfa is None:
+        quit_with_error("clean requires -i and -o (or --cache DIR alone)")
     if not os.path.isfile(in_gfa):
         quit_with_error(f"file does not exist: {in_gfa}")
     log.section_header("Starting autocycler clean")

@@ -11,7 +11,11 @@ Two modes:
 - ``--once`` (the default): parse whatever the trace holds right now,
   render one frame, exit;
 - ``--follow``: poll the file (default every 2 s), re-render whenever new
-  spans land, and exit when the run's ``finish`` footer arrives.
+  spans land, and exit when the run's ``finish`` footer arrives. The run
+  dir (or its ``trace.jsonl``) not existing *yet* is not an error in this
+  mode: `autocycler submit --follow` starts watching a job's run dir
+  before the daemon has admitted the job, so the follower announces it is
+  waiting and keeps polling until the tracer creates the file.
 
 The follower is torn-line safe (it only consumes up to the last newline,
 exactly the boundary the tracer writes atomically under its lock) and
@@ -246,8 +250,21 @@ def watch(run_dir, follow: bool = False, interval: float = 2.0,
     follower = TraceFollower(trace_path)
     records: List[dict] = []
     polled = 0
+    announced_wait = False
     try:
         while True:
+            if not records and not trace_path.is_file():
+                # run dir not created yet (e.g. the job is still queued in a
+                # serve daemon) — wait for the tracer, don't error out
+                if not announced_wait:
+                    print(f"Waiting for {trace_path} to appear "
+                          "(run not started yet)...", flush=True)
+                    announced_wait = True
+                polled += 1
+                if cycles is not None and polled >= cycles:
+                    return 0
+                time.sleep(max(0.1, interval))
+                continue
             new = follower.poll()
             if new:
                 # a fresh run header means the file was rewritten — drop

@@ -1,0 +1,17 @@
+"""Assembly-as-a-service: the `autocycler serve` daemon and its client.
+
+A long-lived process amortizes every one-time cost the CLI pays per
+invocation — JAX init, JIT compiles, the parse/end-repair warm-start
+caches, the device probe, the shared worker pool — across a stream of
+isolate jobs submitted over a local HTTP endpoint (TCP loopback or a Unix
+domain socket). Modules:
+
+- :mod:`.protocol` — the job-spec / job-record wire format and validation;
+- :mod:`.scheduler` — the bounded work queue with per-job fault isolation
+  (``utils.resilience.RunManifest`` + quarantine) and per-job run dirs;
+- :mod:`.server` — the HTTP surface (``/jobs``, ``/metrics``, ``/healthz``,
+  per-job trace streaming) and the `autocycler serve` entry point;
+- :mod:`.client` — the thin `autocycler submit` client.
+"""
+
+from .protocol import DEFAULT_PORT, JobSpec, parse_job_spec  # noqa: F401

@@ -1,0 +1,115 @@
+"""Wire format of the serve daemon: job specs, job records, endpoints.
+
+Everything is plain JSON over HTTP so any client (curl, a workflow engine,
+`autocycler submit`) can drive the daemon. A *job spec* is what the client
+POSTs to ``/jobs``; a *job record* is what the daemon returns from
+``/jobs`` and ``/jobs/<id>`` — the spec plus lifecycle state, timestamps,
+and the paths of the run directory (trace/QC/ledger artifacts) and the
+assembly output directory.
+
+Validation mirrors the CLI flag checks (`cli.py` / `commands/compress.py`)
+so a spec the daemon accepts is exactly one the CLI would have accepted —
+a rejected spec costs an HTTP 400, never a quarantined job.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ..utils.resilience import InputError
+
+PROTOCOL_VERSION = 1
+DEFAULT_PORT = 8642
+
+# daemon discovery file written into the serve root so local clients
+# (`autocycler submit --dir <root>`) find the endpoint without flags
+SERVE_INFO_JSON = "serve.json"
+
+# job lifecycle: queued -> running -> done | failed. "failed" covers
+# quarantined jobs — the job is recorded and the daemon keeps serving.
+JOB_STATES = ("queued", "running", "done", "failed")
+
+# what a job runs: "compress" is the single-isolate unitig-graph build;
+# "pipeline" continues through cluster -> trim -> resolve -> combine,
+# mirroring one isolate of `autocycler batch`.
+JOB_COMMANDS = ("compress", "pipeline")
+
+
+@dataclass
+class JobSpec:
+    """One validated isolate job."""
+
+    assemblies_dir: str
+    command: str = "compress"
+    out_dir: Optional[str] = None     # default: <run_dir>/out
+    kmer: int = 51
+    max_contigs: int = 25
+    threads: int = 8
+    cutoff: float = 0.2               # pipeline only
+    min_assemblies: Optional[int] = None
+
+    def to_dict(self) -> dict:
+        return {
+            "assemblies_dir": self.assemblies_dir,
+            "command": self.command,
+            "out_dir": self.out_dir,
+            "kmer": self.kmer,
+            "max_contigs": self.max_contigs,
+            "threads": self.threads,
+            "cutoff": self.cutoff,
+            "min_assemblies": self.min_assemblies,
+        }
+
+
+def parse_job_spec(data) -> JobSpec:
+    """Validate a decoded JSON body into a :class:`JobSpec`; raises
+    :class:`InputError` with a client-renderable message on any problem
+    (the server maps it to HTTP 400)."""
+    if not isinstance(data, dict):
+        raise InputError("job spec must be a JSON object")
+    unknown = set(data) - {"assemblies_dir", "command", "out_dir", "kmer",
+                           "max_contigs", "threads", "cutoff",
+                           "min_assemblies"}
+    if unknown:
+        raise InputError(f"unknown job spec field(s): "
+                         f"{', '.join(sorted(unknown))}")
+    assemblies_dir = data.get("assemblies_dir")
+    if not assemblies_dir or not isinstance(assemblies_dir, str):
+        raise InputError("job spec requires a string 'assemblies_dir'")
+    command = data.get("command", "compress")
+    if command not in JOB_COMMANDS:
+        raise InputError(f"unknown job command {command!r} "
+                         f"(choose from {', '.join(JOB_COMMANDS)})")
+    out_dir = data.get("out_dir")
+    if out_dir is not None and not isinstance(out_dir, str):
+        raise InputError("'out_dir' must be a string when given")
+
+    def _int(name, default, lo, hi):
+        value = data.get(name, default)
+        if value is None:
+            return None
+        if isinstance(value, bool) or not isinstance(value, int):
+            raise InputError(f"'{name}' must be an integer")
+        if not lo <= value <= hi:
+            raise InputError(f"'{name}' must be between {lo} and {hi} "
+                             f"(inclusive)")
+        return value
+
+    kmer = _int("kmer", 51, 11, 501)
+    if kmer % 2 == 0:
+        raise InputError("'kmer' must be odd")
+    max_contigs = _int("max_contigs", 25, 1, 10000)
+    threads = _int("threads", 8, 1, 100)
+    cutoff = data.get("cutoff", 0.2)
+    if isinstance(cutoff, bool) or not isinstance(cutoff, (int, float)) \
+            or not 0.0 < float(cutoff) < 1.0:
+        raise InputError("'cutoff' must be a number between 0 and 1 "
+                         "(exclusive)")
+    min_assemblies = data.get("min_assemblies")
+    if min_assemblies is not None:
+        min_assemblies = _int("min_assemblies", None, 1, 10000)
+    return JobSpec(assemblies_dir=assemblies_dir, command=command,
+                   out_dir=out_dir, kmer=kmer, max_contigs=max_contigs,
+                   threads=threads, cutoff=float(cutoff),
+                   min_assemblies=min_assemblies)

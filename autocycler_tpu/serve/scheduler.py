@@ -1,0 +1,307 @@
+"""Bounded work queue with per-job fault isolation for `autocycler serve`.
+
+One scheduler owns the daemon's job table, a bounded FIFO queue and a
+worker thread. Each job runs the same code path the CLI runs — compress
+(optionally through the full cluster/trim/resolve/combine pipeline) — but
+inside a quarantine: an :class:`AutocyclerError` or OSError marks the job
+failed in the job table and the ``serve_manifest.json`` run manifest
+(:class:`utils.resilience.RunManifest`) and the worker moves on to the
+next job. One poisoned job never kills the process.
+
+Each job owns a run directory (``<root>/jobs/<id>/``) receiving the
+standard per-run artifacts — ``trace.jsonl``, ``qc_report.json``,
+``ledger.json`` — exactly what ``AUTOCYCLER_TRACE_DIR`` produces for a CLI
+run, so `autocycler watch` and `autocycler report` work unchanged on a
+daemon job. The span tracer, QC journal and ledger are process-wide
+one-run-at-a-time machinery, so job execution holds the scheduler's run
+lock: jobs are admitted concurrently (the bounded queue) but execute
+serially, which is also what the device and the shared worker pool want.
+
+The warm wins come for free from sharing the process: the JIT caches, the
+resolved device probe, the shared ``utils.pool`` executor and — because the
+daemon points ``utils.cache`` at one shared directory — the parse and
+end-repair caches all persist across jobs.
+"""
+
+from __future__ import annotations
+
+import gc
+import queue
+import threading
+import time
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from ..obs import ledger, metrics_registry, trace
+from ..obs import qc as obs_qc
+from ..utils import AutocyclerError, log
+from ..utils.resilience import RunManifest
+from .protocol import JobSpec
+
+MANIFEST_NAME = "serve_manifest.json"
+
+# registry metric names: the live /metrics endpoint and bench servesmoke
+# both read these
+JOBS_TOTAL = "autocycler_serve_jobs_total"
+SUBMITTED_TOTAL = "autocycler_serve_submitted_total"
+REJECTED_TOTAL = "autocycler_serve_rejected_total"
+QUEUE_DEPTH = "autocycler_serve_queue_depth"
+JOB_SECONDS = "autocycler_serve_job_seconds"
+
+
+class QueueFullError(AutocyclerError):
+    """The bounded work queue is at capacity — the server maps this to
+    HTTP 503 so clients can back off and retry."""
+
+
+class Job:
+    """One job's record: the spec plus lifecycle state and artifact paths."""
+
+    def __init__(self, job_id: str, spec: JobSpec, run_dir: Path,
+                 out_dir: Path):
+        self.id = job_id
+        self.spec = spec
+        self.run_dir = run_dir
+        self.out_dir = out_dir
+        self.state = "queued"
+        self.error: Optional[str] = None
+        self.submitted_epoch = time.time()
+        self.started_epoch: Optional[float] = None
+        self.finished_epoch: Optional[float] = None
+        self.wall_s: Optional[float] = None
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.id,
+            "state": self.state,
+            "spec": self.spec.to_dict(),
+            "run_dir": str(self.run_dir),
+            "out_dir": str(self.out_dir),
+            "error": self.error,
+            "submitted_epoch": round(self.submitted_epoch, 3),
+            "started_epoch": round(self.started_epoch, 3)
+            if self.started_epoch else None,
+            "finished_epoch": round(self.finished_epoch, 3)
+            if self.finished_epoch else None,
+            "wall_s": round(self.wall_s, 3) if self.wall_s is not None
+            else None,
+        }
+
+
+class Scheduler:
+    """The daemon's job table + bounded queue + worker thread."""
+
+    def __init__(self, root, capacity: int = 16):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.capacity = max(1, int(capacity))
+        self._queue: "queue.Queue[Job]" = queue.Queue(maxsize=self.capacity)
+        self._jobs: Dict[str, Job] = {}
+        self._lock = threading.Lock()
+        self._run_lock = threading.Lock()   # serializes trace/QC/ledger runs
+        self._next_id = 1
+        self._stop = threading.Event()
+        self._worker: Optional[threading.Thread] = None
+        self.manifest = RunManifest.load(self.root / MANIFEST_NAME)
+        # a previous daemon died mid-job: those entries can never complete
+        # now — record the interruption so `/jobs` history and the manifest
+        # agree (docs/failure-modes.md "daemon restart")
+        for name, entry in self.manifest.items.items():
+            if entry.get("status") == "running":
+                self.manifest.fail(name, "interrupted by daemon restart")
+            # resume the id sequence past every recorded job so a restarted
+            # daemon never reuses (and silently overwrites) a prior job id
+            try:
+                self._next_id = max(self._next_id,
+                                    int(name.rsplit("-", 1)[1]) + 1)
+            except (IndexError, ValueError):
+                pass
+
+    # ---- admission ----
+
+    def submit(self, spec: JobSpec) -> Job:
+        """Admit one job into the bounded queue; raises
+        :class:`QueueFullError` at capacity (never blocks the caller)."""
+        with self._lock:
+            job_id = f"job-{self._next_id:06d}"
+            self._next_id += 1
+            run_dir = self.root / "jobs" / job_id
+            out_dir = Path(spec.out_dir) if spec.out_dir \
+                else run_dir / "out"
+            job = Job(job_id, spec, run_dir, out_dir)
+            try:
+                self._queue.put_nowait(job)
+            except queue.Full:
+                metrics_registry.counter_inc(
+                    REJECTED_TOTAL, 1, help="jobs rejected at admission",
+                    reason="queue_full")
+                raise QueueFullError(
+                    f"work queue is full ({self.capacity} jobs); "
+                    "retry after a job completes") from None
+            self._jobs[job_id] = job
+        self.manifest.pending(job_id)
+        metrics_registry.counter_inc(
+            SUBMITTED_TOTAL, 1, help="jobs admitted into the work queue")
+        self._gauge_depth()
+        return job
+
+    def job(self, job_id: str) -> Optional[Job]:
+        with self._lock:
+            return self._jobs.get(job_id)
+
+    def jobs(self) -> List[Job]:
+        with self._lock:
+            return list(self._jobs.values())
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for job in self.jobs():
+            out[job.state] = out.get(job.state, 0) + 1
+        return out
+
+    def _gauge_depth(self) -> None:
+        metrics_registry.gauge_set(
+            QUEUE_DEPTH, self._queue.qsize(),
+            help="jobs waiting in the serve work queue")
+
+    # ---- worker ----
+
+    def start(self) -> None:
+        if self._worker is not None:
+            return
+        self._worker = threading.Thread(
+            target=self._worker_loop, name="autocycler-serve-worker",
+            daemon=True)
+        self._worker.start()
+
+    def shutdown(self, wait: bool = True, timeout: float = 30.0) -> None:
+        """Stop the worker after its current job; queued jobs stay recorded
+        as pending in the manifest (a restarted daemon reports them)."""
+        self._stop.set()
+        worker, self._worker = self._worker, None
+        if worker is not None and wait:
+            worker.join(timeout=timeout)
+
+    def idle(self) -> bool:
+        """True when the queue is drained and no job is running."""
+        return self._queue.empty() and not self._run_lock.locked()
+
+    def _worker_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                job = self._queue.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            self._gauge_depth()
+            try:
+                self.execute(job)
+            finally:
+                self._queue.task_done()
+
+    # ---- execution ----
+
+    def execute(self, job: Job) -> None:
+        """Run one job under quarantine, with its own trace/QC/ledger run.
+
+        Holding the run lock across the job keeps the process-wide run
+        machinery (one active trace run, the QC journal, the ledger tables)
+        exclusive to this job; the QC scope additionally labels every
+        gauge/journal entry with the job id so nothing cross-contaminates
+        the cumulative registry the /metrics endpoint exports."""
+        spec = job.spec
+        with self._run_lock:
+            job.state = "running"
+            job.started_epoch = time.time()
+            self.manifest.start(job.id)
+            log.message(f"serve: {job.id} started "
+                        f"({spec.command} {spec.assemblies_dir})")
+            t0 = time.perf_counter()
+            owns_run = False
+            try:
+                trace.start_run(job.run_dir, name=f"serve-{spec.command}")
+                owns_run = True
+            except (RuntimeError, OSError):
+                # a CLI-owned run is somehow active or the dir is
+                # unwritable — run the job untraced rather than refuse it
+                pass
+            if owns_run:
+                obs_qc.reset()
+                ledger.reset()
+            failure: Optional[BaseException] = None
+            unexpected = False
+            try:
+                with trace.span(f"job/{job.id}", cat="command",
+                                job=job.id, command=spec.command), \
+                        obs_qc.scope(job.id):
+                    self._run_spec(spec, job.out_dir)
+            except (AutocyclerError, OSError) as e:
+                failure = e
+            except Exception as e:  # noqa: BLE001 — a bug in one job's
+                # pipeline path must not take the worker (and every queued
+                # job behind it) down with it
+                failure, unexpected = e, True
+            finally:
+                job.wall_s = time.perf_counter() - t0
+                if owns_run:
+                    run_dir = trace.finish_run()
+                    if run_dir:
+                        obs_qc.write_qc_report(run_dir)
+                        ledger.write_ledger(
+                            run_dir, command=f"serve/{spec.command}")
+                # job graphs are reference-cyclic; a long-lived daemon must
+                # reclaim them eagerly or RSS grows by one graph per job
+                gc.collect()
+                # the terminal state flips only AFTER the run artifacts are
+                # flushed: a client that polls /jobs/<id> to done may read
+                # ledger.json immediately
+                job.finished_epoch = time.time()
+                if failure is None:
+                    job.state = "done"
+                    self.manifest.done(job.id)
+                else:
+                    self._quarantine(job, failure, unexpected=unexpected)
+                metrics_registry.counter_inc(
+                    JOBS_TOTAL, 1, help="jobs completed by the serve worker",
+                    state=job.state, command=spec.command)
+                metrics_registry.observe(
+                    JOB_SECONDS, job.wall_s,
+                    help="per-job wall seconds", command=spec.command)
+                log.message(f"serve: {job.id} {job.state} "
+                            f"({job.wall_s:.2f}s)")
+
+    def _quarantine(self, job: Job, error: BaseException,
+                    unexpected: bool = False) -> None:
+        job.state = "failed"
+        prefix = "unexpected error: " if unexpected else ""
+        job.error = f"{prefix}{type(error).__name__}: {error}" if unexpected \
+            else str(error)
+        self.manifest.fail(job.id, job.error)
+        log.message(f"WARNING: serve: {job.id} quarantined — {job.error}")
+        metrics_registry.counter_inc(
+            "autocycler_quarantined_items_total", 1,
+            help="per-item failures quarantined instead of aborting")
+
+    def _run_spec(self, spec: JobSpec, out_dir: Path) -> None:
+        """The job body: exactly the CLI code path, so outputs are
+        byte-identical to `autocycler compress` / the per-isolate slice of
+        `autocycler batch` by construction."""
+        from ..commands.compress import compress
+        compress(spec.assemblies_dir, out_dir, spec.kmer, spec.max_contigs,
+                 threads=spec.threads)
+        if spec.command != "pipeline":
+            return
+        from ..commands.cluster import cluster
+        cluster(out_dir, spec.cutoff, spec.min_assemblies, spec.max_contigs)
+        from ..commands.combine import combine
+        from ..commands.resolve import resolve
+        from ..commands.trim import trim
+        qc_pass = Path(out_dir) / "clustering" / "qc_pass"
+        cluster_dirs = sorted(d for d in qc_pass.iterdir() if d.is_dir()) \
+            if qc_pass.is_dir() else []
+        for cdir in cluster_dirs:
+            trimmed = trim(cdir, threads=spec.threads)
+            resolve(cdir, preloaded=trimmed)
+            del trimmed
+        finals = sorted(qc_pass.glob("cluster_*/5_final.gfa"))
+        if finals:
+            combine(out_dir, finals)

@@ -1,0 +1,308 @@
+"""The `autocycler serve` HTTP surface: a loopback daemon over the
+scheduler.
+
+Routes (all JSON unless noted):
+
+- ``POST /jobs``            submit a job spec -> 202 job record
+                            (400 invalid spec, 503 queue full)
+- ``GET  /jobs``            every job record this daemon has seen
+- ``GET  /jobs/<id>``       one job record (404 unknown)
+- ``GET  /jobs/<id>/trace`` raw ``trace.jsonl`` bytes from ``?offset=N``
+                            (``X-Autocycler-Trace-Offset`` header carries
+                            the next offset) — the span stream a remote
+                            follower polls; local followers can equally
+                            run `autocycler watch <run_dir>` on the path
+                            in the job record
+- ``GET  /metrics``         live Prometheus text exposition of the
+                            process-wide metrics registry
+- ``GET  /healthz``         daemon liveness + queue/job counts + probe
+- ``POST /shutdown``        graceful stop (finish the current job, exit)
+
+The daemon binds TCP loopback by default (``--host``/``--port``) or a Unix
+domain socket (``--socket``), and writes ``serve.json`` into its root so
+`autocycler submit --dir <root>` discovers the endpoint without flags.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from .. import __version__
+from ..obs import metrics_registry
+from ..utils import log
+from ..utils.resilience import InputError
+from .protocol import (DEFAULT_PORT, SERVE_INFO_JSON, parse_job_spec)
+from .scheduler import QueueFullError, Scheduler
+
+REQUESTS_TOTAL = "autocycler_serve_requests_total"
+
+
+class _UnixHTTPServer(ThreadingHTTPServer):
+    address_family = socket.AF_UNIX
+
+    def server_bind(self):
+        # a stale socket file from a dead daemon would fail the bind
+        with contextlib.suppress(OSError):
+            os.unlink(self.server_address)
+        self.socket.bind(self.server_address)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = f"autocycler-serve/{__version__}"
+
+    # the ThreadingHTTPServer subclass carries the scheduler + daemon state
+    @property
+    def state(self) -> "ServeHandle":
+        return self.server.serve_state  # type: ignore[attr-defined]
+
+    def log_message(self, format, *args):  # noqa: A002 — stdlib signature
+        pass                               # request logging goes via metrics
+
+    def address_string(self) -> str:
+        # AF_UNIX hands a str/bytes client_address; the default
+        # implementation indexes it like a (host, port) tuple
+        addr = self.client_address
+        return addr[0] if isinstance(addr, tuple) and addr else "unix"
+
+    # ---- plumbing ----
+
+    def _send_json(self, code: int, payload: dict, route: str) -> None:
+        body = (json.dumps(payload, indent=2, sort_keys=True) + "\n").encode()
+        self._send_bytes(code, body, "application/json", route)
+
+    def _send_bytes(self, code: int, body: bytes, ctype: str, route: str,
+                    headers: Optional[dict] = None) -> None:
+        metrics_registry.counter_inc(
+            REQUESTS_TOTAL, 1, help="serve HTTP requests",
+            route=route, code=str(code))
+        with contextlib.suppress(BrokenPipeError, ConnectionResetError,
+                                 OSError):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    def _read_json(self):
+        length = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(length) if length else b""
+        try:
+            return json.loads(raw.decode() or "null")
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            raise InputError(f"request body is not valid JSON: {e}")
+
+    # ---- routes ----
+
+    def do_GET(self):  # noqa: N802 — stdlib casing
+        parsed = urlparse(self.path)
+        parts = [p for p in parsed.path.split("/") if p]
+        if parsed.path == "/healthz":
+            return self._send_json(200, self.state.health(), "/healthz")
+        if parsed.path == "/metrics":
+            body = metrics_registry.to_prometheus().encode()
+            return self._send_bytes(200, body,
+                                    "text/plain; version=0.0.4", "/metrics")
+        if parts and parts[0] == "jobs":
+            if len(parts) == 1:
+                jobs = [j.to_dict() for j in self.state.scheduler.jobs()]
+                return self._send_json(200, {"jobs": jobs}, "/jobs")
+            job = self.state.scheduler.job(parts[1])
+            if job is None:
+                return self._send_json(
+                    404, {"error": f"unknown job {parts[1]!r}"}, "/jobs/<id>")
+            if len(parts) == 2:
+                return self._send_json(200, job.to_dict(), "/jobs/<id>")
+            if len(parts) == 3 and parts[2] == "trace":
+                return self._send_trace(job, parsed)
+        return self._send_json(404, {"error": f"no route {parsed.path!r}"},
+                               "unknown")
+
+    def _send_trace(self, job, parsed) -> None:
+        """Raw trace.jsonl bytes from ?offset=N — enough for a remote
+        TraceFollower; the next offset rides a response header so the
+        client never re-reads."""
+        query = parse_qs(parsed.query)
+        try:
+            offset = max(0, int(query.get("offset", ["0"])[0]))
+        except ValueError:
+            offset = 0
+        path = Path(job.run_dir) / "trace.jsonl"
+        chunk = b""
+        with contextlib.suppress(OSError):
+            with open(path, "rb") as f:
+                f.seek(offset)
+                chunk = f.read(1 << 20)
+        self._send_bytes(
+            200, chunk, "application/x-ndjson", "/jobs/<id>/trace",
+        )
+
+    def do_POST(self):  # noqa: N802
+        parsed = urlparse(self.path)
+        if parsed.path == "/jobs":
+            try:
+                spec = parse_job_spec(self._read_json())
+            except InputError as e:
+                metrics_registry.counter_inc(
+                    "autocycler_serve_rejected_total", 1,
+                    help="jobs rejected at admission", reason="bad_request")
+                return self._send_json(400, {"error": str(e)}, "/jobs")
+            try:
+                job = self.state.scheduler.submit(spec)
+            except QueueFullError as e:
+                return self._send_json(503, {"error": str(e)}, "/jobs")
+            return self._send_json(202, job.to_dict(), "/jobs")
+        if parsed.path == "/shutdown":
+            self._send_json(200, {"status": "shutting down"}, "/shutdown")
+            self.state.request_shutdown()
+            return
+        return self._send_json(404, {"error": f"no route {parsed.path!r}"},
+                               "unknown")
+
+
+class ServeHandle:
+    """A running daemon: the HTTP server thread + scheduler, stoppable.
+
+    `serve()` builds one and blocks on it; tests and `bench.py servesmoke`
+    build one in-process and drive it over real loopback HTTP."""
+
+    def __init__(self, root, host: str = "127.0.0.1",
+                 port: int = DEFAULT_PORT, socket_path=None,
+                 queue_size: int = 16):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.t0 = time.time()
+        self.scheduler = Scheduler(self.root, capacity=queue_size)
+        self.socket_path = str(socket_path) if socket_path else None
+        if self.socket_path:
+            self.server = _UnixHTTPServer(self.socket_path, _Handler)
+            self.endpoint = f"unix:{self.socket_path}"
+            self.host, self.port = None, None
+        else:
+            self.server = ThreadingHTTPServer((host, port), _Handler)
+            self.host, self.port = self.server.server_address[:2]
+            self.endpoint = f"http://{self.host}:{self.port}"
+        self.server.serve_state = self  # type: ignore[attr-defined]
+        self.server.daemon_threads = True
+        self._server_thread: Optional[threading.Thread] = None
+        self._shutdown_requested = threading.Event()
+
+    # ---- lifecycle ----
+
+    def start(self) -> "ServeHandle":
+        """Start the scheduler worker and the HTTP accept loop (on a
+        background thread) and write the discovery file."""
+        self.scheduler.start()
+        self._server_thread = threading.Thread(
+            target=self.server.serve_forever,
+            name="autocycler-serve-http", daemon=True)
+        self._server_thread.start()
+        self._write_info()
+        return self
+
+    def request_shutdown(self) -> None:
+        self._shutdown_requested.set()
+
+    def wait(self, poll_s: float = 0.2) -> None:
+        """Block until a shutdown is requested (POST /shutdown or signal)."""
+        while not self._shutdown_requested.wait(poll_s):
+            pass
+
+    def stop(self) -> None:
+        """Graceful stop: no new connections, finish the running job."""
+        self.server.shutdown()
+        self.server.server_close()
+        self.scheduler.shutdown(wait=True)
+        if self.socket_path:
+            with contextlib.suppress(OSError):
+                os.unlink(self.socket_path)
+        with contextlib.suppress(OSError):
+            (self.root / SERVE_INFO_JSON).unlink()
+
+    def _write_info(self) -> None:
+        info = {"pid": os.getpid(), "endpoint": self.endpoint,
+                "host": self.host, "port": self.port,
+                "socket": self.socket_path,
+                "started_epoch": round(self.t0, 3),
+                "version": __version__}
+        path = self.root / SERVE_INFO_JSON
+        tmp = path.with_suffix(".json.tmp")
+        with contextlib.suppress(OSError):
+            tmp.write_text(json.dumps(info, indent=2, sort_keys=True) + "\n")
+            os.replace(tmp, path)
+
+    # ---- health ----
+
+    def health(self) -> dict:
+        from ..ops.distance import probe_overlap_report
+        health = {
+            "status": "ok",
+            "version": __version__,
+            "pid": os.getpid(),
+            "uptime_s": round(time.time() - self.t0, 3),
+            "queue_capacity": self.scheduler.capacity,
+            "jobs": self.scheduler.counts(),
+            "idle": self.scheduler.idle(),
+        }
+        with contextlib.suppress(Exception):
+            health["probe"] = probe_overlap_report()
+        return health
+
+
+def serve(serve_dir, host: str = "127.0.0.1", port: int = DEFAULT_PORT,
+          socket_path=None, queue_size: int = 16) -> int:
+    """CLI entry for `autocycler serve`: warm the process once, then block
+    serving jobs until SIGINT/SIGTERM or POST /shutdown."""
+    root = Path(serve_dir)
+    root.mkdir(parents=True, exist_ok=True)
+
+    # one warm process: shared parse/repair cache dir, persistent compile
+    # cache, and the device probe resolved once in the background
+    from ..utils import cache as warm_cache
+    if warm_cache.shared_cache_dir() is None:
+        warm_cache.set_shared_cache_dir(root / ".cache")
+    from ..utils.jaxcache import configure_compile_cache
+    with contextlib.suppress(Exception):
+        configure_compile_cache()
+    from ..ops.distance import set_probe_cache_dir, start_background_probe
+    set_probe_cache_dir(root / ".cache")
+    start_background_probe()
+
+    handle = ServeHandle(root, host=host, port=port,
+                         socket_path=socket_path, queue_size=queue_size)
+    handle.start()
+
+    import signal
+
+    def _on_signal(signum, frame):
+        handle.request_shutdown()
+
+    with contextlib.suppress(ValueError):  # not the main thread
+        signal.signal(signal.SIGTERM, _on_signal)
+        signal.signal(signal.SIGINT, _on_signal)
+
+    log.section_header("autocycler serve")
+    log.explanation("The daemon keeps JAX, the JIT caches, the parse/repair caches, the "
+                    "device probe and the worker pool warm across jobs, so every "
+                    "request after the first skips the CLI's cold-start cost.")
+    log.message(f"listening on {handle.endpoint}")
+    log.message(f"serve root:   {root}")
+    log.message(f"work queue:   {queue_size} job(s)")
+    log.message(f"submit with:  autocycler submit -i <assemblies_dir> "
+                f"--dir {root}")
+    log.message()
+    try:
+        handle.wait()
+    except KeyboardInterrupt:
+        pass
+    log.message("serve: shutting down (finishing the current job)")
+    handle.stop()
+    return 0

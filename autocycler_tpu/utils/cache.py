@@ -19,6 +19,20 @@ the uncached path (the caller re-parses / re-repairs), and every payload
 re-derives the reverse strand from the forward bytes, so a cache hit is
 bit-identical to a cold run by construction. AUTOCYCLER_ENCODE_CACHE=0
 disables both.
+
+Two daemon-era additions:
+
+- a **shared cache directory** (:func:`set_shared_cache_dir` or
+  ``AUTOCYCLER_CACHE_DIR``): `autocycler serve` points every job's
+  :func:`open_cache` at one directory, so a repeat isolate hits the parse
+  and repair caches regardless of which output dir its job writes to.
+  Entries are content-addressed, so sharing is safe by construction.
+- a **byte-budget LRU** (``AUTOCYCLER_CACHE_MAX_BYTES``, default 4 GiB,
+  <= 0 disables): after every store the cache evicts least-recently-used
+  entries (hits bump mtime) until the directory fits the budget. Unbounded
+  growth was tolerable per-CLI-invocation; a daemon serving thousands of
+  isolates needs a cap. ``autocycler clean --cache <dir>`` purges a cache
+  outright.
 """
 
 from __future__ import annotations
@@ -28,6 +42,7 @@ import io
 import json
 import os
 import tempfile
+import threading
 from pathlib import Path
 from typing import List, Optional, Tuple
 
@@ -39,6 +54,45 @@ from ..obs import metrics_registry
 # (obs.metrics_registry), inspectable by tests, artifacts and
 # `autocycler report` alike
 CACHE_EVENTS = "autocycler_cache_events_total"
+CACHE_EVICTIONS = "autocycler_cache_evictions_total"
+CACHE_EVICTED_BYTES = "autocycler_cache_evicted_bytes_total"
+
+DEFAULT_MAX_BYTES = 4 << 30   # generous: per-entry payloads are megabytes
+
+_shared_dir_lock = threading.Lock()
+_shared_dir: Optional[Path] = None
+
+
+def set_shared_cache_dir(path) -> None:
+    """Point every subsequent :func:`open_cache` at one directory (None
+    restores per-autocycler-dir caches). The serve daemon sets this once at
+    startup so all jobs share warm-start entries."""
+    global _shared_dir
+    with _shared_dir_lock:
+        _shared_dir = None if path is None else Path(path)
+
+
+def shared_cache_dir() -> Optional[Path]:
+    """The active shared cache directory: the explicit setter wins, then
+    ``AUTOCYCLER_CACHE_DIR``, else None (per-dir caches)."""
+    with _shared_dir_lock:
+        if _shared_dir is not None:
+            return _shared_dir
+    env = os.environ.get("AUTOCYCLER_CACHE_DIR", "").strip()
+    return Path(env) if env else None
+
+
+def cache_max_bytes() -> Optional[int]:
+    """The eviction budget in bytes, or None when eviction is disabled
+    (``AUTOCYCLER_CACHE_MAX_BYTES`` <= 0 or unparsable)."""
+    raw = os.environ.get("AUTOCYCLER_CACHE_MAX_BYTES", "").strip()
+    if not raw:
+        return DEFAULT_MAX_BYTES
+    try:
+        budget = int(raw)
+    except ValueError:
+        return DEFAULT_MAX_BYTES
+    return budget if budget > 0 else None
 
 
 def cache_stats() -> dict:
@@ -99,6 +153,59 @@ class EncodeCache:
     def _repair_path(self, combined_hash: str, k: int) -> Path:
         return self.dir / f"repair-{combined_hash[:24]}-k{k}.npz"
 
+    # ---- byte-budget LRU ----
+
+    @staticmethod
+    def _touch(path: Path) -> None:
+        """Bump an entry's mtime on a hit — mtime order IS the LRU order
+        the evictor walks. Best-effort (a read-only cache still hits)."""
+        try:
+            os.utime(path)
+        except OSError:
+            pass
+
+    def enforce_budget(self, max_bytes: Optional[int] = None) -> int:
+        """Evict least-recently-used ``.npz`` entries until the directory
+        fits ``max_bytes`` (default: :func:`cache_max_bytes`). The newest
+        entry always survives — evicting what was just written would make
+        a tiny budget equivalent to disabling the cache. Returns the number
+        of entries evicted; never raises."""
+        if max_bytes is None:
+            max_bytes = cache_max_bytes()
+        if max_bytes is None:
+            return 0
+        try:
+            entries = []
+            for path in self.dir.glob("*.npz"):
+                st = path.stat()
+                entries.append((st.st_mtime, st.st_size, path))
+        except OSError:
+            return 0
+        total = sum(size for _, size, _ in entries)
+        if total <= max_bytes:
+            return 0
+        entries.sort()                      # oldest mtime first
+        evicted = 0
+        evicted_bytes = 0
+        for mtime, size, path in entries[:-1]:   # keep the newest entry
+            if total <= max_bytes:
+                break
+            try:
+                path.unlink()
+            except OSError:
+                continue
+            total -= size
+            evicted += 1
+            evicted_bytes += size
+        if evicted:
+            metrics_registry.counter_inc(
+                CACHE_EVICTIONS, evicted,
+                help="warm-start cache entries evicted by the byte budget")
+            metrics_registry.counter_inc(
+                CACHE_EVICTED_BYTES, evicted_bytes,
+                help="bytes reclaimed by warm-start cache eviction")
+        return evicted
+
     # ---- per-assembly parse cache ----
 
     def load_parsed(self, file_hash: str, k: int
@@ -118,6 +225,7 @@ class EncodeCache:
         for i, (header, length) in enumerate(meta):
             records.append((header, payload[offs[i]:offs[i + 1]], int(length)))
         _count("parse_hits")
+        self._touch(path)
         return records
 
     def store_parsed(self, file_hash: str, k: int,
@@ -134,6 +242,7 @@ class EncodeCache:
             np.savez(buf, payload=payload, offs=offs,
                      meta=np.frombuffer(meta, np.uint8))
             _atomic_write(self._parse_path(file_hash, k), buf.getvalue())
+            self.enforce_budget()
         except Exception:  # noqa: BLE001 — cache writes never fail the run
             pass
 
@@ -154,6 +263,7 @@ class EncodeCache:
             _count("repair_misses")
             return None
         _count("repair_hits")
+        self._touch(path)
         return ends
 
     def store_repair_ends(self, combined_hash: str, k: int,
@@ -163,12 +273,46 @@ class EncodeCache:
             buf = io.BytesIO()
             np.savez(buf, ends=ends)
             _atomic_write(self._repair_path(combined_hash, k), buf.getvalue())
+            self.enforce_budget()
         except Exception:  # noqa: BLE001
             pass
 
 
 def open_cache(autocycler_dir) -> Optional[EncodeCache]:
-    """The autocycler dir's encode cache, or None when disabled."""
-    if autocycler_dir is None or not cache_enabled():
+    """The encode cache for ``autocycler_dir``, or None when disabled.
+    A shared cache directory (:func:`set_shared_cache_dir` /
+    ``AUTOCYCLER_CACHE_DIR``) overrides the per-dir location — the serve
+    daemon's cross-job warm path."""
+    if not cache_enabled():
+        return None
+    shared = shared_cache_dir()
+    if shared is not None:
+        return EncodeCache(shared)
+    if autocycler_dir is None:
         return None
     return EncodeCache(Path(autocycler_dir) / ".cache")
+
+
+def purge_cache(target) -> Tuple[int, int]:
+    """Delete every entry of a warm-start cache: ``target`` may be an
+    autocycler dir (its ``.cache`` subdirectory is purged) or a cache
+    directory itself. Returns (files removed, bytes reclaimed); missing
+    directories purge nothing. Only cache artifact files are touched —
+    the directory and anything unrecognised stay."""
+    target = Path(target)
+    cache_dir = target / ".cache" if (target / ".cache").is_dir() \
+        else target
+    removed = 0
+    reclaimed = 0
+    if not cache_dir.is_dir():
+        return 0, 0
+    for pattern in ("*.npz", "*.npz.tmp*"):
+        for path in cache_dir.glob(pattern):
+            try:
+                size = path.stat().st_size
+                path.unlink()
+            except OSError:
+                continue
+            removed += 1
+            reclaimed += size
+    return removed, reclaimed

@@ -764,6 +764,75 @@ def bench_faultsmoke() -> None:
         sys.exit(1)
 
 
+def bench_servesmoke() -> None:
+    """Smoke the assembly-as-a-service path: start an in-process serve
+    daemon, submit the same tiny isolate twice over real loopback HTTP, and
+    check that (a) both jobs finish, (b) the warm second job beats the cold
+    first (shared parse/repair caches + JIT already compiled), and (c) the
+    daemon's outputs are byte-identical to a fresh CLI-path compress run
+    with caches disabled. One JSON line on stdout; exit 1 on failure."""
+    import contextlib
+    import os
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent / "tests"))
+    from synthetic import make_assemblies
+
+    from autocycler_tpu.commands.compress import compress as run_compress
+    from autocycler_tpu.serve.client import request_json, wait_for_job
+    from autocycler_tpu.serve.server import ServeHandle
+    from autocycler_tpu.utils import cache as warm_cache
+
+    tmp = Path(tempfile.mkdtemp(prefix="autocycler_servesmoke_"))
+    asm = make_assemblies(tmp, n_assemblies=3, chromosome_len=30_000,
+                          plasmid_len=2_000, n_snps=10)
+    root = tmp / "serve"
+    warm_cache.set_shared_cache_dir(root / ".cache")
+    handle = ServeHandle(root, port=0).start()
+    spec = {"assemblies_dir": str(asm), "command": "compress",
+            "kmer": 51, "threads": 2}
+    devnull = open(os.devnull, "w")
+    try:
+        with contextlib.redirect_stderr(devnull):
+            records = []
+            for _ in range(2):
+                status, record = request_json(handle.endpoint, "POST",
+                                              "/jobs", body=spec)
+                assert status == 202, (status, record)
+                records.append(wait_for_job(handle.endpoint, record["id"],
+                                            poll_s=0.1, timeout=600))
+            # the reference run: same code path, caches off, fresh dir —
+            # the byte-identity oracle for the daemon's warm path
+            os.environ["AUTOCYCLER_ENCODE_CACHE"] = "0"
+            try:
+                run_compress(asm, tmp / "ref", 51, 25, threads=2)
+            finally:
+                os.environ.pop("AUTOCYCLER_ENCODE_CACHE", None)
+    finally:
+        with contextlib.redirect_stderr(devnull):
+            handle.stop()
+        warm_cache.set_shared_cache_dir(None)
+        devnull.close()
+
+    cold, warm = (r["wall_s"] for r in records)
+    states = [r["state"] for r in records]
+    identical = all(
+        (Path(records[1]["out_dir"]) / name).read_bytes()
+        == (tmp / "ref" / name).read_bytes()
+        for name in ("input_assemblies.gfa", "input_assemblies.yaml"))
+    passed = states == ["done", "done"] and warm < cold and identical
+    print(json.dumps({
+        "bench": "servesmoke",
+        "passed": passed,
+        "states": states,
+        "cold_s": round(cold, 3),
+        "warm_s": round(warm, 3),
+        "warm_speedup": round(cold / warm, 2) if warm else None,
+        "byte_identical": identical,
+    }))
+    if not passed:
+        sys.exit(1)
+
+
 GUARD_BASELINE_PATH = Path(__file__).resolve().parent / "BENCH_GUARD.json"
 GUARD_TOLERANCE = 1.25
 
@@ -1191,6 +1260,8 @@ def main() -> None:
         bench_grouping(float(sys.argv[2]) if len(sys.argv) > 2 else 147.0)
     elif len(sys.argv) > 1 and sys.argv[1] == "faultsmoke":
         bench_faultsmoke()
+    elif len(sys.argv) > 1 and sys.argv[1] == "servesmoke":
+        bench_servesmoke()
     elif len(sys.argv) > 1 and sys.argv[1] == "guard":
         bench_guard(sys.argv[2:])
     elif len(sys.argv) > 1 and sys.argv[1] == "trend":

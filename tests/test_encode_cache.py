@@ -94,3 +94,143 @@ def test_compile_cache_knob(tmp_path, monkeypatch):
     # idempotent on repeat calls
     assert jaxcache.configure_compile_cache() is True
     jaxcache._reset_for_tests()
+
+# ---- byte-budget LRU + purge (the daemon-era additions) ----
+
+
+def _store_entry(cache, tag, mtime):
+    """One parse entry with a controlled mtime (mtime order IS LRU order)."""
+    import numpy as np
+    fwd = np.frombuffer(b"." * 50 + b"ACGT" * 250, np.uint8)
+    cache.store_parsed(tag * 16, 51, [("c", fwd, 1000)])
+    path = cache._parse_path(tag * 16, 51)
+    os.utime(path, (mtime, mtime))
+    return path
+
+
+@pytest.mark.perf
+def test_budget_evicts_lru_keeps_newest(tmp_path):
+    from autocycler_tpu.utils.cache import EncodeCache
+
+    cache = EncodeCache(tmp_path / ".cache")
+    old = _store_entry(cache, "a", 1_000)
+    mid = _store_entry(cache, "b", 2_000)
+    new = _store_entry(cache, "c", 3_000)
+    size = new.stat().st_size
+
+    # budget fits one entry: the two oldest go, the newest survives
+    assert cache.enforce_budget(max_bytes=size) == 2
+    assert not old.exists() and not mid.exists() and new.exists()
+    # already under budget: no-op
+    assert cache.enforce_budget(max_bytes=size) == 0
+
+    # even a budget smaller than one entry keeps the newest (a tiny budget
+    # must degrade to "cache of one", not "no cache")
+    assert cache.enforce_budget(max_bytes=1) == 0
+    assert new.exists()
+
+
+@pytest.mark.perf
+def test_budget_hit_refreshes_lru_rank(tmp_path):
+    """A cache hit bumps the entry's mtime, so the evictor removes the
+    *unused* entry, not the recently-hit older one."""
+    from autocycler_tpu.utils.cache import EncodeCache
+
+    cache = EncodeCache(tmp_path / ".cache")
+    hot = _store_entry(cache, "a", 1_000)   # oldest by store order...
+    cold = _store_entry(cache, "b", 2_000)
+    assert cache.load_parsed("a" * 16, 51) is not None  # ...but just hit
+    assert hot.stat().st_mtime > cold.stat().st_mtime
+    assert cache.enforce_budget(max_bytes=hot.stat().st_size) == 1
+    assert hot.exists() and not cold.exists()
+
+
+@pytest.mark.perf
+def test_cache_max_bytes_env(monkeypatch):
+    from autocycler_tpu.utils.cache import DEFAULT_MAX_BYTES, cache_max_bytes
+
+    monkeypatch.delenv("AUTOCYCLER_CACHE_MAX_BYTES", raising=False)
+    assert cache_max_bytes() == DEFAULT_MAX_BYTES
+    monkeypatch.setenv("AUTOCYCLER_CACHE_MAX_BYTES", "12345")
+    assert cache_max_bytes() == 12345
+    monkeypatch.setenv("AUTOCYCLER_CACHE_MAX_BYTES", "0")
+    assert cache_max_bytes() is None          # <= 0 disables eviction
+    monkeypatch.setenv("AUTOCYCLER_CACHE_MAX_BYTES", "-1")
+    assert cache_max_bytes() is None
+    monkeypatch.setenv("AUTOCYCLER_CACHE_MAX_BYTES", "junk")
+    assert cache_max_bytes() == DEFAULT_MAX_BYTES
+
+
+@pytest.mark.perf
+def test_store_enforces_budget(tmp_path, monkeypatch):
+    """The budget is enforced on the write path itself — a long-lived
+    daemon never needs a sweeper."""
+    from autocycler_tpu.utils.cache import EncodeCache
+
+    cache = EncodeCache(tmp_path / ".cache")
+    first = _store_entry(cache, "a", 1_000)
+    monkeypatch.setenv("AUTOCYCLER_CACHE_MAX_BYTES",
+                       str(first.stat().st_size))
+    second = _store_entry(cache, "b", 2_000)
+    assert not first.exists() and second.exists()
+
+
+@pytest.mark.perf
+def test_purge_cache_and_clean_cli(tmp_path, capsys):
+    """`autocycler clean --cache <dir>` purges entries (autocycler dir or
+    cache dir itself), leaves foreign files alone, and errors on a missing
+    directory."""
+    from autocycler_tpu.commands.clean import clean
+    from autocycler_tpu.utils import AutocyclerError
+    from autocycler_tpu.utils.cache import EncodeCache, purge_cache
+
+    autodir = tmp_path / "auto"
+    cache = EncodeCache(autodir / ".cache")
+    _store_entry(cache, "a", 1_000)
+    _store_entry(cache, "b", 2_000)
+    keep = autodir / ".cache" / "notes.txt"
+    keep.write_text("mine")
+
+    removed, reclaimed = purge_cache(autodir)     # resolves the .cache subdir
+    assert removed == 2 and reclaimed > 0
+    assert keep.exists()
+    assert purge_cache(autodir) == (0, 0)         # idempotent
+    assert purge_cache(tmp_path / "missing") == (0, 0)
+
+    _store_entry(cache, "c", 3_000)
+    clean(None, None, cache=str(autodir))         # --cache alone is a run
+    assert list((autodir / ".cache").glob("*.npz")) == []
+    assert "Purged warm-start cache" in capsys.readouterr().err
+
+    with pytest.raises(AutocyclerError, match="does not exist"):
+        clean(None, None, cache=str(tmp_path / "missing"))
+    with pytest.raises(AutocyclerError, match="requires -i and -o"):
+        clean(None, str(tmp_path / "out.gfa"))
+
+
+@pytest.mark.perf
+def test_shared_cache_dir_override(tmp_path, monkeypatch):
+    """set_shared_cache_dir (the serve daemon) and AUTOCYCLER_CACHE_DIR
+    both redirect open_cache away from the per-dir .cache; the setter
+    outranks the env; None restores per-dir behaviour."""
+    from autocycler_tpu.utils.cache import (open_cache, set_shared_cache_dir,
+                                            shared_cache_dir)
+
+    monkeypatch.delenv("AUTOCYCLER_CACHE_DIR", raising=False)
+    assert shared_cache_dir() is None
+    assert open_cache(tmp_path / "job1").dir == tmp_path / "job1" / ".cache"
+
+    try:
+        set_shared_cache_dir(tmp_path / "shared")
+        assert open_cache(tmp_path / "job1").dir == tmp_path / "shared"
+        assert open_cache(tmp_path / "job2").dir == tmp_path / "shared"
+        assert open_cache(None).dir == tmp_path / "shared"
+        monkeypatch.setenv("AUTOCYCLER_CACHE_DIR", str(tmp_path / "env"))
+        assert open_cache(None).dir == tmp_path / "shared"  # setter wins
+        set_shared_cache_dir(None)
+        assert open_cache(None).dir == tmp_path / "env"     # env takes over
+        # disabling the cache outranks any shared dir
+        monkeypatch.setenv("AUTOCYCLER_ENCODE_CACHE", "0")
+        assert open_cache(tmp_path / "job1") is None
+    finally:
+        set_shared_cache_dir(None)

@@ -1,0 +1,324 @@
+"""The `autocycler serve` daemon over real loopback HTTP.
+
+The acceptance path: one in-process daemon serves two sequential jobs for
+the same isolate — the second job's parse/repair caches hit (asserted via
+the per-job ledgers' cache lineage deltas) and its outputs are
+byte-identical to a fresh CLI compress run with caches disabled — then a
+deliberately-faulted third job is quarantined (HTTP record + run manifest)
+while the daemon keeps serving.
+
+All tests drive a ServeHandle bound to an ephemeral port (or a Unix
+socket) — the same object `serve()` blocks on — so the full HTTP stack,
+scheduler worker thread, quarantine and artifact plumbing are exercised
+without a subprocess.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from synthetic import make_assemblies
+
+pytestmark = pytest.mark.serve
+
+
+def _wait_until(predicate, timeout=30.0, interval=0.05):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def serve_handle(tmp_path):
+    """A running daemon on an ephemeral loopback port, with the shared
+    warm-start cache dir pointed at its root (what `serve()` does)."""
+    from autocycler_tpu.serve.server import ServeHandle
+    from autocycler_tpu.utils import cache as warm_cache
+
+    root = tmp_path / "serve"
+    warm_cache.set_shared_cache_dir(root / ".cache")
+    handle = ServeHandle(root, port=0).start()
+    try:
+        yield handle
+    finally:
+        handle.stop()
+        warm_cache.set_shared_cache_dir(None)
+
+
+def _request(endpoint, method, path, body=None):
+    from autocycler_tpu.serve.client import request_json
+    return request_json(endpoint, method, path, body=body)
+
+
+def _wait_job(endpoint, job_id, timeout=120.0):
+    from autocycler_tpu.serve.client import wait_for_job
+    return wait_for_job(endpoint, job_id, poll_s=0.05, timeout=timeout)
+
+
+# ---------------------------------------------------------------- protocol
+
+
+def test_job_spec_validation():
+    from autocycler_tpu.serve.protocol import JobSpec, parse_job_spec
+    from autocycler_tpu.utils.resilience import InputError
+
+    spec = parse_job_spec({"assemblies_dir": "/x"})
+    assert isinstance(spec, JobSpec)
+    assert spec.command == "compress" and spec.kmer == 51
+
+    # round trip: a spec's own dict re-validates
+    assert parse_job_spec(spec.to_dict()) == spec
+
+    for bad in (
+        None,                                         # not an object
+        {},                                           # no assemblies_dir
+        {"assemblies_dir": 3},                        # wrong type
+        {"assemblies_dir": "/x", "bogus": 1},         # unknown field
+        {"assemblies_dir": "/x", "command": "zap"},   # unknown command
+        {"assemblies_dir": "/x", "kmer": 50},         # even k
+        {"assemblies_dir": "/x", "kmer": 9},          # k too small
+        {"assemblies_dir": "/x", "threads": 0},       # bad threads
+        {"assemblies_dir": "/x", "threads": True},    # bool is not an int
+        {"assemblies_dir": "/x", "cutoff": 1.5},      # cutoff out of range
+    ):
+        with pytest.raises(InputError):
+            parse_job_spec(bad)
+
+
+# ------------------------------------------------------- the acceptance e2e
+
+
+def test_serve_two_jobs_warm_cache_then_quarantine(serve_handle, tmp_path,
+                                                   monkeypatch, capsys):
+    """The ISSUE acceptance path, in one daemon lifetime."""
+    from autocycler_tpu.commands.compress import compress
+    from autocycler_tpu.serve.scheduler import MANIFEST_NAME
+
+    make_assemblies(tmp_path)
+    asm = tmp_path / "assemblies"
+    endpoint = serve_handle.endpoint
+    spec = {"assemblies_dir": str(asm), "command": "compress", "kmer": 51,
+            "threads": 2}
+
+    # --- two sequential jobs for the same isolate ---
+    status, rec1 = _request(endpoint, "POST", "/jobs", body=spec)
+    assert status == 202 and rec1["state"] in ("queued", "running")
+    rec1 = _wait_job(endpoint, rec1["id"])
+    status, rec2 = _request(endpoint, "POST", "/jobs", body=spec)
+    assert status == 202
+    rec2 = _wait_job(endpoint, rec2["id"])
+    assert rec1["state"] == "done" and rec2["state"] == "done"
+
+    # each job owns a full artifact set in its run dir
+    from pathlib import Path
+    run1, run2 = Path(rec1["run_dir"]), Path(rec2["run_dir"])
+    for run in (run1, run2):
+        for artifact in ("trace.jsonl", "qc_report.json", "ledger.json"):
+            assert (run / artifact).is_file(), (run, artifact)
+
+    # cache lineage: the ledgers record CUMULATIVE process-wide counters,
+    # so job2's warm hits are the delta between the two ledgers
+    led1 = json.loads((run1 / "ledger.json").read_text())["caches"]
+    led2 = json.loads((run2 / "ledger.json").read_text())["caches"]
+    assert led2["parse"]["hits"] - led1["parse"]["hits"] == 4
+    assert led2["parse"]["misses"] == led1["parse"]["misses"]
+    assert led2["repair"]["hits"] - led1["repair"]["hits"] == 1
+    assert led2["repair"]["misses"] == led1["repair"]["misses"]
+
+    # warm and cold jobs produce identical QC verdicts (timestamps and job
+    # ids aside, the journal is a pure function of the inputs)
+    qc1 = json.loads((run1 / "qc_report.json").read_text())["entries"]
+    qc2 = json.loads((run2 / "qc_report.json").read_text())["entries"]
+    strip = lambda es: [{k: v for k, v in e.items()
+                         if k not in ("ts_epoch", "isolate")} for e in es]
+    assert strip(qc1) == strip(qc2)
+    assert any(e["stage"] == "compress" for e in qc1)
+
+    # byte-identity oracle: a fresh CLI-path run with caches disabled
+    monkeypatch.setenv("AUTOCYCLER_ENCODE_CACHE", "0")
+    compress(str(asm), str(tmp_path / "ref"), k_size=51, threads=2)
+    monkeypatch.delenv("AUTOCYCLER_ENCODE_CACHE")
+    for name in ("input_assemblies.gfa", "input_assemblies.yaml"):
+        daemon_bytes = (Path(rec2["out_dir"]) / name).read_bytes()
+        assert daemon_bytes == (tmp_path / "ref" / name).read_bytes(), name
+
+    # --- a poisoned third job is quarantined, the daemon keeps serving ---
+    status, rec3 = _request(
+        endpoint, "POST", "/jobs",
+        body={"assemblies_dir": str(tmp_path / "no_such_dir")})
+    assert status == 202
+    rec3 = _wait_job(endpoint, rec3["id"])
+    assert rec3["state"] == "failed"
+    assert "does not exist" in rec3["error"]
+
+    manifest = json.loads(
+        (serve_handle.root / MANIFEST_NAME).read_text())["items"]
+    assert manifest[rec1["id"]]["status"] == "done"
+    assert manifest[rec2["id"]]["status"] == "done"
+    assert manifest[rec3["id"]]["status"] == "failed"
+    assert "does not exist" in manifest[rec3["id"]]["error"]
+
+    # still alive and honest about what happened
+    status, health = _request(endpoint, "GET", "/healthz")
+    assert status == 200 and health["status"] == "ok"
+    assert health["jobs"] == {"done": 2, "failed": 1}
+    status, listing = _request(endpoint, "GET", "/jobs")
+    assert status == 200 and len(listing["jobs"]) == 3
+
+    # /metrics exports the job lifecycle live, Prometheus text format
+    status, metrics = _request(endpoint, "GET", "/metrics")
+    assert status == 200
+    text = metrics["raw"]
+    assert 'autocycler_serve_jobs_total{command="compress",state="done"}' \
+        in text
+    assert 'autocycler_serve_jobs_total{command="compress",state="failed"}' \
+        in text
+    assert "autocycler_serve_job_seconds" in text
+    assert "autocycler_serve_requests_total" in text
+
+    # the trace endpoint streams the job's span records
+    status, trace = _request(endpoint, "GET", f"/jobs/{rec1['id']}/trace")
+    assert status == 200
+    lines = [json.loads(l) for l in trace["raw"].splitlines() if l.strip()]
+    assert any(r.get("type") == "run" for r in lines)
+    assert any(r.get("type") == "span" and r["name"] == f"job/{rec1['id']}"
+               for r in lines)
+    capsys.readouterr()
+
+
+# ------------------------------------------------------------ HTTP edges
+
+
+def test_http_error_codes(serve_handle):
+    endpoint = serve_handle.endpoint
+    status, body = _request(endpoint, "GET", "/jobs/job-999999")
+    assert status == 404 and "unknown job" in body["error"]
+    status, body = _request(endpoint, "GET", "/no/such/route")
+    assert status == 404
+    status, body = _request(endpoint, "POST", "/jobs",
+                            body={"assemblies_dir": "/x", "kmer": 50})
+    assert status == 400 and "odd" in body["error"]
+    status, body = _request(endpoint, "POST", "/jobs", body={"zap": 1})
+    assert status == 400
+
+
+def test_queue_full_returns_503(tmp_path, capsys):
+    """With capacity 1 and a worker stuck on job 1, the queue holds job 2
+    and job 3 bounces with 503 — admission never blocks the HTTP thread."""
+    from autocycler_tpu.serve.server import ServeHandle
+
+    gate = threading.Event()
+    handle = ServeHandle(tmp_path / "serve", port=0, queue_size=1)
+    handle.scheduler._run_spec = lambda spec, out_dir: gate.wait(30)
+    handle.start()
+    try:
+        specs = {"assemblies_dir": str(tmp_path)}
+        status, rec1 = _request(handle.endpoint, "POST", "/jobs", body=specs)
+        assert status == 202
+        # wait until the worker has dequeued job 1 (it is now stuck on the
+        # gate), so job 2 occupies the whole queue
+        assert _wait_until(
+            lambda: _request(handle.endpoint, "GET",
+                             f"/jobs/{rec1['id']}")[1]["state"] == "running")
+        status, _ = _request(handle.endpoint, "POST", "/jobs", body=specs)
+        assert status == 202
+        status, body = _request(handle.endpoint, "POST", "/jobs", body=specs)
+        assert status == 503 and "full" in body["error"]
+        gate.set()
+        assert _wait_until(lambda: handle.scheduler.idle())
+    finally:
+        gate.set()
+        handle.stop()
+    capsys.readouterr()
+
+
+def test_unix_socket_and_discovery(tmp_path, capsys):
+    """The daemon serves over an AF_UNIX socket, and `submit` resolves the
+    endpoint from the serve.json discovery file."""
+    from autocycler_tpu.serve.client import resolve_endpoint
+    from autocycler_tpu.serve.protocol import SERVE_INFO_JSON
+    from autocycler_tpu.serve.server import ServeHandle
+
+    sock = tmp_path / "d.sock"
+    handle = ServeHandle(tmp_path / "serve", socket_path=sock).start()
+    try:
+        assert handle.endpoint == f"unix:{sock}"
+        status, health = _request(handle.endpoint, "GET", "/healthz")
+        assert status == 200 and health["status"] == "ok"
+        # discovery: --dir reads serve.json
+        assert (handle.root / SERVE_INFO_JSON).is_file()
+        assert resolve_endpoint(serve_dir=handle.root) == handle.endpoint
+        # explicit flags outrank discovery
+        assert resolve_endpoint(server="http://10.0.0.1:1") \
+            == "http://10.0.0.1:1"
+        assert resolve_endpoint(socket_path="/s") == "unix:/s"
+    finally:
+        handle.stop()
+    assert not sock.exists()            # graceful stop unlinks the socket
+    capsys.readouterr()
+
+
+def test_submit_client_roundtrip(serve_handle, tmp_path, capsys):
+    """The `autocycler submit --wait` client path end to end: 0 for a done
+    job, 1 for a quarantined one."""
+    from autocycler_tpu.serve.client import submit
+
+    make_assemblies(tmp_path, n_assemblies=3, chromosome_len=2000,
+                    plasmid_len=500)
+    rc = submit(tmp_path / "assemblies", server=serve_handle.endpoint,
+                threads=2, wait=True, poll_s=0.05, timeout=120)
+    assert rc == 0
+    rc = submit(tmp_path / "no_such", server=serve_handle.endpoint,
+                wait=True, poll_s=0.05, timeout=120)
+    assert rc == 1
+    capsys.readouterr()
+
+
+def test_daemon_restart_marks_interrupted_jobs(tmp_path):
+    """A manifest entry still 'running' when a new scheduler loads it (the
+    previous daemon died mid-job) is marked failed/interrupted — the
+    restart/resume contract in docs/failure-modes.md."""
+    from autocycler_tpu.serve.protocol import JobSpec
+    from autocycler_tpu.serve.scheduler import MANIFEST_NAME, Scheduler
+    from autocycler_tpu.utils.resilience import RunManifest
+
+    root = tmp_path / "serve"
+    root.mkdir()
+    manifest = RunManifest.load(root / MANIFEST_NAME)
+    manifest.pending("job-000001")
+    manifest.start("job-000001")
+    manifest.pending("job-000002")
+    manifest.done("job-000002")
+
+    scheduler = Scheduler(root)
+    items = json.loads((root / MANIFEST_NAME).read_text())["items"]
+    assert items["job-000001"]["status"] == "failed"
+    assert "restart" in items["job-000001"]["error"]
+    assert items["job-000002"]["status"] == "done"
+    assert scheduler.manifest.items["job-000001"]["status"] == "failed"
+
+    # the id sequence resumes past recorded jobs — a restarted daemon never
+    # reuses (and overwrites) a previous generation's job id or run dir
+    job = scheduler.submit(JobSpec(assemblies_dir="/x"))
+    assert job.id == "job-000003"
+
+
+def test_watch_follow_waits_for_run_dir(tmp_path, capsys):
+    """`autocycler watch --follow` on a run dir that does not exist yet
+    announces it is waiting and polls instead of erroring — the `submit
+    --follow` race where the job has not been admitted yet. ``--once`` on
+    the same dir stays an error."""
+    from autocycler_tpu.obs.watch import watch
+
+    missing = tmp_path / "jobs" / "job-000042"
+    assert watch(missing, follow=True, interval=0.05, cycles=3) == 0
+    out = capsys.readouterr()
+    assert "Waiting for" in out.out
+    assert watch(missing, follow=False) == 1
+    err = capsys.readouterr()
+    assert "nothing to watch" in err.err

@@ -1,0 +1,185 @@
+"""Concurrency safety of the process-wide observability state the serve
+daemon leans on: overlapping jobs must not cross-contaminate the metrics
+registry (job-id labels keep series distinct under concurrent writers) or
+the QC journal's thread-local isolate scope.
+
+The daemon executes jobs serially under its run lock, but its HTTP threads
+render /metrics while the worker writes, and nothing stops a future
+multi-worker scheduler — these tests pin the contracts that make either
+safe.
+"""
+
+import threading
+
+import pytest
+
+from autocycler_tpu.obs import qc
+from autocycler_tpu.obs.metrics_registry import MetricsRegistry
+
+pytestmark = [pytest.mark.serve, pytest.mark.obs]
+
+N_THREADS = 8
+N_ITER = 500
+
+
+def _run_threads(target, n=N_THREADS):
+    """Run ``target(i)`` on n threads behind a start barrier; re-raises the
+    first worker exception so assertion failures inside threads fail the
+    test instead of vanishing."""
+    barrier = threading.Barrier(n)
+    errors = []
+
+    def wrap(i):
+        barrier.wait()
+        try:
+            target(i)
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=wrap, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+
+
+def test_counter_series_isolated_per_job_label():
+    """Concurrent writers with distinct job labels: every series lands on
+    exactly its own total — no lost updates, no cross-talk."""
+    reg = MetricsRegistry()
+
+    def work(i):
+        for _ in range(N_ITER):
+            reg.counter_inc("autocycler_serve_jobs_total", 1,
+                            job=f"job-{i:06d}")
+
+    _run_threads(work)
+    for i in range(N_THREADS):
+        assert reg.value("autocycler_serve_jobs_total",
+                         job=f"job-{i:06d}") == N_ITER
+
+
+def test_gauge_last_write_stays_per_label():
+    """Overlapping jobs setting the same gauge under different labels keep
+    independent values; an unlabelled series is yet another series."""
+    reg = MetricsRegistry()
+
+    def work(i):
+        for v in range(N_ITER):
+            reg.gauge_set("autocycler_qc_compress_unitigs", v,
+                          isolate=f"job-{i:06d}")
+        reg.gauge_set("autocycler_qc_compress_unitigs", i,
+                      isolate=f"job-{i:06d}")
+
+    _run_threads(work)
+    for i in range(N_THREADS):
+        assert reg.value("autocycler_qc_compress_unitigs",
+                         isolate=f"job-{i:06d}") == i
+    assert reg.value("autocycler_qc_compress_unitigs") == 0.0
+
+
+def test_histogram_concurrent_observe():
+    reg = MetricsRegistry()
+
+    def work(i):
+        for _ in range(N_ITER):
+            reg.observe("autocycler_serve_job_seconds", 0.5,
+                        command="compress")
+
+    _run_threads(work)
+    state = reg._metrics["autocycler_serve_job_seconds"].series[
+        (("command", "compress"),)]
+    assert state["count"] == N_THREADS * N_ITER
+    assert state["sum"] == pytest.approx(0.5 * N_THREADS * N_ITER)
+
+
+def test_to_prometheus_while_writing():
+    """The /metrics render path: exposition stays parseable (and never
+    raises) while writers mutate the registry underneath it."""
+    reg = MetricsRegistry()
+    stop = threading.Event()
+    errors = []
+
+    def writer(i):
+        n = 0
+        while not stop.is_set():
+            reg.counter_inc("autocycler_serve_requests_total", 1,
+                            route="/jobs", code="202", job=f"j{i}")
+            n += 1
+            if n >= N_ITER:
+                break
+
+    def reader():
+        try:
+            while not stop.is_set():
+                text = reg.to_prometheus()
+                for line in text.splitlines():
+                    assert line.startswith(("#", "autocycler_")), line
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    r = threading.Thread(target=reader)
+    r.start()
+    _run_threads(writer, n=4)
+    stop.set()
+    r.join()
+    assert not errors
+    total = sum(reg.labeled("autocycler_serve_requests_total",
+                            "job").values())
+    assert total == 4 * N_ITER
+
+
+def test_qc_scope_is_thread_local():
+    """Overlapping jobs' QC scopes: each thread's records carry its own
+    job id, never a neighbour's, and the registry gauges keyed by isolate
+    stay per-job."""
+    qc.reset()
+    from autocycler_tpu.obs import metrics_registry
+
+    reg = metrics_registry.registry()
+    base = {f"job-{i:06d}": reg.value("autocycler_qc_stress_value",
+                                      isolate=f"job-{i:06d}")
+            for i in range(N_THREADS)}
+
+    def work(i):
+        job = f"job-{i:06d}"
+        with qc.scope(job):
+            assert qc.current_scope() == job
+            for k in range(50):
+                qc.record("stress", value=i * 1000 + k)
+            assert qc.current_scope() == job
+        assert qc.current_scope() is None
+
+    try:
+        _run_threads(work)
+        by_iso = {}
+        for entry in qc.entries():
+            if entry["stage"] != "stress":
+                continue
+            by_iso.setdefault(entry["isolate"], []).append(
+                entry["metrics"]["value"])
+        assert set(by_iso) == {f"job-{i:06d}" for i in range(N_THREADS)}
+        for iso, values in by_iso.items():
+            i = int(iso.split("-")[1])
+            assert sorted(values) == [i * 1000 + k for k in range(50)], iso
+        # the last gauge write per isolate is that isolate's own value
+        for i in range(N_THREADS):
+            got = reg.value("autocycler_qc_stress_value",
+                            isolate=f"job-{i:06d}")
+            assert got == i * 1000 + 49, (i, got, base)
+    finally:
+        qc.reset()
+
+
+def test_nested_scope_restores_outer():
+    qc.reset()
+    try:
+        with qc.scope("job-000001"):
+            with qc.scope("job-000001/cluster_001"):
+                assert qc.current_scope() == "job-000001/cluster_001"
+            assert qc.current_scope() == "job-000001"
+        assert qc.current_scope() is None
+    finally:
+        qc.reset()
