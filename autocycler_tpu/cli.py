@@ -240,6 +240,25 @@ def build_parser() -> argparse.ArgumentParser:
                    help="--follow: stop after this many polls even if the "
                         "run has not finished")
 
+    p = sub.add_parser("top",
+                       help="live fleet dashboard: queue depth and "
+                            "throughput sparklines, latency quantiles with "
+                            "the SLO verdict, cache hit-rate and memory — "
+                            "aggregated from a serve root's (or run dir's) "
+                            "timeseries.jsonl and manifests")
+    p.add_argument("dir", nargs="?", default=".",
+                   help="serve root or run directory (default: cwd)")
+    p.add_argument("--follow", action="store_true",
+                   help="keep re-rendering every --interval seconds "
+                        "(default: render once and exit)")
+    p.add_argument("--once", action="store_true",
+                   help="render one frame and exit (the default; "
+                        "overrides --follow)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="--follow refresh interval in seconds (default 2)")
+    p.add_argument("--cycles", type=int,
+                   help="--follow: stop after this many frames")
+
     p = sub.add_parser("trim", help="trim contigs in a cluster")
     p.add_argument("-c", "--cluster_dir", required=True)
     p.add_argument("--min_identity", type=float, default=0.75)
@@ -323,6 +342,10 @@ def dispatch(args) -> int:
         from .commands.trim import trim
         trim(args.cluster_dir, args.min_identity, args.max_unitigs, args.mad,
              args.threads)
+    elif args.command == "top":
+        from .obs.top import top
+        return top(args.dir, follow=args.follow and not args.once,
+                   interval=args.interval, cycles=args.cycles)
     elif args.command == "watch":
         from .obs.watch import watch
         return watch(args.run_dir, follow=args.follow and not args.once,
@@ -366,19 +389,34 @@ def main(argv=None) -> int:
         import gc
         gc.disable()
     from .obs import trace
-    # `report` and `watch` read a previous/other run's telemetry — tracing
-    # them would clutter (or clobber) the very artifacts they render.
-    # `doctor` likewise only inspects state (and must stay side-effect-free
-    # on a wedged host). `serve` owns one trace run PER JOB (each job's run
-    # dir gets its own trace/QC/ledger), and `submit` is a thin client.
-    owns_run = (args.command not in ("report", "doctor", "watch", "serve",
-                                     "submit")
-                and trace.maybe_start_run(name=args.command))
+    # `report`, `watch` and `top` read a previous/other run's telemetry —
+    # tracing them would clutter (or clobber) the very artifacts they
+    # render. `doctor` likewise only inspects state (and must stay
+    # side-effect-free on a wedged host). `serve` owns one trace run PER
+    # JOB (each job's run dir gets its own trace/QC/ledger), and `submit`
+    # is a thin client.
+    may_own_run = args.command not in ("report", "doctor", "watch", "top",
+                                       "serve", "submit")
+    # continuous telemetry rides the same run dir as the trace: one
+    # background thread, one timeseries.jsonl next to trace.jsonl. The
+    # sampler starts BEFORE the run clock and stops AFTER it closes, so
+    # thread spawn/join never shows up as untraced wall time inside the
+    # run (the stage-tree/wall agreement must hold on millisecond runs).
+    sampler = None
+    trace_target = os.environ.get("AUTOCYCLER_TRACE_DIR", "").strip()
+    if may_own_run and trace_target:
+        from .obs import timeseries
+        if timeseries.timeseries_enabled():
+            sampler = timeseries.TimeseriesSampler(trace_target).start()
+    owns_run = may_own_run and trace.maybe_start_run(name=args.command)
+    if not owns_run and sampler is not None:
+        sampler.stop(final_sample=False)   # another run is already active
+        sampler = None
     if owns_run:
         from .obs import ledger, qc
         qc.reset()
         ledger.reset()
-    if args.command not in ("report", "doctor", "watch", "submit"):
+    if args.command not in ("report", "doctor", "watch", "top", "submit"):
         from .obs import sentinel
         sentinel.maybe_start_watcher()
         # Kick off the device probe on a background thread now, so its
@@ -405,6 +443,8 @@ def main(argv=None) -> int:
                 from .obs import ledger, qc
                 qc.write_qc_report(run_dir)
                 ledger.write_ledger(run_dir, command=args.command)
+        if sampler is not None:
+            sampler.stop()   # outside the run wall; takes the final tick
         metrics_path = os.environ.get("AUTOCYCLER_METRICS")
         if metrics_path:
             trace.write_metrics_file(metrics_path)

@@ -12,6 +12,7 @@ from typing import List, Optional
 
 from ..models import UnitigGraph
 from ..models.simplify import merge_linear_paths
+from ..obs.timeseries import purge_timeseries
 from ..utils import log, quit_with_error
 from ..utils.cache import purge_cache
 
@@ -31,14 +32,21 @@ def parse_tig_numbers(tig_num_str: Optional[str]) -> List[int]:
 
 def clean_cache(cache_dir) -> None:
     """`autocycler clean --cache <dir>`: purge the warm-start cache under
-    an autocycler dir (or a cache dir itself). A daemon's shared cache is
-    LRU-capped automatically; this is the manual full reset."""
+    an autocycler dir (or a cache dir itself), plus any rotated
+    continuous-telemetry series (``timeseries.jsonl`` at the root and
+    under serve job dirs). A daemon's shared cache is LRU-capped
+    automatically; this is the manual full reset."""
     if not os.path.isdir(cache_dir):
         quit_with_error(f"directory does not exist: {cache_dir}")
     removed, reclaimed = purge_cache(cache_dir)
     log.message(f"Purged warm-start cache under {cache_dir}: "
                 f"{removed} entr{'y' if removed == 1 else 'ies'}, "
                 f"{reclaimed} bytes reclaimed")
+    ts_removed, ts_reclaimed = purge_timeseries(cache_dir)
+    if ts_removed:
+        log.message(f"Purged telemetry series under {cache_dir}: "
+                    f"{ts_removed} file{'' if ts_removed == 1 else 's'}, "
+                    f"{ts_reclaimed} bytes reclaimed")
     log.message()
 
 

@@ -12,10 +12,12 @@ the *assembly* look like, and where did every artifact come from?":
 ``watch`` follows another process's run live. See docs/observability.md.
 """
 
-from . import ledger, metrics_registry, qc, sentinel, trace, watch
+from . import ledger, metrics_registry, qc, sentinel, timeseries, trace, watch
 from .memory import memory_sample
 from .metrics_registry import (MetricsRegistry, counter_inc, gauge_set,
-                               info_set, observe, registry, snapshot,
-                               to_prometheus)
+                               info_set, observe, quantile, registry,
+                               snapshot, to_prometheus)
+from .timeseries import (TimeseriesSampler, read_timeseries,
+                         summarize_timeseries)
 from .trace import (current_span, finish_run, maybe_start_run, span,
                     start_run, tracing_active)

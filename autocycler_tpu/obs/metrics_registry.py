@@ -29,6 +29,14 @@ from typing import Dict, List, Optional, Tuple
 DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
                    30.0, 60.0, 300.0)
 
+# seconds-to-minutes preset for job/stage latency histograms: the default
+# buckets are device-dispatch-oriented (sub-millisecond resolution wasted on
+# a 20 s cold job), so serve job walls, queue waits and stage latencies use
+# this coarser ladder — resolution where SLO objectives actually live
+SECONDS_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 3.0, 5.0, 7.5, 10.0,
+                   15.0, 20.0, 30.0, 45.0, 60.0, 120.0, 300.0, 600.0,
+                   1200.0)
+
 _LabelKey = Tuple[Tuple[str, str], ...]
 
 
@@ -157,6 +165,40 @@ class MetricsRegistry:
                         out[v] = val
         return out
 
+    def quantile(self, name: str, q: float, **labels) -> Optional[float]:
+        """Streaming quantile estimate from one histogram series: walk the
+        cumulative bucket counts to the bucket containing the ``q``-th
+        observation and interpolate linearly inside it. No raw samples are
+        stored, so the estimate's error is bounded by the bucket width; the
+        result is clamped to the recorded [min, max] so it always brackets
+        what was actually observed (the overflow bucket in particular has
+        no finite upper edge without the clamp). Returns None when the
+        series does not exist or is empty."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None or m.kind != "histogram":
+                return None
+            state = m.series.get(_label_key(labels))
+            if not isinstance(state, dict) or not state["count"]:
+                return None
+            edges = list(state["buckets"])
+            counts = list(state["counts"])
+            lo, hi = state["min"], state["max"]
+            count = state["count"]
+        target = q * count
+        cum = 0.0
+        prev_edge = 0.0
+        for edge, c in zip(edges + [hi], counts):
+            if c and cum + c >= target:
+                frac = (target - cum) / c
+                est = prev_edge + frac * (max(edge, prev_edge) - prev_edge)
+                return min(max(est, lo), hi)
+            cum += c
+            prev_edge = edge
+        return hi
+
     def snapshot(self) -> dict:
         """JSON-able {metric name: {"type", "help", "values": [...]}} where
         each value entry carries its labels dict and value (histograms: the
@@ -252,6 +294,10 @@ def info_set(name: str, text: str, help: str = "", **labels) -> None:
 def observe(name: str, value: float, help: str = "",
             buckets: Optional[Tuple[float, ...]] = None, **labels) -> None:
     _registry.observe(name, value, help=help, buckets=buckets, **labels)
+
+
+def quantile(name: str, q: float, **labels) -> Optional[float]:
+    return _registry.quantile(name, q, **labels)
 
 
 def snapshot() -> dict:

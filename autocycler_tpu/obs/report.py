@@ -31,6 +31,8 @@ from typing import Dict, List, Optional
 
 from .ledger import LEDGER_JSON
 from .qc import QC_REPORT_JSON
+from .timeseries import (TIMESERIES_JSONL, read_timeseries,
+                         summarize_timeseries)
 from .trace import METRICS_JSON, TRACE_JSONL
 
 RUN_REPORT_HTML = "run_report.html"
@@ -202,8 +204,19 @@ def build_report(run_dir) -> Optional[dict]:
             continue
         if isinstance(data, dict):
             bench.append({"file": path.name, **data})
+    timeseries = None
+    ts_entries = read_timeseries(run_dir / TIMESERIES_JSONL)
+    if ts_entries:
+        timeseries = summarize_timeseries(ts_entries)
+        # the SLO verdict rides the last sampled tick that carried one
+        # (the serve sampler attaches its rolling-window report per tick)
+        slo = next((e["slo"] for e in reversed(ts_entries)
+                    if isinstance(e.get("slo"), dict)), None)
+        if slo is not None:
+            timeseries["slo"] = slo
     if trace is None and metrics is None and manifest is None \
-            and qc is None and ledger is None and not bench:
+            and qc is None and ledger is None and not bench \
+            and timeseries is None:
         return None
     report: dict = {"dir": str(run_dir)}
     if trace is not None:
@@ -228,7 +241,62 @@ def build_report(run_dir) -> Optional[dict]:
         report["ledger"] = ledger
     if bench:
         report["bench"] = bench
+    if timeseries is not None:
+        report["timeseries"] = timeseries
     return report
+
+
+def _telemetry_lines(ts: dict, lines: List[str]) -> None:
+    """The continuous-telemetry section: series shape, host envelope,
+    latency quantiles and the SLO verdict. Every field optional — a
+    foreign or truncated series renders partially, never raises."""
+    if not isinstance(ts, dict):
+        return
+    head = f"  {ts.get('ticks', '?')} sampler ticks"
+    span = ts.get("span_s")
+    if isinstance(span, (int, float)) and span > 0:
+        head += f" over {_fmt_s(span)}"
+    lines.append(head)
+    host = ts.get("host") or {}
+    rss = host.get("rss_bytes")
+    if isinstance(rss, dict):
+        lines.append(f"  RSS: min {_fmt_bytes(rss.get('min', 0))} · "
+                     f"median {_fmt_bytes(rss.get('median', 0))} · "
+                     f"max {_fmt_bytes(rss.get('max', 0))}")
+    busy = host.get("cpu_busy_frac")
+    if isinstance(busy, dict):
+        lines.append(f"  host CPU busy: min {busy.get('min', 0) * 100:.0f}%"
+                     f" · median {busy.get('median', 0) * 100:.0f}%"
+                     f" · max {busy.get('max', 0) * 100:.0f}%")
+    for key, stats in sorted((ts.get("gauges") or {}).items()):
+        if key.startswith("autocycler_serve_queue_depth") \
+                and isinstance(stats, dict):
+            lines.append(f"  queue depth: median "
+                         f"{stats.get('median', 0):g} · max "
+                         f"{stats.get('max', 0):g}")
+    for key, h in sorted((ts.get("hists") or {}).items()):
+        if key.startswith("autocycler_serve_job_seconds") \
+                and isinstance(h, dict) and h.get("p50") is not None:
+            line = f"  job latency ({key}): p50 {_fmt_s(h['p50'])}"
+            if h.get("p95") is not None:
+                line += f" · p95 {_fmt_s(h['p95'])}"
+            lines.append(line)
+    slo = ts.get("slo")
+    if isinstance(slo, dict):
+        obj = slo.get("objectives") or {}
+        if any(v is not None for v in obj.values()):
+            verdict = "VIOLATED" if slo.get("violated") else "met"
+            bits = [f"{k.replace('_s', '')} <= {v:g}s"
+                    for k, v in sorted(obj.items()) if v is not None]
+            line = f"  SLO ({', '.join(bits)}): {verdict}"
+            burn = slo.get("burn_rate")
+            if isinstance(burn, (int, float)):
+                line += f", burn rate {burn:g}"
+            lines.append(line)
+        elif slo.get("p50_s") is not None:
+            lines.append(f"  SLO: no objective set (window p50 "
+                         f"{_fmt_s(slo['p50_s'])}, p95 "
+                         f"{_fmt_s(slo.get('p95_s', slo['p50_s']))})")
 
 
 def render_report(report: dict) -> str:
@@ -335,6 +403,11 @@ def render_report(report: dict) -> str:
                 stage = entry.get("stage") or "?"
                 lines.append(f"  FAILED {name} (stage {stage}): "
                              f"{entry.get('error')}")
+        lines.append("")
+    timeseries = report.get("timeseries")
+    if timeseries:
+        lines.append("Continuous telemetry:")
+        _telemetry_lines(timeseries, lines)
         lines.append("")
     qc = report.get("qc")
     if qc:
@@ -598,6 +671,20 @@ def render_html(report: dict) -> str:
             parts.append("<h2>Stage outputs</h2>")
             parts.extend(_html_kv_table(
                 stage_rows, ("stage", "artifact", "bytes", "sha256")))
+    timeseries = report.get("timeseries")
+    if timeseries:
+        parts.append("<h2>Continuous telemetry</h2>")
+        ts_lines: List[str] = []
+        _telemetry_lines(timeseries, ts_lines)
+        parts.append("<pre>" + _esc("\n".join(ts_lines)) + "</pre>")
+        slo = timeseries.get("slo")
+        if isinstance(slo, dict):
+            obj = slo.get("objectives") or {}
+            if any(v is not None for v in obj.values()):
+                verdict = ("<span class=\"fail\">SLO VIOLATED</span>"
+                           if slo.get("violated")
+                           else "<span class=\"pass\">SLO met</span>")
+                parts.append(f"<p>{verdict}</p>")
     metrics = report.get("metrics")
     if metrics:
         dev_s = _metric_total(metrics, "autocycler_device_seconds_total")
@@ -630,7 +717,7 @@ def report(run_dir, as_json: bool = False,
     if built is None:
         print(f"Error: no telemetry found in {run_dir} (expected "
               f"{TRACE_JSONL}, {METRICS_JSON}, {QC_REPORT_JSON}, "
-              f"{LEDGER_JSON}, batch_manifest.json or "
+              f"{LEDGER_JSON}, {TIMESERIES_JSONL}, batch_manifest.json or "
               "BENCH*.json)", file=sys.stderr)
         return 1
     if html is not None:

@@ -1,0 +1,326 @@
+"""`autocycler top <dir>`: a live fleet dashboard over a serve root (or
+any run directory with a time series).
+
+`watch` follows one run's span stream; `top` is the fleet view — it
+aggregates the daemon's discovery file (``serve.json``), the job manifest
+(``serve_manifest.json``) and the continuous telemetry
+(``timeseries.jsonl``, written by :mod:`obs.timeseries`) into one frame:
+queue depth and throughput sparklines, latency quantiles with the SLO
+verdict, cache hit-rate, the device/host split and memory. Everything is
+read from artifacts, so it works cross-process against a live daemon, a
+finished run, or a directory scp'd home — no HTTP endpoint required.
+
+Modes mirror `watch`: ``--once`` (default) renders one frame and exits;
+``--follow`` re-renders every ``--interval`` seconds (bounded by
+``--cycles`` when given).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import sys
+import time
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from . import report as obs_report
+from .timeseries import (TIMESERIES_JSONL, read_timeseries,
+                         summarize_timeseries)
+
+SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+SPARK_WIDTH = 32
+
+
+def sparkline(values: List[float], width: int = SPARK_WIDTH) -> str:
+    """Unicode block sparkline of the series tail (newest right). A flat
+    series renders as a flat low line, not noise."""
+    vals = [v for v in values if isinstance(v, (int, float))][-width:]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return SPARK_BLOCKS[0] * len(vals)
+    span = hi - lo
+    return "".join(
+        SPARK_BLOCKS[min(len(SPARK_BLOCKS) - 1,
+                         int((v - lo) / span * len(SPARK_BLOCKS)))]
+        for v in vals)
+
+
+def _load_json(path: Path) -> Optional[dict]:
+    try:
+        data = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return None
+    return data if isinstance(data, dict) else None
+
+
+def _gauge_series(entries: List[dict], name: str) -> List[float]:
+    """Per-tick values of one gauge, summed across label sets."""
+    out = []
+    for e in entries:
+        total = None
+        for key, v in (e.get("gauges") or {}).items():
+            if key == name or key.startswith(name + "{"):
+                if isinstance(v, (int, float)):
+                    total = (total or 0.0) + v
+        if total is not None:
+            out.append(total)
+    return out
+
+
+def _counter_delta_series(entries: List[dict], name: str) -> List[float]:
+    """Per-tick deltas of one counter, summed across label sets (absent
+    in a tick means no change — rendered 0 so the sparkline stays dense)."""
+    out = []
+    for e in entries:
+        total = 0.0
+        for key, v in (e.get("counters") or {}).items():
+            if key == name or key.startswith(name + "{"):
+                if isinstance(v, (int, float)):
+                    total += v
+        out.append(total)
+    return out
+
+
+def _cache_rates(entries: List[dict]) -> Dict[str, dict]:
+    """Cumulative hit/miss per cache from the delta-encoded counter
+    stream."""
+    out: Dict[str, dict] = {}
+    for e in entries:
+        for key, v in (e.get("counters") or {}).items():
+            if not key.startswith("autocycler_cache_events_total{") \
+                    or not isinstance(v, (int, float)):
+                continue
+            body = key[key.index("{") + 1:-1]
+            labels = dict(part.split("=", 1) for part in body.split(",")
+                          if "=" in part)
+            which = labels.get("cache")
+            event = labels.get("event")
+            if which and event in ("hit", "miss"):
+                out.setdefault(which, {"hit": 0.0, "miss": 0.0})
+                out[which][event] += v
+    return out
+
+
+def _latest(entries: List[dict], key: str) -> Optional[dict]:
+    for e in reversed(entries):
+        node = e.get(key)
+        if isinstance(node, dict):
+            return node
+    return None
+
+
+def _load_entries(root: Path) -> List[dict]:
+    """The root's own series, or — for a root whose jobs carry their own
+    samplers — the per-job series merged in time order."""
+    entries = read_timeseries(root / TIMESERIES_JSONL)
+    if entries:
+        return entries
+    merged: List[dict] = []
+    for path in sorted(root.glob("jobs/*/" + TIMESERIES_JSONL)):
+        merged.extend(read_timeseries(path))
+    merged.sort(key=lambda e: e.get("ts") or 0.0)
+    return merged
+
+
+def render_top_frame(root) -> Optional[str]:
+    """One dashboard frame from the artifacts under ``root``; None when
+    the directory holds neither a time series nor serve artifacts."""
+    root = Path(root)
+    info = _load_json(root / "serve.json")
+    manifest = _load_json(root / "serve_manifest.json")
+    entries = _load_entries(root)
+    if not entries and info is None and manifest is None:
+        return None
+    lines: List[str] = []
+
+    head = f"Autocycler top — {root}"
+    if info:
+        up = ""
+        started = info.get("started_epoch")
+        if isinstance(started, (int, float)):
+            up = f" up {obs_report._fmt_s(max(0.0, time.time() - started))}"
+        head += (f"  [daemon pid {info.get('pid', '?')}{up} @ "
+                 f"{info.get('endpoint', '?')}]")
+    else:
+        head += "  [no live daemon — rendering artifacts]"
+    lines.append(head)
+
+    if manifest:
+        items = manifest.get("items") or {}
+        counts: Dict[str, int] = {}
+        for entry in items.values():
+            if isinstance(entry, dict):
+                status = entry.get("status", "?")
+                counts[status] = counts.get(status, 0) + 1
+        summary = " · ".join(f"{n} {status}"
+                             for status, n in sorted(counts.items()))
+        lines.append(f"Jobs:        {len(items)} total  ({summary})"
+                     if items else "Jobs:        none yet")
+
+    if entries:
+        serve_last = _latest(entries, "serve")
+        depth = _gauge_series(entries,
+                              "autocycler_serve_queue_depth")
+        if serve_last is not None or depth:
+            now_depth = (serve_last or {}).get("queue_depth")
+            if now_depth is None and depth:
+                now_depth = int(depth[-1])
+            spark = sparkline(depth)
+            line = f"Queue depth  {spark or '-'}"
+            if now_depth is not None:
+                line += f"  now {int(now_depth)}"
+            if depth:
+                line += f" (max {int(max(depth))})"
+            lines.append(line)
+
+        jobs_deltas = _counter_delta_series(
+            entries, "autocycler_serve_jobs_total")
+        if any(jobs_deltas):
+            total_jobs = sum(jobs_deltas)
+            rate = ""
+            interval = entries[-1].get("interval_s")
+            if isinstance(interval, (int, float)) and interval > 0:
+                rate = (f"  {jobs_deltas[-1] * 60.0 / interval:.1f} "
+                        "jobs/min (last tick)")
+            lines.append(f"Throughput   {sparkline(jobs_deltas)}"
+                         f"{rate}  {int(total_jobs)} finished in view")
+
+        slo = _latest(entries, "slo")
+        lat = _latency_line(slo, entries)
+        if lat:
+            lines.append(lat)
+
+        caches = _cache_rates(entries)
+        if caches:
+            bits = []
+            for which in sorted(caches):
+                hit, miss = caches[which]["hit"], caches[which]["miss"]
+                total = hit + miss
+                pct = f" ({100.0 * hit / total:.0f}% hit)" if total else ""
+                bits.append(f"{which} {int(hit)}/{int(total)}{pct}")
+            lines.append("Caches       " + " · ".join(bits))
+
+        dev_deltas = _counter_delta_series(
+            entries, "autocycler_device_seconds_total")
+        busy = [v for v in
+                (e.get("host", {}).get("cpu_busy_frac") for e in entries)
+                if isinstance(v, (int, float))]
+        if any(dev_deltas) or busy:
+            bits = []
+            if any(dev_deltas):
+                bits.append(f"device {sparkline(dev_deltas)} "
+                            f"{sum(dev_deltas):.2f}s in view")
+            if busy:
+                bits.append(f"host cpu {sparkline(busy)} "
+                            f"now {busy[-1] * 100:.0f}%")
+            lines.append("Device/host  " + " · ".join(bits))
+
+        rss = [v for v in
+               (e.get("host", {}).get("rss_bytes") for e in entries)
+               if isinstance(v, (int, float))]
+        if rss:
+            lines.append(f"Memory       RSS {sparkline(rss)} now "
+                         f"{obs_report._fmt_bytes(rss[-1])} "
+                         f"(peak {obs_report._fmt_bytes(max(rss))})")
+
+        summary = summarize_timeseries(entries) or {}
+        span = summary.get("span_s")
+        tick_bits = f"{summary.get('ticks', len(entries))} ticks"
+        if isinstance(span, (int, float)) and span > 0:
+            tick_bits += f" over {obs_report._fmt_s(span)}"
+        interval = entries[-1].get("interval_s")
+        if isinstance(interval, (int, float)):
+            tick_bits += f" (interval {interval:g}s)"
+        age = time.time() - (entries[-1].get("ts") or 0.0)
+        if isinstance(interval, (int, float)) and age > 3 * interval:
+            tick_bits += f"  [STALE: last tick {obs_report._fmt_s(age)} ago]"
+        lines.append(f"Sampler      {tick_bits}")
+    else:
+        lines.append(f"No {TIMESERIES_JSONL} yet — queue/latency trends "
+                     "appear once the sampler ticks")
+
+    return "\n".join(lines).rstrip() + "\n"
+
+
+def _latency_line(slo: Optional[dict], entries: List[dict]) -> Optional[str]:
+    """The latency quantiles + SLO verdict line, preferring the daemon's
+    windowed SLO block and falling back to histogram estimates from the
+    latest tick."""
+    p50 = p95 = None
+    extra = ""
+    if slo:
+        p50, p95 = slo.get("p50_s"), slo.get("p95_s")
+        qw, ex = slo.get("queue_wait_p50_s"), slo.get("exec_p50_s")
+        if qw is not None or ex is not None:
+            parts = []
+            if qw is not None:
+                parts.append(f"queue p50 {obs_report._fmt_s(qw)}")
+            if ex is not None:
+                parts.append(f"exec p50 {obs_report._fmt_s(ex)}")
+            extra = "  (" + " · ".join(parts) + ")"
+    if p50 is None:
+        for e in reversed(entries):
+            for key, h in (e.get("hists") or {}).items():
+                if key.startswith("autocycler_serve_job_seconds") \
+                        and isinstance(h, dict) and h.get("p50") is not None:
+                    p50, p95 = h.get("p50"), h.get("p95")
+                    break
+            if p50 is not None:
+                break
+    if p50 is None:
+        return None
+    line = f"Latency      p50 {obs_report._fmt_s(p50)}"
+    if p95 is not None:
+        line += f"  p95 {obs_report._fmt_s(p95)}"
+    line += extra
+    if slo:
+        obj = slo.get("objectives") or {}
+        if any(v is not None for v in obj.values()):
+            verdict = "VIOLATED" if slo.get("violated") else "ok"
+            line += f"  SLO {verdict}"
+            burn = slo.get("burn_rate")
+            if isinstance(burn, (int, float)):
+                line += f" (burn {burn:g})"
+        else:
+            line += "  SLO: no objective set"
+    return line
+
+
+def top(root, follow: bool = False, interval: float = 2.0,
+        cycles: Optional[int] = None) -> int:
+    """CLI entry for `autocycler top`. ``--once`` renders the current
+    fleet state and exits (1 when the directory holds no artifacts at
+    all); ``--follow`` re-renders until interrupted (or ``cycles``
+    frames)."""
+    root = Path(root)
+    if not follow:
+        frame = render_top_frame(root)
+        if frame is None:
+            print(f"Error: no {TIMESERIES_JSONL}, serve.json or "
+                  f"serve_manifest.json in {root} — nothing to show",
+                  file=sys.stderr)
+            return 1
+        print(frame, end="")
+        return 0
+    polled = 0
+    announced_wait = False
+    with contextlib.suppress(KeyboardInterrupt):
+        while True:
+            frame = render_top_frame(root)
+            if frame is None:
+                if not announced_wait:
+                    print(f"Waiting for artifacts in {root} "
+                          "(no daemon or sampler output yet)...", flush=True)
+                    announced_wait = True
+            else:
+                stamp = time.strftime("%H:%M:%S")
+                print(f"--- {stamp} ---")
+                print(frame, end="", flush=True)
+            polled += 1
+            if cycles is not None and polled >= cycles:
+                return 0
+            time.sleep(max(0.1, interval))
+    return 0

@@ -34,9 +34,11 @@ from typing import Dict, List, Optional
 
 from ..obs import ledger, metrics_registry, trace
 from ..obs import qc as obs_qc
+from ..obs.metrics_registry import SECONDS_BUCKETS
 from ..utils import AutocyclerError, log
 from ..utils.resilience import RunManifest
 from .protocol import JobSpec
+from .slo import SloTracker
 
 MANIFEST_NAME = "serve_manifest.json"
 
@@ -69,6 +71,7 @@ class Job:
         self.started_epoch: Optional[float] = None
         self.finished_epoch: Optional[float] = None
         self.wall_s: Optional[float] = None
+        self.queue_wait_s: Optional[float] = None
 
     def to_dict(self) -> dict:
         return {
@@ -85,6 +88,8 @@ class Job:
             if self.finished_epoch else None,
             "wall_s": round(self.wall_s, 3) if self.wall_s is not None
             else None,
+            "queue_wait_s": round(self.queue_wait_s, 3)
+            if self.queue_wait_s is not None else None,
         }
 
 
@@ -102,6 +107,9 @@ class Scheduler:
         self._next_id = 1
         self._stop = threading.Event()
         self._worker: Optional[threading.Thread] = None
+        # latency SLO tracking: its own lock, disjoint from _run_lock by
+        # construction (the sampler and /healthz read it mid-job)
+        self.slo = SloTracker()
         self.manifest = RunManifest.load(self.root / MANIFEST_NAME)
         # a previous daemon died mid-job: those entries can never complete
         # now — record the interruption so `/jobs` history and the manifest
@@ -212,6 +220,8 @@ class Scheduler:
         with self._run_lock:
             job.state = "running"
             job.started_epoch = time.time()
+            job.queue_wait_s = max(0.0,
+                                   job.started_epoch - job.submitted_epoch)
             self.manifest.start(job.id)
             log.message(f"serve: {job.id} started "
                         f"({spec.command} {spec.assemblies_dir})")
@@ -265,7 +275,11 @@ class Scheduler:
                     state=job.state, command=spec.command)
                 metrics_registry.observe(
                     JOB_SECONDS, job.wall_s,
-                    help="per-job wall seconds", command=spec.command)
+                    help="per-job wall seconds",
+                    buckets=SECONDS_BUCKETS, command=spec.command)
+                self.slo.record(job.queue_wait_s or 0.0, job.wall_s,
+                                finished_epoch=job.finished_epoch,
+                                command=spec.command)
                 log.message(f"serve: {job.id} {job.state} "
                             f"({job.wall_s:.2f}s)")
 

@@ -38,10 +38,15 @@ from urllib.parse import parse_qs, urlparse
 
 from .. import __version__
 from ..obs import metrics_registry
+from ..obs.timeseries import TimeseriesSampler, timeseries_enabled
 from ..utils import log
 from ..utils.resilience import InputError
 from .protocol import (DEFAULT_PORT, SERVE_INFO_JSON, parse_job_spec)
 from .scheduler import QueueFullError, Scheduler
+
+# a sampler whose last tick is older than this many intervals is stale —
+# wedged or dead, either way the continuous telemetry has stopped
+SAMPLER_STALE_INTERVALS = 3.0
 
 REQUESTS_TOTAL = "autocycler_serve_requests_total"
 
@@ -194,6 +199,20 @@ class ServeHandle:
         self.server.daemon_threads = True
         self._server_thread: Optional[threading.Thread] = None
         self._shutdown_requested = threading.Event()
+        # continuous telemetry: one sampler per daemon, writing
+        # timeseries.jsonl into the serve root. Its extra() hook reads the
+        # SLO tracker and job-table lock only — never the run lock, so a
+        # tick can never stall job execution.
+        self.sampler: Optional[TimeseriesSampler] = None
+        if timeseries_enabled():
+            self.sampler = TimeseriesSampler(
+                self.root, extra=self._sampler_extra)
+
+    def _sampler_extra(self) -> dict:
+        return {"serve": {"queue_depth": self.scheduler._queue.qsize(),
+                          "jobs": self.scheduler.counts(),
+                          "idle": self.scheduler.idle()},
+                "slo": self.scheduler.slo.report()}
 
     # ---- lifecycle ----
 
@@ -201,6 +220,8 @@ class ServeHandle:
         """Start the scheduler worker and the HTTP accept loop (on a
         background thread) and write the discovery file."""
         self.scheduler.start()
+        if self.sampler is not None:
+            self.sampler.start()
         self._server_thread = threading.Thread(
             target=self.server.serve_forever,
             name="autocycler-serve-http", daemon=True)
@@ -221,6 +242,8 @@ class ServeHandle:
         self.server.shutdown()
         self.server.server_close()
         self.scheduler.shutdown(wait=True)
+        if self.sampler is not None:
+            self.sampler.stop()   # takes the series' final tick
         if self.socket_path:
             with contextlib.suppress(OSError):
                 os.unlink(self.socket_path)
@@ -242,16 +265,47 @@ class ServeHandle:
     # ---- health ----
 
     def health(self) -> dict:
+        """Daemon health: liveness basics, queue state, the latency-SLO
+        verdict and sampler liveness. ``status`` degrades (never errors —
+        the daemon IS serving) when the rolling latency window violates a
+        configured objective or the telemetry sampler has gone stale."""
         from ..ops.distance import probe_overlap_report
+        now = time.time()
+        slo_report = self.scheduler.slo.report()
         health = {
             "status": "ok",
             "version": __version__,
             "pid": os.getpid(),
-            "uptime_s": round(time.time() - self.t0, 3),
+            "uptime_s": round(now - self.t0, 3),
             "queue_capacity": self.scheduler.capacity,
+            "queue_depth": self.scheduler._queue.qsize(),
             "jobs": self.scheduler.counts(),
             "idle": self.scheduler.idle(),
+            "last_job_finished_epoch": slo_report.get("last_finished_epoch"),
+            "slo": slo_report,
         }
+        sampler = {"enabled": self.sampler is not None}
+        if self.sampler is not None:
+            last = self.sampler.last_tick_epoch
+            age = round(now - last, 3) if last is not None else None
+            stale_after = self.sampler.interval * SAMPLER_STALE_INTERVALS
+            sampler.update(
+                running=self.sampler.running(),
+                interval_s=self.sampler.interval,
+                last_tick_epoch=round(last, 3) if last else None,
+                tick_age_s=age,
+                stale=(not self.sampler.running()
+                       or (age is not None and age > stale_after)))
+        health["sampler"] = sampler
+        degraded = []
+        if slo_report.get("violated"):
+            degraded.append("slo")
+        if sampler.get("stale"):
+            degraded.append("sampler")
+        if degraded:
+            health["status"] = "degraded"
+            health["degraded"] = degraded
+            health["burn_rate"] = slo_report.get("burn_rate")
         with contextlib.suppress(Exception):
             health["probe"] = probe_overlap_report()
         return health
@@ -296,6 +350,10 @@ def serve(serve_dir, host: str = "127.0.0.1", port: int = DEFAULT_PORT,
     log.message(f"listening on {handle.endpoint}")
     log.message(f"serve root:   {root}")
     log.message(f"work queue:   {queue_size} job(s)")
+    if handle.sampler is not None:
+        log.message(f"telemetry:    {handle.sampler.path} "
+                    f"(every {handle.sampler.interval:g}s; "
+                    f"watch with `autocycler top {root} --follow`)")
     log.message(f"submit with:  autocycler submit -i <assemblies_dir> "
                 f"--dir {root}")
     log.message()

@@ -36,6 +36,7 @@ DEVICE_KERNEL_HIST = "autocycler_device_kernel_seconds"
 DEVICE_KERNEL_FLOPS = "autocycler_device_kernel_flops_total"
 DEVICE_KERNEL_BYTES = "autocycler_device_kernel_bytes_total"
 STAGE_SECONDS = "autocycler_stage_seconds_total"
+STAGE_LATENCY_HIST = "autocycler_stage_latency_seconds"
 SUBSTAGE_SECONDS = "autocycler_substage_seconds_total"
 
 _last_lock = threading.Lock()
@@ -322,9 +323,17 @@ def stage_timer(name: str):
                 jax_trace.__exit__(None, None, None)
             except Exception:
                 pass
-        metrics_registry.registry().counter_inc(
+        reg = metrics_registry.registry()
+        reg.counter_inc(
             STAGE_SECONDS, elapsed,
             help="cumulative wall seconds per pipeline stage", stage=name)
+        # seconds-scale latency histogram: stage walls live in the same
+        # band as SLO objectives, so they share the coarse bucket preset
+        # (quantiles readable via metrics_registry.quantile)
+        reg.observe(
+            STAGE_LATENCY_HIST, elapsed,
+            help="per-stage wall latency distribution",
+            buckets=metrics_registry.SECONDS_BUCKETS, stage=name)
         if os.environ.get("AUTOCYCLER_TIMINGS"):
             log.message(f"[timing] {name}: {format_duration(elapsed)}")
             for sub, secs in substage_deltas(sub_before).items():

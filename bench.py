@@ -31,28 +31,13 @@ TARGET_SECONDS = 60.0
 
 def host_load_snapshot() -> dict:
     """One host-load sample: loadavg, cumulative /proc/stat CPU jiffies
-    (total + idle, so two snapshots give the busy fraction DURING the run)
-    and the interpreter's native thread count. Best-effort on every field —
-    the bench must run on hosts without /proc."""
-    import os
-    import threading
-
-    snap: dict = {"ts": round(time.time(), 3),
-                  "threads": threading.active_count()}
-    try:
-        snap["loadavg"] = [round(v, 2) for v in os.getloadavg()]
-    except (OSError, AttributeError):
-        snap["loadavg"] = None
-    try:
-        with open("/proc/stat") as f:
-            fields = f.readline().split()
-        vals = [int(v) for v in fields[1:]]
-        snap["cpu_jiffies_total"] = sum(vals)
-        # idle + iowait: neither is work stolen from the benchmark
-        snap["cpu_jiffies_idle"] = vals[3] + (vals[4] if len(vals) > 4 else 0)
-    except (OSError, ValueError, IndexError):
-        pass
-    return snap
+    (total + idle, so two snapshots give the busy fraction DURING the run),
+    RSS and the interpreter's native thread count. A view over
+    ``obs.timeseries.host_sample`` — the continuous-telemetry sampler and
+    the bench artifacts measure the machine with the same code, so they
+    can never disagree about what the host was doing."""
+    from autocycler_tpu.obs.timeseries import host_sample
+    return host_sample()
 
 
 def host_load_context(before: dict, after: dict) -> dict:
@@ -786,6 +771,9 @@ def bench_servesmoke() -> None:
     asm = make_assemblies(tmp, n_assemblies=3, chromosome_len=30_000,
                           plasmid_len=2_000, n_snps=10)
     root = tmp / "serve"
+    # the smoke daemon lives well under the default 5 s sampler interval;
+    # tick fast so the artifact records a real series
+    os.environ.setdefault("AUTOCYCLER_TIMESERIES_INTERVAL_S", "0.2")
     warm_cache.set_shared_cache_dir(root / ".cache")
     handle = ServeHandle(root, port=0).start()
     spec = {"assemblies_dir": str(asm), "command": "compress",
@@ -813,6 +801,7 @@ def bench_servesmoke() -> None:
         warm_cache.set_shared_cache_dir(None)
         devnull.close()
 
+    slo_report = handle.scheduler.slo.report()
     cold, warm = (r["wall_s"] for r in records)
     states = [r["state"] for r in records]
     identical = all(
@@ -820,7 +809,12 @@ def bench_servesmoke() -> None:
         == (tmp / "ref" / name).read_bytes()
         for name in ("input_assemblies.gfa", "input_assemblies.yaml"))
     passed = states == ["done", "done"] and warm < cold and identical
-    print(json.dumps({
+    # the latency split + SLO artifact: queue-wait vs execution per job,
+    # the daemon's rolling-window quantiles/burn-rate, and the number of
+    # sampler ticks the run produced (schema-tolerant consumers use .get)
+    from autocycler_tpu.obs.timeseries import (TIMESERIES_JSONL,
+                                               read_timeseries)
+    artifact = {
         "bench": "servesmoke",
         "passed": passed,
         "states": states,
@@ -828,7 +822,12 @@ def bench_servesmoke() -> None:
         "warm_s": round(warm, 3),
         "warm_speedup": round(cold / warm, 2) if warm else None,
         "byte_identical": identical,
-    }))
+        "queue_wait_s": [r.get("queue_wait_s") for r in records],
+        "exec_s": [round(r["wall_s"], 3) for r in records],
+        "slo": slo_report,
+        "timeseries_ticks": len(read_timeseries(root / TIMESERIES_JSONL)),
+    }
+    print(json.dumps(artifact))
     if not passed:
         sys.exit(1)
 
@@ -1130,6 +1129,10 @@ def trend_rows(artifacts: list) -> list:
         host = p.get("host_env") or {}
         kernels = p.get("device_kernels")
         kernels = kernels if isinstance(kernels, dict) else {}
+        # SLO/timeseries fields landed with the continuous-telemetry round:
+        # every read tolerates absence (r01-era artifacts have neither)
+        slo = p.get("slo")
+        slo = slo if isinstance(slo, dict) else {}
         rows.append({
             "round": art.get("round"),
             "path": art.get("path"),
@@ -1144,6 +1147,9 @@ def trend_rows(artifacts: list) -> list:
             "device_dispatches": p.get("device_dispatches"),
             "kernel_failures": kernels.get("failures"),
             "untrusted": p.get("untrusted"),
+            "slo_p50_s": slo.get("p50_s"),
+            "slo_p95_s": slo.get("p95_s"),
+            "timeseries_ticks": p.get("timeseries_ticks"),
         })
     return rows
 
@@ -1199,19 +1205,31 @@ def bench_trend() -> None:
     if not rows:
         print("no BENCH_r*.json artifacts found", file=sys.stderr)
     else:
+        # the p50/p95 column only renders when some round recorded SLO
+        # quantiles — older artifact sets keep the historical layout
+        has_slo = any(r.get("slo_p50_s") is not None
+                      or r.get("slo_p95_s") is not None for r in rows)
+        slo_head = f" {'p50/p95':>11}" if has_slo else ""
         print(f"{'round':>5} {'median_s':>9} {'best_s':>7} {'spread':>7} "
-              f"{'dev_frac':>8} {'probe':>8} {'ovl_s':>6} {'load':>6}  stages",
+              f"{'dev_frac':>8} {'probe':>8} {'ovl_s':>6} {'load':>6}"
+              f"{slo_head}  stages",
               file=sys.stderr)
         for r in rows:
             stages = " ".join(f"{name}={fmt(secs, '.1f')}"
                               for name, secs in (r["stages_s"] or {}).items())
             flag = " UNTRUSTED" if r.get("untrusted") else ""
+            slo_col = ""
+            if has_slo:
+                cell = (f"{fmt(r.get('slo_p50_s'), '.1f')}/"
+                        f"{fmt(r.get('slo_p95_s'), '.1f')}")
+                slo_col = f" {cell:>11}"
             print(f"{fmt(r['round']):>5} {fmt(r['median_s'], '.2f'):>9} "
                   f"{fmt(r['best_s'], '.2f'):>7} {fmt(r['spread_s'], '.2f'):>7} "
                   f"{fmt(r['device_fraction'], '.4f'):>8} "
                   f"{r['probe_kind'] or '-':>8} "
                   f"{fmt(r['probe_overlap_saved_s'], '.1f'):>6} "
-                  f"{fmt(r['ambient_load'], '.2f'):>6}  {stages}{flag}",
+                  f"{fmt(r['ambient_load'], '.2f'):>6}{slo_col}  "
+                  f"{stages}{flag}",
                   file=sys.stderr)
     mrows = multichip_rows(load_multichip_artifacts())
     if mrows:

@@ -1,0 +1,258 @@
+"""Serve latency SLOs end to end: the queue-wait/exec split, rolling-window
+burn rate, degraded health and the telemetry artifacts a live daemon emits.
+
+The acceptance path: one in-process daemon with a fast sampler runs three
+compress jobs — timeseries.jsonl carries monotone ticks spanning the jobs,
+/metrics exports p50/p95 latency quantiles that bracket the observed wall
+times, /healthz flips to "degraded" once AUTOCYCLER_SLO_P50_S is set below
+the observed p50, and `autocycler top --once` renders a frame from the
+same artifacts.
+"""
+
+import time
+
+import pytest
+
+from synthetic import make_assemblies
+
+pytestmark = [pytest.mark.serve, pytest.mark.slo]
+
+
+def _wait_until(predicate, timeout=30.0, interval=0.05):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _request(endpoint, method, path, body=None):
+    from autocycler_tpu.serve.client import request_json
+    return request_json(endpoint, method, path, body=body)
+
+
+def _wait_job(endpoint, job_id, timeout=120.0):
+    from autocycler_tpu.serve.client import wait_for_job
+    return wait_for_job(endpoint, job_id, poll_s=0.05, timeout=timeout)
+
+
+@pytest.fixture
+def no_slo_env(monkeypatch):
+    """SLO objectives off unless a test opts in."""
+    from autocycler_tpu.serve import slo
+    for env in (slo.P50_ENV, slo.P95_ENV, slo.WINDOW_ENV):
+        monkeypatch.delenv(env, raising=False)
+    return monkeypatch
+
+
+# ----------------------------------------------------------- tracker units
+
+
+def test_objectives_parse_env(no_slo_env):
+    from autocycler_tpu.serve import slo
+
+    assert slo.objectives() == {"p50_s": None, "p95_s": None}
+    no_slo_env.setenv(slo.P50_ENV, "5.0")
+    no_slo_env.setenv(slo.P95_ENV, "garbage")
+    assert slo.objectives() == {"p50_s": 5.0, "p95_s": None}
+    no_slo_env.setenv(slo.P50_ENV, "-3")   # non-positive means unset
+    assert slo.objectives()["p50_s"] is None
+
+
+def test_tracker_quantiles_and_split(no_slo_env):
+    from autocycler_tpu.obs.metrics_registry import MetricsRegistry
+    from autocycler_tpu.serve.slo import SloTracker
+
+    reg = MetricsRegistry()
+    tracker = SloTracker(registry=reg)
+    walls = [1.0, 2.0, 3.0, 4.0, 10.0]
+    for w in walls:
+        tracker.record(0.5, w, command="compress")
+    rep = tracker.report()
+    assert rep["window_jobs"] == 5
+    assert rep["p50_s"] == pytest.approx(3.5)        # 0.5 wait + 3.0 exec
+    assert rep["exec_p50_s"] == pytest.approx(3.0)
+    assert rep["queue_wait_p50_s"] == pytest.approx(0.5)
+    assert rep["violated"] is False and rep["burn_rate"] is None
+    assert rep["last_finished_epoch"] is not None
+    # both histograms carry the split, labelled by command
+    assert reg.quantile("autocycler_serve_exec_seconds", 0.5,
+                        command="compress") is not None
+    assert reg.quantile("autocycler_serve_queue_wait_seconds", 0.5,
+                        command="compress") is not None
+
+
+def test_tracker_burn_rate_and_violation(no_slo_env):
+    from autocycler_tpu.obs.metrics_registry import MetricsRegistry
+    from autocycler_tpu.serve import slo
+
+    tracker = slo.SloTracker(registry=MetricsRegistry())
+    for w in (1.0, 1.0, 1.0, 9.0):   # one of four jobs is slow
+        tracker.record(0.0, w)
+    # p50 objective 2s: observed p50 1.0 meets it; 25% violators over a
+    # 50% allowance burns at 0.5
+    no_slo_env.setenv(slo.P50_ENV, "2.0")
+    rep = tracker.report()
+    assert rep["violated"] is False
+    assert rep["burn_rate"] == pytest.approx(0.5)
+    # p50 objective 0.5s: everything violates, burn 1/0.5 = 2.0
+    no_slo_env.setenv(slo.P50_ENV, "0.5")
+    rep = tracker.report()
+    assert rep["violated"] is True
+    assert rep["burn_rate"] == pytest.approx(2.0)
+
+
+def test_tracker_window_prunes_by_age(no_slo_env):
+    from autocycler_tpu.obs.metrics_registry import MetricsRegistry
+    from autocycler_tpu.serve.slo import SloTracker, WINDOW_ENV
+
+    no_slo_env.setenv(WINDOW_ENV, "60")
+    tracker = SloTracker(registry=MetricsRegistry())
+    now = time.time()
+    tracker.record(0.0, 100.0, finished_epoch=now - 600)   # ancient outlier
+    tracker.record(0.0, 1.0, finished_epoch=now)
+    rep = tracker.report()
+    assert rep["window_jobs"] == 1
+    assert rep["p50_s"] == pytest.approx(1.0)   # the outlier aged out
+
+
+def test_tracker_report_while_run_lock_held(no_slo_env, tmp_path):
+    """The no-shared-locks bar from the sampler side of the fence: the SLO
+    read path answers while the scheduler's run lock is held."""
+    import threading
+
+    from autocycler_tpu.serve.scheduler import Scheduler
+
+    sched = Scheduler(tmp_path / "serve")
+    sched.slo.record(0.1, 1.0)
+    done = threading.Event()
+    with sched._run_lock:
+        t = threading.Thread(
+            target=lambda: (sched.slo.report(), done.set()), daemon=True)
+        t.start()
+        assert done.wait(5.0), "slo report blocked behind the run lock"
+
+
+# ------------------------------------------------------- the acceptance e2e
+
+
+@pytest.fixture
+def fast_serve(tmp_path, no_slo_env):
+    """A daemon whose sampler ticks every 50 ms (the interval is read at
+    construction, so the env must be set before ServeHandle exists)."""
+    from autocycler_tpu.serve.server import ServeHandle
+    from autocycler_tpu.utils import cache as warm_cache
+
+    no_slo_env.setenv("AUTOCYCLER_TIMESERIES_INTERVAL_S", "0.05")
+    root = tmp_path / "serve"
+    warm_cache.set_shared_cache_dir(root / ".cache")
+    handle = ServeHandle(root, port=0).start()
+    try:
+        yield handle
+    finally:
+        handle.stop()
+        warm_cache.set_shared_cache_dir(None)
+
+
+def test_serve_slo_telemetry_e2e(fast_serve, tmp_path, no_slo_env, capsys):
+    from autocycler_tpu.cli import main as cli_main
+    from autocycler_tpu.obs.metrics_registry import registry
+    from autocycler_tpu.obs.timeseries import TIMESERIES_JSONL, \
+        read_timeseries
+    from autocycler_tpu.serve import slo
+
+    make_assemblies(tmp_path)
+    endpoint = fast_serve.endpoint
+    spec = {"assemblies_dir": str(tmp_path / "assemblies"),
+            "command": "compress", "kmer": 51, "threads": 2}
+
+    # --- three jobs through the daemon, with the sampler running ---
+    totals = []
+    for _ in range(3):
+        status, rec = _request(endpoint, "POST", "/jobs", body=spec)
+        assert status == 202
+        final = _wait_job(endpoint, rec["id"])
+        assert final["state"] == "done"
+        assert final["wall_s"] is not None and final["wall_s"] > 0
+        assert final["queue_wait_s"] is not None   # the latency split
+        totals.append(final["wall_s"] + final["queue_wait_s"])
+
+    # --- timeseries.jsonl: monotone ticks spanning the jobs ---
+    ts_path = fast_serve.root / TIMESERIES_JSONL
+    assert _wait_until(lambda: len(read_timeseries(ts_path)) >= 3,
+                       timeout=10.0)
+    entries = read_timeseries(ts_path)
+    ticks = [e["tick"] for e in entries]
+    assert ticks == sorted(ticks) and len(set(ticks)) == len(ticks)
+    assert entries[-1]["ts"] - entries[0]["ts"] >= 0
+    # at least one tick saw the jobs land (counter deltas are per-tick)
+    assert any("autocycler_serve_jobs_total" in k
+               for e in entries for k in e.get("counters", {})), entries
+    # the sampler's extra() hook embedded the live SLO verdict
+    assert any(isinstance(e.get("slo"), dict) for e in entries)
+
+    # --- /metrics: p50/p95 quantiles bracket the observed walls ---
+    status, metrics = _request(endpoint, "GET", "/metrics")
+    assert status == 200
+    text = metrics["raw"]
+    assert "autocycler_serve_latency_quantile_seconds" in text
+    assert 'q="0.50"' in text and 'q="0.95"' in text
+    assert 'phase="queue_wait"' in text and 'phase="exec"' in text
+    for q in ("0.50", "0.95"):
+        # phase=total quantiles come from THIS daemon's rolling window
+        # (the registry's histograms accumulate across the whole test
+        # process, so only the window is guaranteed to see just our jobs);
+        # ±1e-3 covers the 3-decimal rounding of the HTTP job record
+        est = registry().value(
+            "autocycler_serve_latency_quantile_seconds", default=-1.0,
+            q=q, phase="total", command="compress")
+        assert min(totals) - 1e-3 <= est <= max(totals) + 1e-3, \
+            (q, est, totals)
+
+    # --- /healthz: ok, then degraded once the objective is impossible ---
+    status, health = _request(endpoint, "GET", "/healthz")
+    assert status == 200 and health["status"] == "ok"
+    assert health["queue_depth"] == 0
+    assert health["last_job_finished_epoch"] is not None
+    assert health["sampler"]["enabled"] and health["sampler"]["running"]
+    assert health["sampler"]["stale"] is False
+    assert health["slo"]["window_jobs"] == 3
+
+    observed_p50 = health["slo"]["p50_s"]
+    no_slo_env.setenv(slo.P50_ENV, str(observed_p50 / 10.0))
+    status, health = _request(endpoint, "GET", "/healthz")
+    assert status == 200 and health["status"] == "degraded"
+    assert "slo" in health["degraded"]
+    assert health["burn_rate"] is not None and health["burn_rate"] >= 1.0
+    no_slo_env.delenv(slo.P50_ENV)
+
+    # --- `autocycler top --once` renders from the same artifacts ---
+    assert cli_main(["top", str(fast_serve.root), "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "Autocycler top" in out and "Latency" in out
+
+
+def test_health_degrades_on_stale_sampler(fast_serve, no_slo_env):
+    endpoint = fast_serve.endpoint
+    status, health = _request(endpoint, "GET", "/healthz")
+    assert status == 200 and health["status"] == "ok"
+    # kill the sampler thread behind the daemon's back: ticks stop, age
+    # grows past the staleness horizon, health degrades — daemon still up
+    fast_serve.sampler.stop(final_sample=False)
+    fast_serve.sampler.last_tick_epoch = time.time() - 60.0
+    status, health = _request(endpoint, "GET", "/healthz")
+    assert status == 200 and health["status"] == "degraded"
+    assert "sampler" in health["degraded"]
+    assert health["sampler"]["stale"] is True
+
+
+def test_sampler_disabled_by_env(tmp_path, monkeypatch):
+    from autocycler_tpu.serve.server import ServeHandle
+
+    monkeypatch.setenv("AUTOCYCLER_TIMESERIES", "0")
+    handle = ServeHandle(tmp_path / "serve", port=0)
+    assert handle.sampler is None
+    health = handle.health()
+    assert health["sampler"] == {"enabled": False}
+    assert health["status"] == "ok"

@@ -1,0 +1,398 @@
+"""Continuous telemetry: the timeseries sampler, rotation, torn-line
+tolerance, quantile estimates and the `top` renderer.
+
+The reader guarantees mirror TraceFollower/test_report_edges: a torn or
+malformed line, a missing file or a foreign payload must degrade to
+"fewer entries", never raise. Quantile estimates are checked against
+exact numpy percentiles on synthetic samples — the error bound is the
+width of the bucket the exact value falls in, and every estimate must
+bracket within the observed [min, max].
+"""
+
+import json
+import random
+import threading
+
+import numpy as np
+import pytest
+
+from autocycler_tpu.obs.metrics_registry import (MetricsRegistry,
+                                                 SECONDS_BUCKETS)
+from autocycler_tpu.obs.timeseries import (TIMESERIES_JSONL,
+                                           TimeseriesSampler, host_sample,
+                                           purge_timeseries,
+                                           read_timeseries,
+                                           snapshot_quantile,
+                                           summarize_timeseries)
+
+pytestmark = [pytest.mark.obs, pytest.mark.slo]
+
+
+# ---------------------------------------------------------------- quantiles
+
+
+def _bucket_width(edges, value):
+    prev = 0.0
+    for edge in edges:
+        if value <= edge:
+            return edge - prev
+        prev = edge
+    return float("inf")
+
+
+@pytest.mark.parametrize("q", [0.5, 0.9, 0.95])
+def test_quantile_vs_numpy(q):
+    reg = MetricsRegistry()
+    rng = random.Random(42)
+    samples = [rng.lognormvariate(1.0, 0.8) for _ in range(2000)]
+    for s in samples:
+        reg.observe("autocycler_test_lat_seconds", s,
+                    buckets=SECONDS_BUCKETS, help="h")
+    est = reg.quantile("autocycler_test_lat_seconds", q)
+    exact = float(np.percentile(samples, q * 100))
+    assert est is not None
+    # interpolation error is bounded by the bucket the exact value sits in
+    tol = _bucket_width(SECONDS_BUCKETS, exact)
+    assert abs(est - exact) <= tol
+    assert min(samples) <= est <= max(samples)
+
+
+def test_quantile_brackets_observations():
+    reg = MetricsRegistry()
+    for v in (3.0, 3.1, 3.2):
+        reg.observe("autocycler_test_lat_seconds", v,
+                    buckets=SECONDS_BUCKETS, help="h")
+    for q in (0.0, 0.5, 0.95, 1.0):
+        est = reg.quantile("autocycler_test_lat_seconds", q)
+        assert 3.0 <= est <= 3.2
+
+
+def test_quantile_absent_and_invalid():
+    reg = MetricsRegistry()
+    assert reg.quantile("autocycler_nope_seconds", 0.5) is None
+    reg.counter_inc("autocycler_c_total", 1, help="h")
+    assert reg.quantile("autocycler_c_total", 0.5) is None   # not a histogram
+    with pytest.raises(ValueError):
+        reg.quantile("autocycler_nope_seconds", 1.5)
+
+
+def test_snapshot_quantile_matches_registry():
+    reg = MetricsRegistry()
+    rng = random.Random(7)
+    samples = [rng.uniform(0.1, 40.0) for _ in range(500)]
+    for s in samples:
+        reg.observe("autocycler_test_lat_seconds", s,
+                    buckets=SECONDS_BUCKETS, help="h")
+    entry = reg.snapshot()["autocycler_test_lat_seconds"]["values"][0]
+    for q in (0.5, 0.95):
+        assert snapshot_quantile(entry, q) == \
+            pytest.approx(reg.quantile("autocycler_test_lat_seconds", q))
+    assert snapshot_quantile({}, 0.5) is None
+    assert snapshot_quantile({"count": 0, "buckets": {}}, 0.5) is None
+
+
+def test_stage_timer_records_seconds_histogram():
+    from autocycler_tpu.utils.timing import STAGE_LATENCY_HIST, stage_timer
+    from autocycler_tpu.obs import metrics_registry as mr
+
+    with stage_timer("unit-test-stage"):
+        pass
+    est = mr.registry().quantile(STAGE_LATENCY_HIST, 0.5,
+                                 stage="unit-test-stage")
+    assert est is not None and est >= 0.0
+
+
+# ------------------------------------------------------------ reader edges
+
+
+def test_read_timeseries_missing_and_empty(tmp_path):
+    assert read_timeseries(tmp_path / "nope.jsonl") == []
+    path = tmp_path / TIMESERIES_JSONL
+    path.write_text("")
+    assert read_timeseries(path) == []
+
+
+def test_read_timeseries_skips_torn_and_malformed(tmp_path):
+    path = tmp_path / TIMESERIES_JSONL
+    good1 = json.dumps({"ts": 1.0, "tick": 1})
+    good2 = json.dumps({"ts": 2.0, "tick": 2})
+    path.write_bytes((good1 + "\nnot json\n[1,2]\n" + good2 +
+                      '\n{"ts": 3.0, "ti').encode())   # torn final line
+    entries = read_timeseries(path)
+    assert [e["tick"] for e in entries] == [1, 2]
+    # completing the torn line makes it visible — the TraceFollower
+    # byte-boundary contract
+    with open(path, "ab") as f:
+        f.write(b'ck": 3}\n')
+    assert [e["tick"] for e in read_timeseries(path)] == [1, 2, 3]
+
+
+def test_read_timeseries_limit(tmp_path):
+    path = tmp_path / TIMESERIES_JSONL
+    path.write_text("".join(json.dumps({"tick": i}) + "\n"
+                            for i in range(10)))
+    assert [e["tick"] for e in read_timeseries(path, limit=3)] == [7, 8, 9]
+
+
+# ---------------------------------------------------------------- rotation
+
+
+def test_rotation_keeps_newest(tmp_path, monkeypatch):
+    monkeypatch.setenv("AUTOCYCLER_TIMESERIES_MAX", "5")
+    reg = MetricsRegistry()
+    sampler = TimeseriesSampler(tmp_path, interval=0.05, registry=reg)
+    for _ in range(12):
+        sampler.sample()
+    path = tmp_path / TIMESERIES_JSONL
+    assert path.read_text().count("\n") <= 5
+    ticks = [e["tick"] for e in read_timeseries(path)]
+    assert ticks == list(range(8, 13))   # newest five, still monotone
+    assert not list(tmp_path.glob(TIMESERIES_JSONL + ".tmp*"))
+
+
+def test_rotation_disabled(tmp_path, monkeypatch):
+    monkeypatch.setenv("AUTOCYCLER_TIMESERIES_MAX", "0")
+    reg = MetricsRegistry()
+    sampler = TimeseriesSampler(tmp_path, interval=0.05, registry=reg)
+    for _ in range(8):
+        sampler.sample()
+    assert len(read_timeseries(tmp_path / TIMESERIES_JSONL)) == 8
+
+
+# ----------------------------------------------------------------- sampler
+
+
+def test_sampler_delta_encodes_counters(tmp_path):
+    reg = MetricsRegistry()
+    sampler = TimeseriesSampler(tmp_path, interval=0.05, registry=reg)
+    reg.counter_inc("autocycler_test_events_total", 5, help="h")
+    sampler.sample()
+    reg.counter_inc("autocycler_test_events_total", 2, help="h")
+    sampler.sample()
+    sampler.sample()   # no change — the key disappears from the tick
+    entries = read_timeseries(tmp_path / TIMESERIES_JSONL)
+    deltas = [e["counters"].get("autocycler_test_events_total")
+              for e in entries]
+    assert deltas == [5.0, 2.0, None]
+    # histogram deltas likewise per-tick
+    reg.observe("autocycler_test_lat_seconds", 1.0,
+                buckets=SECONDS_BUCKETS, help="h")
+    sampler.sample()
+    last = read_timeseries(tmp_path / TIMESERIES_JSONL)[-1]
+    h = last["hists"]["autocycler_test_lat_seconds"]
+    assert h["count"] == 1 and h["p50"] == pytest.approx(1.0)
+
+
+def test_sampler_thread_lifecycle(tmp_path):
+    reg = MetricsRegistry()
+    sampler = TimeseriesSampler(tmp_path, interval=0.05, registry=reg)
+    sampler.start()
+    try:
+        assert sampler.running()
+        deadline = 100
+        while len(read_timeseries(tmp_path / TIMESERIES_JSONL)) < 3 \
+                and deadline:
+            deadline -= 1
+            threading.Event().wait(0.02)
+    finally:
+        sampler.stop()
+    assert not sampler.running()
+    entries = read_timeseries(tmp_path / TIMESERIES_JSONL)
+    ticks = [e["tick"] for e in entries]
+    assert len(ticks) >= 3
+    assert ticks == sorted(ticks) and len(set(ticks)) == len(ticks)
+    # liveness self-telemetry landed in the registry
+    assert reg.value("autocycler_timeseries_last_tick_epoch") > 0
+
+
+def test_sampler_never_blocks_on_foreign_locks(tmp_path):
+    """The acceptance bar: a tick completes while the scheduler's run lock
+    is held by a job — the sampler shares no lock with job execution."""
+    from autocycler_tpu.serve.scheduler import Scheduler
+
+    reg = MetricsRegistry()
+    sched = Scheduler(tmp_path / "serve")
+    sampler = TimeseriesSampler(tmp_path, interval=0.05, registry=reg)
+    done = threading.Event()
+    with sched._run_lock:              # a job is "executing"
+        t = threading.Thread(
+            target=lambda: (sampler.sample(), done.set()), daemon=True)
+        t.start()
+        assert done.wait(5.0), "sampler tick blocked while run lock held"
+    assert read_timeseries(tmp_path / TIMESERIES_JSONL)
+
+
+def test_sampler_survives_unwritable_dir(tmp_path):
+    reg = MetricsRegistry()
+    target = tmp_path / "blocked"
+    target.write_text("a file, not a dir")   # mkdir/open will fail
+    sampler = TimeseriesSampler(target / "sub", interval=0.05, registry=reg)
+    entry = sampler.sample()               # must not raise
+    assert entry["tick"] == 1
+
+
+def test_host_sample_fields():
+    snap = host_sample()
+    assert snap["threads"] >= 1
+    assert "ts" in snap
+    # rss is best-effort but present on linux
+    assert snap.get("rss_bytes", 1) > 0
+
+
+# --------------------------------------------------------------- summarize
+
+
+def test_summarize_timeseries():
+    entries = [
+        {"ts": 10.0, "tick": 1, "host": {"rss_bytes": 100, "threads": 2,
+                                         "loadavg": [0.5, 0, 0]},
+         "gauges": {"autocycler_serve_queue_depth": 1},
+         "counters": {"autocycler_serve_jobs_total{state=done}": 1},
+         "hists": {"autocycler_serve_job_seconds": {"count": 1, "sum": 2.0,
+                                                    "p50": 2.0, "p95": 2.0}}},
+        {"ts": 20.0, "tick": 2, "host": {"rss_bytes": 300, "threads": 2,
+                                         "loadavg": [1.5, 0, 0]},
+         "gauges": {"autocycler_serve_queue_depth": 3},
+         "counters": {"autocycler_serve_jobs_total{state=done}": 2},
+         "hists": {"autocycler_serve_job_seconds": {"count": 2, "sum": 5.0,
+                                                    "p50": 2.5, "p95": 3.0}}},
+    ]
+    s = summarize_timeseries(entries)
+    assert s["ticks"] == 2 and s["span_s"] == 10.0
+    assert s["host"]["rss_bytes"] == {"min": 100, "median": 200, "max": 300,
+                                      "last": 300}
+    assert s["gauges"]["autocycler_serve_queue_depth"]["max"] == 3
+    assert s["counters"]["autocycler_serve_jobs_total{state=done}"] == 3
+    assert s["hists"]["autocycler_serve_job_seconds"]["p50"] == 2.5
+    assert summarize_timeseries([]) is None
+
+
+def test_summarize_tolerates_foreign_entries():
+    entries = [{"ts": "not a number"}, {"junk": True},
+               {"ts": 5.0, "host": None, "gauges": "nope"}]
+    s = summarize_timeseries(entries)     # never raises
+    assert s["ticks"] == 3
+
+
+# -------------------------------------------------------------- purge/clean
+
+
+def test_purge_timeseries(tmp_path):
+    (tmp_path / TIMESERIES_JSONL).write_text("{}\n")
+    (tmp_path / (TIMESERIES_JSONL + ".tmp123")).write_text("x")
+    job = tmp_path / "jobs" / "job-000001"
+    job.mkdir(parents=True)
+    (job / TIMESERIES_JSONL).write_text("{}\n")
+    removed, reclaimed = purge_timeseries(tmp_path)
+    assert removed == 3 and reclaimed > 0
+    assert not (tmp_path / TIMESERIES_JSONL).exists()
+    assert purge_timeseries(tmp_path) == (0, 0)
+
+
+def test_clean_cache_purges_timeseries(tmp_path, capsys):
+    from autocycler_tpu.commands.clean import clean_cache
+
+    (tmp_path / TIMESERIES_JSONL).write_text("{}\n")
+    clean_cache(tmp_path)
+    assert not (tmp_path / TIMESERIES_JSONL).exists()
+
+
+# --------------------------------------------------------------------- top
+
+
+def _mini_series(tmp_path, reg=None):
+    reg = reg or MetricsRegistry()
+    sampler = TimeseriesSampler(tmp_path, interval=0.05, registry=reg)
+    for depth in (0, 2, 1):
+        reg.gauge_set("autocycler_serve_queue_depth", depth, help="h")
+        reg.counter_inc("autocycler_serve_jobs_total", 1, help="h",
+                        state="done", command="compress")
+        reg.observe("autocycler_serve_job_seconds", 1.5,
+                    buckets=SECONDS_BUCKETS, command="compress", help="h")
+        sampler.sample()
+    return reg
+
+
+def test_top_renders_frame_from_artifacts(tmp_path, capsys):
+    from autocycler_tpu.obs.top import render_top_frame, top
+
+    _mini_series(tmp_path)
+    (tmp_path / "serve_manifest.json").write_text(
+        json.dumps({"items": {"job-000001": {"status": "done"}}}))
+    frame = render_top_frame(tmp_path)
+    assert "Queue depth" in frame and "Throughput" in frame
+    assert "Latency" in frame and "1 done" in frame
+    assert top(tmp_path) == 0
+    assert "Autocycler top" in capsys.readouterr().out
+
+
+def test_top_once_errors_on_empty_dir(tmp_path, capsys):
+    from autocycler_tpu.obs.top import top
+
+    assert top(tmp_path) == 1
+    assert "nothing to show" in capsys.readouterr().err
+
+
+def test_top_follow_bounded_cycles(tmp_path, capsys):
+    from autocycler_tpu.obs.top import top
+
+    _mini_series(tmp_path)
+    assert top(tmp_path, follow=True, interval=0.01, cycles=2) == 0
+    out = capsys.readouterr().out
+    assert out.count("Autocycler top") == 2
+
+
+def test_top_cli_subcommand(tmp_path, capsys, monkeypatch):
+    from autocycler_tpu.cli import main
+
+    _mini_series(tmp_path)
+    assert main(["top", str(tmp_path), "--once"]) == 0
+    assert "Autocycler top" in capsys.readouterr().out
+
+
+def test_sparkline():
+    from autocycler_tpu.obs.top import sparkline
+
+    assert sparkline([]) == ""
+    assert sparkline([1.0, 1.0]) == "▁▁"
+    line = sparkline([0, 1, 2, 3])
+    assert len(line) == 4 and line[0] == "▁" and line[-1] == "█"
+    assert len(sparkline(list(range(100)), width=16)) == 16
+
+
+# ------------------------------------------------------------------ report
+
+
+def test_report_includes_telemetry_section(tmp_path):
+    from autocycler_tpu.obs.report import build_report, render_html, \
+        render_report
+
+    reg = MetricsRegistry()
+    sampler = TimeseriesSampler(
+        tmp_path, interval=0.05, registry=reg,
+        extra=lambda: {"slo": {"objectives": {"p50_s": 5.0, "p95_s": None},
+                               "p50_s": 1.5, "p95_s": 2.0,
+                               "violated": False, "burn_rate": 0.2}})
+    reg.observe("autocycler_serve_job_seconds", 1.5,
+                buckets=SECONDS_BUCKETS, command="compress", help="h")
+    sampler.sample()
+    sampler.sample()
+    report = build_report(tmp_path)
+    assert report is not None and "timeseries" in report
+    assert report["timeseries"]["ticks"] == 2
+    assert report["timeseries"]["slo"]["burn_rate"] == 0.2
+    text = render_report(report)
+    assert "Continuous telemetry:" in text and "SLO" in text
+    html = render_html(report)
+    assert "Continuous telemetry" in html and "SLO met" in html
+
+
+def test_report_telemetry_never_raises_on_garbage(tmp_path):
+    from autocycler_tpu.obs.report import build_report, render_report
+
+    path = tmp_path / TIMESERIES_JSONL
+    path.write_text('{"ts": "x", "gauges": 3}\nnot json\n'
+                    '{"tick": 1, "hists": {"k": null}}\n')
+    report = build_report(tmp_path)
+    assert report is not None
+    assert "Continuous telemetry:" in render_report(report)
