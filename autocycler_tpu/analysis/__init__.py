@@ -1,0 +1,24 @@
+"""Static analysis for the repo's own invariants (`autocycler lint`).
+
+A self-contained AST-walking rule engine (stdlib ``ast`` only) that
+enforces the conventions the codebase runs on but nothing else checks:
+knob-registry discipline, lock discipline around module-level state,
+JAX purity inside jitted call graphs, never-raise reader contracts, and
+Prometheus metric/span naming.  See docs/static-analysis.md.
+"""
+
+from .engine import (Finding, LintContext, Module, load_baseline, run_lint,
+                     split_baseline, write_baseline)
+from .rules import ALL_RULES, rule_ids
+
+__all__ = [
+    "ALL_RULES",
+    "Finding",
+    "LintContext",
+    "Module",
+    "load_baseline",
+    "rule_ids",
+    "run_lint",
+    "split_baseline",
+    "write_baseline",
+]

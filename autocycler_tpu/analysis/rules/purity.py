@@ -1,0 +1,123 @@
+"""JAX purity inside jitted call graphs.
+
+``purity.impure-call`` flags host side effects and device-sync coercions
+inside any function reachable from a ``jax.jit``/``jax.vmap``/
+``pallas_call`` site in the same module: ``time.*``, stdlib ``random.*``
+and ``np.random.*`` (``jax.random`` is pure and stays legal),
+``os.environ``, ``print``, ``open``, ``.item()`` and ``float(...)``
+coercions.  A stale closure or host callback inside a jitted function
+silently poisons the persistent compile cache; this holds the line
+statically.
+
+Reachability is a module-local, name-based call graph: decoration sites
+(``@jit`` / ``@partial(jax.jit, ...)``) plus first-argument function
+references (``jax.jit(fn)``, ``vmap(fn)``, ``pl.pallas_call(kernel)``)
+seed a BFS over plain-name calls.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Set
+
+from ..engine import Finding, LintContext, Module
+
+JIT_WRAPPERS = {"jit", "vmap", "pallas_call"}
+
+
+def _callee_name(fn) -> str:
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return ""
+
+
+def jit_roots(mod: Module, funcs: Dict[str, ast.AST]) -> Set[str]:
+    roots: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                if _callee_name(target) in JIT_WRAPPERS:
+                    roots.add(node.name)
+                elif isinstance(dec, ast.Call) \
+                        and _callee_name(dec.func) == "partial" \
+                        and dec.args \
+                        and _callee_name(dec.args[0]) in JIT_WRAPPERS:
+                    roots.add(node.name)
+        elif isinstance(node, ast.Call) \
+                and _callee_name(node.func) in JIT_WRAPPERS \
+                and node.args and isinstance(node.args[0], ast.Name):
+            roots.add(node.args[0].id)
+    return roots & set(funcs)
+
+
+def _reachable(funcs: Dict[str, ast.AST], roots: Set[str]) -> Set[str]:
+    reach: Set[str] = set()
+    stack = list(roots)
+    while stack:
+        name = stack.pop()
+        if name in reach:
+            continue
+        reach.add(name)
+        for node in ast.walk(funcs[name]):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id in funcs \
+                    and node.func.id not in reach:
+                stack.append(node.func.id)
+    return reach
+
+
+def _impure_reason(node: ast.AST) -> str:
+    if isinstance(node, ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Name):
+            if fn.id == "print":
+                return "print() (host side effect)"
+            if fn.id == "open":
+                return "open() (host I/O)"
+            if fn.id == "float" and node.args:
+                return "float(...) coercion (forces device sync)"
+        elif isinstance(fn, ast.Attribute):
+            if isinstance(fn.value, ast.Name) and fn.value.id == "time":
+                return f"time.{fn.attr}() (host clock)"
+            if isinstance(fn.value, ast.Name) and fn.value.id == "random":
+                return f"random.{fn.attr}() (host RNG; use jax.random)"
+            if isinstance(fn.value, ast.Attribute) \
+                    and fn.value.attr == "random" \
+                    and isinstance(fn.value.value, ast.Name) \
+                    and fn.value.value.id in ("np", "numpy"):
+                return (f"np.random.{fn.attr}() (host RNG; "
+                        "use jax.random)")
+            if fn.attr == "item" and not node.args:
+                return ".item() (forces device sync)"
+    elif isinstance(node, ast.Attribute) and node.attr == "environ" \
+            and isinstance(node.value, ast.Name) and node.value.id == "os":
+        return "os.environ access (host state)"
+    return ""
+
+
+class PurityRules:
+    name = "purity"
+    ids = ("purity.impure-call",)
+
+    def check_module(self, mod: Module, ctx: LintContext
+                     ) -> Iterable[Finding]:
+        funcs: Dict[str, ast.AST] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                funcs.setdefault(node.name, node)
+        roots = jit_roots(mod, funcs)
+        if not roots:
+            return
+        for name in sorted(_reachable(funcs, roots)):
+            func = funcs[name]
+            for node in ast.walk(func):
+                reason = _impure_reason(node)
+                if reason:
+                    yield Finding(
+                        "purity.impure-call", mod.rel, node.lineno,
+                        f"{reason} inside '{name}', which is reachable "
+                        "from a jit/vmap/pallas_call site")

@@ -13,6 +13,7 @@ import sys
 
 from . import __version__
 from .utils import AutocyclerError
+from .utils.knobs import knob_str
 
 BANNER = r"""                _                        _
      /\        | |                      | |
@@ -140,6 +141,31 @@ def build_parser() -> argparse.ArgumentParser:
                    help="failed/hung subprocess retries with exponential "
                         "backoff (default 0)")
     p.add_argument("--args", dest="extra_args", nargs="+", default=[])
+
+    p = sub.add_parser("lint",
+                       help="statically check the repo's own invariants "
+                            "(knob registry, lock discipline, JAX purity, "
+                            "reader contracts, metric naming)")
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to lint (default: the package, "
+                        "bench.py and pipelines/)")
+    p.add_argument("--json", action="store_true",
+                   help="emit findings as JSON")
+    p.add_argument("--rule", action="append", default=None, metavar="ID",
+                   help="only run this rule id or family prefix "
+                        "(repeatable, e.g. --rule knobs)")
+    p.add_argument("--baseline", default=None, metavar="PATH",
+                   help="baseline file of accepted findings "
+                        "(default: lint_baseline.json at the repo root)")
+    p.add_argument("--write-baseline", default=None, metavar="PATH",
+                   help="accept the current findings: write them as the "
+                        "new baseline and exit 0")
+    p.add_argument("--report", default=None, metavar="PATH",
+                   help="also write a lint_report.json artifact readable "
+                        "by `autocycler report`")
+    p.add_argument("--knobs-md", action="store_true",
+                   help="print the generated AUTOCYCLER_* knob table "
+                        "(markdown) and exit")
 
     p = sub.add_parser("report",
                        help="render a run's telemetry (trace spans, metrics, "
@@ -312,6 +338,12 @@ def dispatch(args) -> int:
         helper(args.task, args.reads, args.out_prefix, args.genome_size, args.threads,
                args.dir, args.read_type, args.min_depth_abs, args.min_depth_rel,
                args.extra_args, timeout=args.timeout, retries=args.retries)
+    elif args.command == "lint":
+        from .commands.lint import lint
+        return lint(paths=args.paths or None, baseline=args.baseline,
+                    rules=args.rule, as_json=args.json,
+                    write_baseline_path=args.write_baseline,
+                    report_path=args.report, knobs_md=args.knobs_md)
     elif args.command == "report":
         from .obs.report import report
         return report(args.run_dir, as_json=args.json, html=args.html)
@@ -396,14 +428,14 @@ def main(argv=None) -> int:
     # JOB (each job's run dir gets its own trace/QC/ledger), and `submit`
     # is a thin client.
     may_own_run = args.command not in ("report", "doctor", "watch", "top",
-                                       "serve", "submit")
+                                       "serve", "submit", "lint")
     # continuous telemetry rides the same run dir as the trace: one
     # background thread, one timeseries.jsonl next to trace.jsonl. The
     # sampler starts BEFORE the run clock and stops AFTER it closes, so
     # thread spawn/join never shows up as untraced wall time inside the
     # run (the stage-tree/wall agreement must hold on millisecond runs).
     sampler = None
-    trace_target = os.environ.get("AUTOCYCLER_TRACE_DIR", "").strip()
+    trace_target = (knob_str("AUTOCYCLER_TRACE_DIR") or "").strip()
     if may_own_run and trace_target:
         from .obs import timeseries
         if timeseries.timeseries_enabled():
@@ -416,7 +448,8 @@ def main(argv=None) -> int:
         from .obs import ledger, qc
         qc.reset()
         ledger.reset()
-    if args.command not in ("report", "doctor", "watch", "top", "submit"):
+    if args.command not in ("report", "doctor", "watch", "top", "submit",
+                            "lint"):
         from .obs import sentinel
         sentinel.maybe_start_watcher()
         # Kick off the device probe on a background thread now, so its
@@ -445,7 +478,7 @@ def main(argv=None) -> int:
                 ledger.write_ledger(run_dir, command=args.command)
         if sampler is not None:
             sampler.stop()   # outside the run wall; takes the final tick
-        metrics_path = os.environ.get("AUTOCYCLER_METRICS")
+        metrics_path = knob_str("AUTOCYCLER_METRICS")
         if metrics_path:
             trace.write_metrics_file(metrics_path)
     return int(rc) if rc else 0

@@ -34,8 +34,10 @@ def clean_cache(cache_dir) -> None:
     """`autocycler clean --cache <dir>`: purge the warm-start cache under
     an autocycler dir (or a cache dir itself), plus any rotated
     continuous-telemetry series (``timeseries.jsonl`` at the root and
-    under serve job dirs). A daemon's shared cache is LRU-capped
-    automatically; this is the manual full reset."""
+    under serve job dirs) and any ``lint_report.json`` artifact (the
+    committed ``lint_baseline.json`` is config, not cache, and is kept).
+    A daemon's shared cache is LRU-capped automatically; this is the
+    manual full reset."""
     if not os.path.isdir(cache_dir):
         quit_with_error(f"directory does not exist: {cache_dir}")
     removed, reclaimed = purge_cache(cache_dir)
@@ -47,6 +49,14 @@ def clean_cache(cache_dir) -> None:
         log.message(f"Purged telemetry series under {cache_dir}: "
                     f"{ts_removed} file{'' if ts_removed == 1 else 's'}, "
                     f"{ts_reclaimed} bytes reclaimed")
+    # lint_report.json is a derived artifact (`autocycler lint --report`
+    # regenerates it); lint_baseline.json is configuration and survives
+    report_path = os.path.join(cache_dir, "lint_report.json")
+    if os.path.isfile(report_path):
+        report_bytes = os.path.getsize(report_path)
+        os.remove(report_path)
+        log.message(f"Purged lint report {report_path}: "
+                    f"{report_bytes} bytes reclaimed")
     log.message()
 
 

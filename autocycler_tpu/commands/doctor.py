@@ -18,6 +18,7 @@ import time
 from pathlib import Path
 
 from ..obs import sentinel
+from ..utils.knobs import knob_bool, knob_float
 
 
 def negative_cache_state(run_dir: str = ".") -> dict:
@@ -25,10 +26,7 @@ def negative_cache_state(run_dir: str = ".") -> dict:
     evidence: looks in ``run_dir`` and ``run_dir/.cache`` (where compress/
     batch put it). Reports freshness against the active TTL so the reader
     knows whether the cache is still suppressing probes."""
-    try:
-        ttl = float(os.environ.get("AUTOCYCLER_PROBE_NEG_TTL_S", "300"))
-    except ValueError:
-        ttl = 300.0
+    ttl = float(knob_float("AUTOCYCLER_PROBE_NEG_TTL_S"))
     for cand in (Path(run_dir) / "device_probe.json",
                  Path(run_dir) / ".cache" / "device_probe.json"):
         try:
@@ -108,6 +106,31 @@ def recommended_actions(probe_state: dict, neg_cache: dict, env: dict,
     return actions
 
 
+def lint_state(run_dir: str = ".") -> dict:
+    """Static-analysis posture: the committed lint baseline (when this is
+    a source tree) and any ``lint_report.json`` artifact in the run dir.
+    Never raises — doctor must work anywhere."""
+    out: dict = {"baseline": None, "baselined": 0, "report": None}
+    try:
+        from .lint import repo_root
+        baseline = repo_root() / "lint_baseline.json"
+        if baseline.is_file():
+            data = json.loads(baseline.read_text())
+            out["baseline"] = str(baseline)
+            out["baselined"] = len(data.get("findings") or [])
+        report = Path(run_dir) / "lint_report.json"
+        if report.is_file():
+            data = json.loads(report.read_text())
+            out["report"] = {
+                "findings": len(data.get("findings") or []),
+                "files": data.get("files"),
+                "wall_s": data.get("wall_s"),
+            }
+    except Exception:
+        pass
+    return out
+
+
 def gather(run_dir: str = ".") -> dict:
     """Everything doctor knows, as one dict (the ``--json`` payload)."""
     from ..ops.distance import device_probe_report, probe_overlap_report
@@ -126,6 +149,7 @@ def gather(run_dir: str = ".") -> dict:
         "async_probe": async_probe,
         "negative_cache": neg_cache,
         "probe_log": {"path": str(log_path), "entries": history},
+        "lint": lint_state(run_dir),
         "actions": recommended_actions(probe_state, neg_cache, env, history),
     }
 
@@ -147,6 +171,15 @@ def _render_text(report: dict) -> None:
     if env["env"]:
         print("knobs: " + ", ".join(f"{k}={v}" for k, v
                                     in sorted(env["env"].items())))
+    lint = report.get("lint") or {}
+    if lint.get("baseline"):
+        line = (f"lint: baseline present "
+                f"({lint.get('baselined', 0)} accepted finding(s))")
+        rep = lint.get("report")
+        if isinstance(rep, dict):
+            line += (f"; last report: {rep.get('findings')} new across "
+                     f"{rep.get('files')} files")
+        print(line)
 
     ps = report["probe_state"]
     print("\nlast in-process probe")
@@ -217,7 +250,7 @@ def doctor(run_dir: str = ".", as_json: bool = False, watch: bool = False,
     with the recovery auto-capture hook armed."""
     sentinel.set_probe_log_dir(run_dir, fallback=True)
     if watch:
-        if os.environ.get("AUTOCYCLER_RECOVERY_CAPTURE", "1") != "0":
+        if knob_bool("AUTOCYCLER_RECOVERY_CAPTURE"):
             sentinel.on_recovery(sentinel.recovery_capture)
         iv = interval if interval is not None else (
             sentinel.watch_interval() or 30.0)

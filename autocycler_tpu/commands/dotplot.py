@@ -363,7 +363,8 @@ def _find_font():
     5. `fc-match` if fontconfig's CLI is available.
     Falls back to PIL's bitmap font with a stderr note (labels then cannot
     scale)."""
-    override = os.environ.get("AUTOCYCLER_DOTPLOT_FONT")
+    from ..utils.knobs import knob_str
+    override = knob_str("AUTOCYCLER_DOTPLOT_FONT")
     if override:
         if Path(override).is_file():
             return override

@@ -25,7 +25,8 @@ def _lib_path() -> Path:
     """AUTOCYCLER_NATIVE_LIB overrides the source-tree location — installed
     packages (pip/containers) don't carry native/, so deployments point this
     at wherever they built libseqkernel.so."""
-    override = os.environ.get("AUTOCYCLER_NATIVE_LIB")
+    from .utils.knobs import knob_str
+    override = knob_str("AUTOCYCLER_NATIVE_LIB")
     if override:
         return Path(override)
     return _NATIVE_DIR / "libseqkernel.so"

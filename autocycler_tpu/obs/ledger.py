@@ -159,7 +159,8 @@ def _cache_lineage() -> dict:
             "misses": int(reg.value("autocycler_cache_events_total",
                                     cache=which, event="miss")),
         }
-    compile_dir = os.environ.get("AUTOCYCLER_COMPILE_CACHE", "").strip()
+    from ..utils.knobs import knob_str
+    compile_dir = (knob_str("AUTOCYCLER_COMPILE_CACHE") or "").strip()
     lineage["compile"] = {"enabled": bool(compile_dir),
                           "dir": compile_dir or None}
     probe: dict = {

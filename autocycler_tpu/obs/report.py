@@ -15,7 +15,9 @@ Inputs, all optional except that at least one must exist in the directory:
   cluster pass/fail verdicts, trim decisions, bridge support;
 - ``ledger.json`` (obs.ledger) — input hashes, versions, env knobs, cache
   lineage and per-stage artifact hashes;
-- ``BENCH*.json`` bench artifacts — one summary line each.
+- ``BENCH*.json`` bench artifacts — one summary line each;
+- ``lint_report.json`` (commands.lint ``--report``) — the static-analysis
+  verdict, file count and findings.
 
 ``--json`` emits the merged structure as one JSON document instead, and
 ``--html`` additionally writes a self-contained ``run_report.html``.
@@ -36,6 +38,7 @@ from .timeseries import (TIMESERIES_JSONL, read_timeseries,
 from .trace import METRICS_JSON, TRACE_JSONL
 
 RUN_REPORT_HTML = "run_report.html"
+LINT_REPORT_JSON = "lint_report.json"
 
 # report total vs recorded wall-clock agreement gate (the acceptance bar:
 # a stage tree that disagrees with the wall by more than this is reported
@@ -204,6 +207,15 @@ def build_report(run_dir) -> Optional[dict]:
             continue
         if isinstance(data, dict):
             bench.append({"file": path.name, **data})
+    lint = None
+    lint_path = run_dir / LINT_REPORT_JSON
+    if lint_path.is_file():
+        try:
+            data = json.loads(lint_path.read_text())
+        except (OSError, json.JSONDecodeError):
+            data = None
+        if isinstance(data, dict):
+            lint = data
     timeseries = None
     ts_entries = read_timeseries(run_dir / TIMESERIES_JSONL)
     if ts_entries:
@@ -216,7 +228,7 @@ def build_report(run_dir) -> Optional[dict]:
             timeseries["slo"] = slo
     if trace is None and metrics is None and manifest is None \
             and qc is None and ledger is None and not bench \
-            and timeseries is None:
+            and timeseries is None and lint is None:
         return None
     report: dict = {"dir": str(run_dir)}
     if trace is not None:
@@ -243,6 +255,8 @@ def build_report(run_dir) -> Optional[dict]:
         report["bench"] = bench
     if timeseries is not None:
         report["timeseries"] = timeseries
+    if lint is not None:
+        report["lint"] = lint
     return report
 
 
@@ -419,6 +433,11 @@ def render_report(report: dict) -> str:
         lines.append("Provenance:")
         _render_ledger_lines(ledger, lines)
         lines.append("")
+    lint = report.get("lint")
+    if lint:
+        lines.append("Static analysis:")
+        _render_lint_lines(lint, lines)
+        lines.append("")
     for artifact in report.get("bench", []):
         if "metric" in artifact:
             line = (f"Bench {artifact['file']}: {artifact['metric']} = "
@@ -502,6 +521,33 @@ def _render_qc_lines(qc: dict, lines: List[str]) -> None:
             lines.append(f"{prefix}{stage}: " + ", ".join(
                 f"{k}={v}" for k, v in sorted(scalars.items())) if scalars
                 else f"{prefix}{stage}")
+
+
+def _render_lint_lines(lint: dict, lines: List[str]) -> None:
+    """The static-analysis section from a lint_report.json artifact
+    (written by `autocycler lint --report`); every field optional."""
+    if not isinstance(lint, dict):
+        return
+    findings = lint.get("findings")
+    findings = findings if isinstance(findings, list) else []
+    verdict = "clean" if not findings else f"{len(findings)} finding(s)"
+    bits = [verdict]
+    files = lint.get("files")
+    if files is not None:
+        bits.append(f"{files} files")
+    wall = lint.get("wall_s")
+    if isinstance(wall, (int, float)):
+        bits.append(f"{wall:.2f}s")
+    baselined = lint.get("baselined")
+    if baselined:
+        bits.append(f"{baselined} baselined")
+    lines.append("  lint: " + ", ".join(bits))
+    for f in findings[:20]:
+        if isinstance(f, dict):
+            lines.append(f"    {f.get('path')}:{f.get('line')} "
+                         f"[{f.get('rule')}] {f.get('message')}")
+    if len(findings) > 20:
+        lines.append(f"    ... and {len(findings) - 20} more")
 
 
 def _render_ledger_lines(ledger: dict, lines: List[str]) -> None:
@@ -685,6 +731,24 @@ def render_html(report: dict) -> str:
                            if slo.get("violated")
                            else "<span class=\"pass\">SLO met</span>")
                 parts.append(f"<p>{verdict}</p>")
+    lint = report.get("lint")
+    if lint:
+        parts.append("<h2>Static analysis</h2>")
+        findings = lint.get("findings")
+        findings = findings if isinstance(findings, list) else []
+        verdict = ("<span class=\"pass\">clean</span>" if not findings
+                   else f"<span class=\"fail\">{len(findings)} "
+                        "finding(s)</span>")
+        lint_lines: List[str] = []
+        _render_lint_lines(lint, lint_lines)
+        parts.append(f"<p>lint: {verdict}</p>")
+        parts.append("<pre>" + _esc("\n".join(lint_lines)) + "</pre>")
+        if findings:
+            rows = [(f.get("rule", "?"), f.get("path", "?"),
+                     f.get("line", "?"), f.get("message", "?"))
+                    for f in findings if isinstance(f, dict)]
+            parts.extend(_html_kv_table(
+                rows, ("rule", "path", "line", "message")))
     metrics = report.get("metrics")
     if metrics:
         dev_s = _metric_total(metrics, "autocycler_device_seconds_total")
@@ -717,8 +781,8 @@ def report(run_dir, as_json: bool = False,
     if built is None:
         print(f"Error: no telemetry found in {run_dir} (expected "
               f"{TRACE_JSONL}, {METRICS_JSON}, {QC_REPORT_JSON}, "
-              f"{LEDGER_JSON}, {TIMESERIES_JSONL}, batch_manifest.json or "
-              "BENCH*.json)", file=sys.stderr)
+              f"{LEDGER_JSON}, {TIMESERIES_JSONL}, {LINT_REPORT_JSON}, "
+              "batch_manifest.json or BENCH*.json)", file=sys.stderr)
         return 1
     if html is not None:
         out = Path(html) if html else Path(run_dir) / RUN_REPORT_HTML

@@ -41,6 +41,9 @@ import time
 from pathlib import Path
 from typing import Callable, List, Optional
 
+from ..utils.knobs import (knob_bool, knob_float, knob_int, knob_raw,
+                           knob_str)
+
 PROBE_LOG = "probe_log.jsonl"
 RECOVERY_CAPTURE_FILE = "recovery_capture.json"
 _MARKER = "AUTOCYCLER_PROBE:"
@@ -209,7 +212,7 @@ def probe_log_path() -> Optional[Path]:
         explicit, fallback = _log_dir, _fallback_dir
     if explicit:
         return Path(explicit) / PROBE_LOG
-    env = os.environ.get("AUTOCYCLER_TRACE_DIR", "").strip()
+    env = (knob_str("AUTOCYCLER_TRACE_DIR") or "").strip()
     if env:
         return Path(env) / PROBE_LOG
     if fallback:
@@ -220,11 +223,7 @@ def probe_log_path() -> Optional[Path]:
 def probe_log_max() -> int:
     """Probe-log rotation cap: keep only the newest N entries
     (AUTOCYCLER_PROBE_LOG_MAX, default 500; 0 disables rotation)."""
-    raw = os.environ.get("AUTOCYCLER_PROBE_LOG_MAX", "").strip()
-    try:
-        return max(0, int(raw)) if raw else 500
-    except ValueError:
-        return 500
+    return max(0, int(knob_int("AUTOCYCLER_PROBE_LOG_MAX")))
 
 
 def append_probe_log(entry: dict) -> None:
@@ -387,7 +386,7 @@ def recovery_capture(outcome: Optional[dict] = None,
         if backend == "tpu":
             from ..ops.dotplot_pallas import benchmark_gcells
             from ..ops.mfu import vpu_grid_mfu
-            n = _env_int("AUTOCYCLER_RECOVERY_DOTPLOT_N", 65536)
+            n = int(knob_int("AUTOCYCLER_RECOVERY_DOTPLOT_N"))
             k = 32
             _, rate = benchmark_gcells(n_a=n, n_b=n, k=k, repeats=1,
                                        kernel="vpu")
@@ -403,7 +402,7 @@ def recovery_capture(outcome: Optional[dict] = None,
         import numpy as np
 
         from ..ops.kmers import group_windows_full
-        n = int(_env_float("AUTOCYCLER_RECOVERY_GROUPING_MBP", 2.0) * 1e6)
+        n = int(knob_float("AUTOCYCLER_RECOVERY_GROUPING_MBP") * 1e6)
         k = 51
         rng = np.random.default_rng(7)
         codes = rng.integers(1, 5, size=max(n, k + 2)).astype(np.uint8)
@@ -437,20 +436,6 @@ def recovery_capture(outcome: Optional[dict] = None,
     return result
 
 
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, default))
-    except ValueError:
-        return default
-
-
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        return default
-
-
 #: Default deadline for the BACKGROUND (overlapped) probe. Deliberately
 #: lower than the legacy synchronous 60 s default: the background probe
 #: overlaps host load/parse work, so its deadline bounds attach *lateness*
@@ -468,15 +453,13 @@ def probe_deadline(background: bool = False) -> float:
     the legacy gate), :data:`BACKGROUND_PROBE_DEADLINE_S` when
     ``background`` (the overlapped probe started at CLI launch)."""
     default = BACKGROUND_PROBE_DEADLINE_S if background else 60.0
-    raw = os.environ.get("AUTOCYCLER_PROBE_DEADLINE_S")
-    if raw is None:
-        raw = os.environ.get("AUTOCYCLER_DEVICE_PROBE_TIMEOUT")
-    if raw is None:
-        return default
-    try:
-        return float(raw)
-    except ValueError:
-        return default
+    if knob_raw("AUTOCYCLER_PROBE_DEADLINE_S") is not None:
+        return float(knob_float("AUTOCYCLER_PROBE_DEADLINE_S",
+                                default=default))
+    if knob_raw("AUTOCYCLER_DEVICE_PROBE_TIMEOUT") is not None:
+        return float(knob_float("AUTOCYCLER_DEVICE_PROBE_TIMEOUT",
+                                default=default))
+    return default
 
 
 # ---- the watcher ----
@@ -518,14 +501,8 @@ class ProbeWatcher:
 
 def watch_interval() -> Optional[float]:
     """AUTOCYCLER_PROBE_WATCH as seconds; unset/<= 0/malformed disables."""
-    raw = os.environ.get("AUTOCYCLER_PROBE_WATCH", "").strip()
-    if not raw:
-        return None
-    try:
-        interval = float(raw)
-    except ValueError:
-        print("autocycler: ignoring malformed AUTOCYCLER_PROBE_WATCH "
-              f"({raw!r})", file=sys.stderr)
+    interval = knob_float("AUTOCYCLER_PROBE_WATCH")
+    if interval is None:
         return None
     return interval if interval > 0 else None
 
@@ -542,7 +519,7 @@ def maybe_start_watcher() -> Optional[threading.Thread]:
     with _lock:
         if _watcher_thread is not None and _watcher_thread.is_alive():
             return _watcher_thread
-    if os.environ.get("AUTOCYCLER_RECOVERY_CAPTURE", "1") != "0":
+    if knob_bool("AUTOCYCLER_RECOVERY_CAPTURE"):
         with _lock:
             if recovery_capture not in _hooks:
                 _hooks.append(recovery_capture)

@@ -43,6 +43,7 @@ from pathlib import Path
 from typing import Callable, Dict, List, Optional
 
 from . import metrics_registry
+from ..utils.knobs import knob_bool, knob_float, knob_int
 
 TIMESERIES_JSONL = "timeseries.jsonl"
 
@@ -51,30 +52,19 @@ TIMESERIES_JSONL = "timeseries.jsonl"
 TICKS_TOTAL = "autocycler_timeseries_ticks_total"
 LAST_TICK_EPOCH = "autocycler_timeseries_last_tick_epoch"
 
-DEFAULT_INTERVAL_S = 5.0
-DEFAULT_MAX_LINES = 2000
-
-
 def timeseries_enabled() -> bool:
-    """Sampling is on by default; AUTOCYCLER_TIMESERIES=0 turns it off."""
-    return os.environ.get("AUTOCYCLER_TIMESERIES", "").strip() != "0"
+    """Sampling is on by default; AUTOCYCLER_TIMESERIES=0/false/no/off
+    turns it off."""
+    return knob_bool("AUTOCYCLER_TIMESERIES")
 
 
 def sample_interval() -> float:
-    raw = os.environ.get("AUTOCYCLER_TIMESERIES_INTERVAL_S", "").strip()
-    try:
-        return max(0.05, float(raw)) if raw else DEFAULT_INTERVAL_S
-    except ValueError:
-        return DEFAULT_INTERVAL_S
+    return max(0.05, float(knob_float("AUTOCYCLER_TIMESERIES_INTERVAL_S")))
 
 
 def timeseries_max() -> int:
     """Rotation cap: keep only the newest N lines (0 disables rotation)."""
-    raw = os.environ.get("AUTOCYCLER_TIMESERIES_MAX", "").strip()
-    try:
-        return max(0, int(raw)) if raw else DEFAULT_MAX_LINES
-    except ValueError:
-        return DEFAULT_MAX_LINES
+    return max(0, int(knob_int("AUTOCYCLER_TIMESERIES_MAX")))
 
 
 # ---- host load ----

@@ -208,7 +208,8 @@ def maybe_start_run(name: str = "run") -> bool:
     """Start a run from ``AUTOCYCLER_TRACE_DIR`` when the variable is set
     and no run is active; returns True when this call started one (and so
     owns the matching :func:`finish_run`)."""
-    target = os.environ.get("AUTOCYCLER_TRACE_DIR", "").strip()
+    from ..utils.knobs import knob_str
+    target = (knob_str("AUTOCYCLER_TRACE_DIR") or "").strip()
     if not target or _run is not None:
         return False
     try:

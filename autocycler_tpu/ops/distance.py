@@ -95,14 +95,8 @@ def notify_probe_recovered() -> None:
 
 
 def _probe_neg_ttl() -> float:
-    import os
-    import sys
-    try:
-        return float(os.environ.get("AUTOCYCLER_PROBE_NEG_TTL_S", "300"))
-    except ValueError:
-        print("autocycler: ignoring malformed AUTOCYCLER_PROBE_NEG_TTL_S",
-              file=sys.stderr)
-        return 300.0
+    from ..utils.knobs import knob_float
+    return float(knob_float("AUTOCYCLER_PROBE_NEG_TTL_S"))
 
 
 def _disk_probe_load():
@@ -268,15 +262,12 @@ def _tpu_attached() -> bool:
     # takes precedence; AUTOCYCLER_DEVICE_PROBE_TIMEOUT remains as the
     # original spelling. Same semantics either way (<= 0 disables the
     # device path outright).
-    raw_deadline = os.environ.get("AUTOCYCLER_PROBE_DEADLINE_S")
-    if raw_deadline is None:
-        raw_deadline = os.environ.get("AUTOCYCLER_DEVICE_PROBE_TIMEOUT", "60")
-    try:
-        timeout = float(raw_deadline)
-    except ValueError:
-        print("autocycler: ignoring malformed probe deadline "
-              f"({raw_deadline!r})", file=sys.stderr)
-        timeout = 60.0
+    from ..utils.knobs import knob_float, knob_raw
+    if knob_raw("AUTOCYCLER_PROBE_DEADLINE_S") is not None:
+        timeout = float(knob_float("AUTOCYCLER_PROBE_DEADLINE_S",
+                                   default=60.0))
+    else:
+        timeout = float(knob_float("AUTOCYCLER_DEVICE_PROBE_TIMEOUT"))
     if timeout <= 0:       # explicit kill switch: host backends, no probe
         _record_probe(False, 0.0,
                       "AUTOCYCLER_DEVICE_PROBE_TIMEOUT <= 0 disables the "
@@ -288,13 +279,7 @@ def _tpu_attached() -> bool:
         if st.get("cached"):
             if st["attached"]:
                 return True
-            try:
-                ttl = float(os.environ.get("AUTOCYCLER_DEVICE_PROBE_TTL",
-                                           "120"))
-            except ValueError:
-                print("autocycler: ignoring malformed "
-                      "AUTOCYCLER_DEVICE_PROBE_TTL", file=sys.stderr)
-                ttl = 120.0
+            ttl = float(knob_float("AUTOCYCLER_DEVICE_PROBE_TTL"))
             # exponential backoff: consecutive failures double the wait
             # before the next re-probe (a dead tunnel would otherwise cost
             # a probe-deadline stall every TTL for the whole run)
@@ -349,9 +334,8 @@ def _probe_mode() -> str:
     a wedged transport becomes kind="timeout" WITH the init chatter that
     explains it. "inline" keeps the in-process thread probe (tests pin
     it; also the mode for hosts where fork/exec is unwelcome)."""
-    import os
-    return os.environ.get("AUTOCYCLER_PROBE_MODE",
-                          "subprocess").strip().lower()
+    from ..utils.knobs import knob_str
+    return knob_str("AUTOCYCLER_PROBE_MODE").strip().lower()
 
 
 def _probe_attempt(timeout: float, mode: str = None
@@ -446,21 +430,9 @@ def _probe_retries() -> Tuple[int, float]:
     """(bounded retry count, initial backoff seconds) for the background
     probe — retries happen BEFORE the persisted negative cache is written,
     so one transient wedge doesn't poison warm runs for the full TTL."""
-    import os
-    import sys
-    try:
-        retries = max(0, int(os.environ.get("AUTOCYCLER_PROBE_RETRIES", "1")))
-    except ValueError:
-        print("autocycler: ignoring malformed AUTOCYCLER_PROBE_RETRIES",
-              file=sys.stderr)
-        retries = 1
-    try:
-        backoff = float(os.environ.get("AUTOCYCLER_PROBE_RETRY_BACKOFF_S",
-                                       "2.0"))
-    except ValueError:
-        print("autocycler: ignoring malformed "
-              "AUTOCYCLER_PROBE_RETRY_BACKOFF_S", file=sys.stderr)
-        backoff = 2.0
+    from ..utils.knobs import knob_float, knob_int
+    retries = max(0, int(knob_int("AUTOCYCLER_PROBE_RETRIES")))
+    backoff = float(knob_float("AUTOCYCLER_PROBE_RETRY_BACKOFF_S"))
     return retries, max(0.0, backoff)
 
 

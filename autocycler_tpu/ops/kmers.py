@@ -29,6 +29,8 @@ from typing import List, Optional, Tuple, Union
 
 import numpy as np
 
+from ..utils.knobs import knob_int, knob_str
+
 from .encode import encode_bytes
 
 SYMS_PER_WORD = 10  # 3 bits per symbol in an int32 (numpy fallback packing)
@@ -61,7 +63,7 @@ def _resolve_use_jax(use_jax: UseJax) -> UseJax:
     ignoring a typo."""
     if use_jax is not None:
         return use_jax
-    value = os.environ.get("AUTOCYCLER_DEVICE_GROUPING", "").strip().lower()
+    value = (knob_str("AUTOCYCLER_DEVICE_GROUPING") or "").strip().lower()
     if value in ("1", "true", "yes", "on"):
         from .distance import (device_attached, jax_backend_safe,
                                warn_backend_unsafe_once)
@@ -152,7 +154,7 @@ def _effective_workers(threads: int) -> int:
     AUTOCYCLER_GROUPING_EXECUTOR choice disables the core clamp — the
     operator (or the parity suite, on single-core CI) asked for that
     executor and gets the requested width."""
-    if os.environ.get("AUTOCYCLER_GROUPING_EXECUTOR", "").strip():
+    if (knob_str("AUTOCYCLER_GROUPING_EXECUTOR") or "").strip():
         return max(1, threads)
     return max(1, min(threads, os.cpu_count() or 1))
 
@@ -161,11 +163,7 @@ def _radix_min_windows() -> int:
     """Below this window count the radix path's partition overhead outweighs
     the bucket wins; the single native/numpy call is used instead. Tests
     (and tiny-machine operators) override via AUTOCYCLER_RADIX_MIN_WINDOWS."""
-    try:
-        return int(os.environ.get("AUTOCYCLER_RADIX_MIN_WINDOWS",
-                                  str(1 << 17)))
-    except ValueError:
-        return 1 << 17
+    return int(knob_int("AUTOCYCLER_RADIX_MIN_WINDOWS"))
 
 
 def _host_radix_enabled(n: int, k: int, workers: int, partitions) -> bool:
@@ -178,7 +176,7 @@ def _host_radix_enabled(n: int, k: int, workers: int, partitions) -> bool:
         return False
     if partitions is not None:
         return True
-    mode = os.environ.get("AUTOCYCLER_HOST_GROUPING", "").strip().lower()
+    mode = (knob_str("AUTOCYCLER_HOST_GROUPING") or "").strip().lower()
     if mode == "radix":
         return True
     if mode in ("native", "numpy"):
@@ -297,7 +295,7 @@ def _chunk_pool_map(codes: np.ndarray, chunk_starts_list, k: int,
     pool for workloads where the GIL still binds."""
     if workers <= 1 or len(chunk_starts_list) <= 1:
         return [_radix_chunk_job(codes, cs, k) for cs in chunk_starts_list]
-    mode = os.environ.get("AUTOCYCLER_GROUPING_EXECUTOR", "").strip().lower()
+    mode = (knob_str("AUTOCYCLER_GROUPING_EXECUTOR") or "").strip().lower()
     if mode == "process":
         import multiprocessing as mp
         from concurrent.futures import ProcessPoolExecutor
@@ -870,7 +868,7 @@ def group_windows_full(codes: np.ndarray, starts: np.ndarray, k: int,
         return gid, order
     # fused native pack + hash-grouping kernel (O(n) vs the comparison sort)
     from .. import native
-    host_mode = os.environ.get("AUTOCYCLER_HOST_GROUPING", "").strip().lower()
+    host_mode = (knob_str("AUTOCYCLER_HOST_GROUPING") or "").strip().lower()
     if host_mode != "numpy" and native.available():
         result = native.group_kmers_full(codes, starts, k)
         if result is not None:

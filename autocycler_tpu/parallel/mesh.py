@@ -47,16 +47,11 @@ def _devices_with_deadline():
     jax.numpy) can block on the same init lock or race a half-initialised
     backend. Callers that want to survive a wedged device must run host
     fallbacks in a fresh process, or pin JAX_PLATFORMS=cpu up front."""
-    import os
-    import sys
     import threading
 
-    try:
-        timeout = float(os.environ.get("AUTOCYCLER_MESH_INIT_TIMEOUT", "600"))
-    except ValueError:
-        print("autocycler: ignoring malformed AUTOCYCLER_MESH_INIT_TIMEOUT",
-              file=sys.stderr)
-        timeout = 600.0
+    from ..utils.knobs import knob_float
+
+    timeout = float(knob_float("AUTOCYCLER_MESH_INIT_TIMEOUT"))
     # consult the (possibly background-resolved) device probe before paying
     # for a watchdog thread: a probe that already attached (or pinned the
     # backend to host) proves jax.devices() returns promptly, and a probe

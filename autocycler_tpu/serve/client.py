@@ -56,7 +56,8 @@ def resolve_endpoint(server: Optional[str] = None,
             raise AutocyclerError(
                 f"cannot read daemon discovery file {info_path} "
                 f"({e}) — is `autocycler serve` running with that root?")
-    env = os.environ.get("AUTOCYCLER_SERVE", "").strip()
+    from ..utils.knobs import knob_str
+    env = (knob_str("AUTOCYCLER_SERVE") or "").strip()
     if env:
         return env
     return f"http://127.0.0.1:{DEFAULT_PORT}"

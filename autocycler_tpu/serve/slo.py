@@ -32,6 +32,7 @@ from typing import Dict, List, Optional
 
 from ..obs import metrics_registry
 from ..obs.metrics_registry import SECONDS_BUCKETS
+from ..utils.knobs import knob_float
 
 P50_ENV = "AUTOCYCLER_SLO_P50_S"
 P95_ENV = "AUTOCYCLER_SLO_P95_S"
@@ -59,22 +60,13 @@ def objectives() -> Dict[str, Optional[float]]:
     unparseable knobs mean "no objective"."""
     out: Dict[str, Optional[float]] = {}
     for key, env in (("p50_s", P50_ENV), ("p95_s", P95_ENV)):
-        raw = os.environ.get(env, "").strip()
-        try:
-            out[key] = float(raw) if raw else None
-        except ValueError:
-            out[key] = None
-        if out[key] is not None and out[key] <= 0:
-            out[key] = None
+        val = knob_float(env)
+        out[key] = val if (val is not None and val > 0) else None
     return out
 
 
 def window_seconds() -> float:
-    raw = os.environ.get(WINDOW_ENV, "").strip()
-    try:
-        return max(1.0, float(raw)) if raw else DEFAULT_WINDOW_S
-    except ValueError:
-        return DEFAULT_WINDOW_S
+    return max(1.0, float(knob_float(WINDOW_ENV)))
 
 
 def _percentile(values: List[float], q: float) -> float:

@@ -49,6 +49,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from ..obs import metrics_registry
+from .knobs import knob_bool, knob_int, knob_str
 
 # process-wide hit/miss accounting lives in the metrics registry
 # (obs.metrics_registry), inspectable by tests, artifacts and
@@ -78,20 +79,14 @@ def shared_cache_dir() -> Optional[Path]:
     with _shared_dir_lock:
         if _shared_dir is not None:
             return _shared_dir
-    env = os.environ.get("AUTOCYCLER_CACHE_DIR", "").strip()
+    env = (knob_str("AUTOCYCLER_CACHE_DIR") or "").strip()
     return Path(env) if env else None
 
 
 def cache_max_bytes() -> Optional[int]:
     """The eviction budget in bytes, or None when eviction is disabled
     (``AUTOCYCLER_CACHE_MAX_BYTES`` <= 0 or unparsable)."""
-    raw = os.environ.get("AUTOCYCLER_CACHE_MAX_BYTES", "").strip()
-    if not raw:
-        return DEFAULT_MAX_BYTES
-    try:
-        budget = int(raw)
-    except ValueError:
-        return DEFAULT_MAX_BYTES
+    budget = int(knob_int("AUTOCYCLER_CACHE_MAX_BYTES", default=DEFAULT_MAX_BYTES))
     return budget if budget > 0 else None
 
 
@@ -116,8 +111,7 @@ def _count(key: str) -> None:
 
 
 def cache_enabled() -> bool:
-    return os.environ.get("AUTOCYCLER_ENCODE_CACHE", "").strip().lower() \
-        not in ("0", "false", "no", "off", "disabled")
+    return knob_bool("AUTOCYCLER_ENCODE_CACHE")
 
 
 def content_hash(raw: bytes) -> str:

@@ -23,7 +23,8 @@ def configure_compile_cache() -> bool:
     number of times; failures (old jax, bad dir) degrade silently — the
     cache is an optimisation, never a correctness dependency."""
     global _configured
-    cache_dir = os.environ.get("AUTOCYCLER_COMPILE_CACHE", "").strip()
+    from .knobs import knob_str
+    cache_dir = (knob_str("AUTOCYCLER_COMPILE_CACHE") or "").strip()
     if not cache_dir:
         return False
     with _lock:

@@ -1,0 +1,449 @@
+"""Central registry for every AUTOCYCLER_* environment knob.
+
+Every tunable the package reads from the environment is declared here with
+a type, a default, and a one-line docstring.  All runtime reads go through
+the typed accessors (``knob_int``/``knob_float``/``knob_bool``/``knob_str``)
+so parsing semantics are uniform:
+
+- booleans: a set value of ``0``/``false``/``no``/``off`` (case-insensitive,
+  stripped) is False, any other non-empty value is True, unset/empty falls
+  back to the declared default;
+- numerics: malformed values fall back to the declared default with a single
+  stderr warning per knob per process instead of raising or silently passing;
+- strings: stripped only of nothing — returned verbatim, empty/unset falls
+  back to the declared default.
+
+``autocycler lint`` statically enforces that no module outside this file
+reads ``AUTOCYCLER_*`` names from ``os.environ`` directly, that every name
+read through the accessors is declared here, and that the registry and
+``docs/cli.md`` stay in sync (both directions).
+
+This module must stay import-light (no package-internal imports): it is
+imported by ``utils.log`` and other low-level modules.
+"""
+
+import os
+import sys
+import threading
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple, Union
+
+__all__ = [
+    "Knob",
+    "KNOBS",
+    "all_knobs",
+    "knob_bool",
+    "knob_float",
+    "knob_int",
+    "knob_raw",
+    "knob_set",
+    "knob_str",
+    "knobs_markdown",
+]
+
+Default = Union[str, int, float, bool, None]
+
+
+@dataclass(frozen=True)
+class Knob:
+    name: str
+    kind: str  # "str" | "int" | "float" | "bool"
+    default: Default
+    doc: str
+
+
+def _k(name: str, kind: str, default: Default, doc: str) -> Tuple[str, Knob]:
+    return name, Knob(name=name, kind=kind, default=default, doc=doc)
+
+
+# Declaration order is the order the generated docs table uses.
+KNOBS: Dict[str, Knob] = dict(
+    [
+        # --- observability -------------------------------------------------
+        _k(
+            "AUTOCYCLER_TRACE_DIR",
+            "str",
+            None,
+            "Root directory for per-run trace/artifact dirs; unset disables run tracing.",
+        ),
+        _k(
+            "AUTOCYCLER_METRICS",
+            "str",
+            None,
+            "Path to write a Prometheus text-format metrics dump at process exit.",
+        ),
+        _k(
+            "AUTOCYCLER_TIMINGS",
+            "bool",
+            False,
+            "Print a per-stage timing table to stderr at process exit.",
+        ),
+        _k(
+            "AUTOCYCLER_LOG_JSON",
+            "bool",
+            False,
+            "Emit log lines as structured JSON instead of ANSI-decorated text.",
+        ),
+        _k(
+            "AUTOCYCLER_PROFILE_DIR",
+            "str",
+            None,
+            "Directory for JAX profiler traces captured around device dispatches.",
+        ),
+        _k(
+            "AUTOCYCLER_XPROF",
+            "str",
+            None,
+            "Comma-separated stage names to profile (or 'all'); requires AUTOCYCLER_PROFILE_DIR.",
+        ),
+        _k(
+            "AUTOCYCLER_XPROF_LIMIT",
+            "int",
+            2,
+            "Maximum number of profiler captures per process.",
+        ),
+        _k(
+            "AUTOCYCLER_TIMESERIES",
+            "bool",
+            True,
+            "Enable the background time-series sampler when a run dir is active.",
+        ),
+        _k(
+            "AUTOCYCLER_TIMESERIES_INTERVAL_S",
+            "float",
+            5.0,
+            "Sampling interval in seconds for the time-series sampler.",
+        ),
+        _k(
+            "AUTOCYCLER_TIMESERIES_MAX",
+            "int",
+            2000,
+            "Maximum retained samples per timeseries.jsonl before rotation.",
+        ),
+        # --- device probe & recovery --------------------------------------
+        _k(
+            "AUTOCYCLER_PROBE_MODE",
+            "str",
+            "subprocess",
+            "Device probe isolation mode: 'subprocess' or 'inline'.",
+        ),
+        _k(
+            "AUTOCYCLER_DEVICE_PROBE_TIMEOUT",
+            "float",
+            60.0,
+            "Subprocess device-probe timeout in seconds.",
+        ),
+        _k(
+            "AUTOCYCLER_PROBE_DEADLINE_S",
+            "float",
+            None,
+            "Overall probe deadline in seconds; overrides AUTOCYCLER_DEVICE_PROBE_TIMEOUT when set; <=0 disables.",
+        ),
+        _k(
+            "AUTOCYCLER_DEVICE_PROBE_TTL",
+            "float",
+            120.0,
+            "Seconds a positive device-probe verdict stays cached; <=0 re-probes every call.",
+        ),
+        _k(
+            "AUTOCYCLER_PROBE_NEG_TTL_S",
+            "float",
+            300.0,
+            "Seconds a negative device-probe verdict stays cached on disk.",
+        ),
+        _k(
+            "AUTOCYCLER_PROBE_RETRIES",
+            "int",
+            1,
+            "Extra subprocess probe attempts after the first failure.",
+        ),
+        _k(
+            "AUTOCYCLER_PROBE_RETRY_BACKOFF_S",
+            "float",
+            2.0,
+            "Base backoff in seconds between probe retry attempts.",
+        ),
+        _k(
+            "AUTOCYCLER_PROBE_WATCH",
+            "float",
+            None,
+            "Interval in seconds for the background probe watcher; unset/invalid disables it.",
+        ),
+        _k(
+            "AUTOCYCLER_PROBE_LOG_MAX",
+            "int",
+            500,
+            "Maximum retained entries in the probe sentinel log.",
+        ),
+        _k(
+            "AUTOCYCLER_RECOVERY_CAPTURE",
+            "bool",
+            True,
+            "Auto-capture a micro-bench when the device recovers from a wedged state.",
+        ),
+        _k(
+            "AUTOCYCLER_RECOVERY_DOTPLOT_N",
+            "int",
+            65536,
+            "Sequence length for the recovery micro-bench dotplot capture.",
+        ),
+        _k(
+            "AUTOCYCLER_RECOVERY_GROUPING_MBP",
+            "float",
+            2.0,
+            "Input size in Mbp for the recovery micro-bench grouping capture.",
+        ),
+        # --- device & grouping dispatch -----------------------------------
+        _k(
+            "AUTOCYCLER_DEVICE_GROUPING",
+            "str",
+            None,
+            "Force the k-mer grouping backend: 'device', 'host', or unset for auto.",
+        ),
+        _k(
+            "AUTOCYCLER_HOST_GROUPING",
+            "str",
+            None,
+            "Force the host grouping implementation: 'numpy' or 'python'.",
+        ),
+        _k(
+            "AUTOCYCLER_GROUPING_EXECUTOR",
+            "str",
+            None,
+            "Executor for parallel host grouping: 'thread', 'serial', or unset for auto.",
+        ),
+        _k(
+            "AUTOCYCLER_RADIX_MIN_WINDOWS",
+            "int",
+            1 << 17,
+            "Minimum window count before the device radix-grouping path engages.",
+        ),
+        _k(
+            "AUTOCYCLER_MESH_INIT_TIMEOUT",
+            "float",
+            600.0,
+            "Seconds to wait for distributed mesh initialisation before aborting.",
+        ),
+        # --- caches --------------------------------------------------------
+        _k(
+            "AUTOCYCLER_COMPILE_CACHE",
+            "str",
+            None,
+            "Directory for the persistent XLA compile cache; unset/empty disables.",
+        ),
+        _k(
+            "AUTOCYCLER_CACHE_DIR",
+            "str",
+            None,
+            "Root of the shared content-addressed encode cache.",
+        ),
+        _k(
+            "AUTOCYCLER_CACHE_MAX_BYTES",
+            "int",
+            4 * 1024**3,
+            "LRU byte budget for the shared encode cache; <=0 disables eviction.",
+        ),
+        _k(
+            "AUTOCYCLER_ENCODE_CACHE",
+            "bool",
+            True,
+            "Enable the content-addressed encode cache.",
+        ),
+        # --- native library ------------------------------------------------
+        _k(
+            "AUTOCYCLER_NATIVE_LIB",
+            "str",
+            None,
+            "Explicit path to the native helper shared library, overriding discovery.",
+        ),
+        _k(
+            "AUTOCYCLER_NATIVE_DEBUG",
+            "bool",
+            False,
+            "Enable debug logging inside the native helper library (read by native code).",
+        ),
+        # --- resilience / faults ------------------------------------------
+        _k(
+            "AUTOCYCLER_FAULTS",
+            "str",
+            None,
+            "Fault-injection plan spec, e.g. 'stage:kind:count' triples separated by commas.",
+        ),
+        _k(
+            "AUTOCYCLER_SUBPROCESS_TIMEOUT",
+            "float",
+            None,
+            "Timeout in seconds applied to helper subprocess invocations.",
+        ),
+        _k(
+            "AUTOCYCLER_SUBPROCESS_RETRIES",
+            "int",
+            0,
+            "Retry count for failed helper subprocess invocations.",
+        ),
+        # --- serve / SLOs --------------------------------------------------
+        _k(
+            "AUTOCYCLER_SERVE",
+            "str",
+            None,
+            "Default serve endpoint for `autocycler submit` (host:port or unix:/path).",
+        ),
+        _k(
+            "AUTOCYCLER_SLO_P50_S",
+            "float",
+            None,
+            "p50 end-to-end latency objective in seconds for serve SLO tracking.",
+        ),
+        _k(
+            "AUTOCYCLER_SLO_P95_S",
+            "float",
+            None,
+            "p95 end-to-end latency objective in seconds for serve SLO tracking.",
+        ),
+        _k(
+            "AUTOCYCLER_SLO_WINDOW_S",
+            "float",
+            3600.0,
+            "Sliding window in seconds for serve SLO burn-rate accounting.",
+        ),
+        # --- bench ---------------------------------------------------------
+        _k(
+            "AUTOCYCLER_BENCH_THREADS",
+            "int",
+            4,
+            "Thread count used by bench.py workloads.",
+        ),
+        _k(
+            "AUTOCYCLER_BENCH_LOAD_MAX",
+            "float",
+            0.5,
+            "Maximum per-core host load for a bench run to count as trusted.",
+        ),
+        # --- misc ----------------------------------------------------------
+        _k(
+            "AUTOCYCLER_DOTPLOT_FONT",
+            "str",
+            None,
+            "Path to a TTF font for dotplot labels, overriding discovery.",
+        ),
+    ]
+)
+
+
+_warn_lock = threading.Lock()
+_warned: set = set()
+
+
+def _warn_once(name: str, raw: str, kind: str, default: Default) -> None:
+    with _warn_lock:
+        if name in _warned:
+            return
+        _warned.add(name)
+    print(
+        f"Warning: ignoring malformed {kind} value {raw!r} for {name}; "
+        f"using default {default!r}",
+        file=sys.stderr,
+    )
+
+
+def _declared(name: str) -> Knob:
+    try:
+        return KNOBS[name]
+    except KeyError:
+        raise KeyError(
+            f"{name} is not declared in autocycler_tpu.utils.knobs.KNOBS; "
+            "declare it there before reading it"
+        ) from None
+
+
+_UNSET = object()
+
+
+def knob_raw(name: str) -> Optional[str]:
+    """Raw environment value for a declared knob (None when unset)."""
+    _declared(name)
+    return os.environ.get(name)
+
+
+def knob_set(name: str) -> bool:
+    """True when the knob is set to a non-empty value in the environment."""
+    _declared(name)
+    raw = os.environ.get(name)
+    return raw is not None and raw.strip() != ""
+
+
+def knob_str(name: str, default: Default = _UNSET) -> Optional[str]:
+    """String knob: unset/empty falls back to the declared (or given) default."""
+    knob = _declared(name)
+    fallback = knob.default if default is _UNSET else default
+    raw = os.environ.get(name)
+    if raw is None or raw.strip() == "":
+        return fallback  # type: ignore[return-value]
+    return raw
+
+
+_FALSE_VALUES = ("0", "false", "no", "off")
+
+
+def knob_bool(name: str, default: Default = _UNSET) -> bool:
+    """Boolean knob: 0/false/no/off (any case) is False, any other set value True."""
+    knob = _declared(name)
+    fallback = knob.default if default is _UNSET else default
+    raw = os.environ.get(name)
+    if raw is None or raw.strip() == "":
+        return bool(fallback)
+    return raw.strip().lower() not in _FALSE_VALUES
+
+
+def knob_int(name: str, default: Default = _UNSET) -> Optional[int]:
+    """Integer knob: malformed values fall back to the default with one warning."""
+    knob = _declared(name)
+    fallback = knob.default if default is _UNSET else default
+    raw = os.environ.get(name)
+    if raw is None or raw.strip() == "":
+        return fallback  # type: ignore[return-value]
+    try:
+        return int(raw.strip())
+    except ValueError:
+        _warn_once(name, raw, "int", fallback)
+        return fallback  # type: ignore[return-value]
+
+
+def knob_float(name: str, default: Default = _UNSET) -> Optional[float]:
+    """Float knob: malformed values fall back to the default with one warning."""
+    knob = _declared(name)
+    fallback = knob.default if default is _UNSET else default
+    raw = os.environ.get(name)
+    if raw is None or raw.strip() == "":
+        return fallback  # type: ignore[return-value]
+    try:
+        return float(raw.strip())
+    except ValueError:
+        _warn_once(name, raw, "float", fallback)
+        return fallback  # type: ignore[return-value]
+
+
+def all_knobs() -> Tuple[Knob, ...]:
+    """Every declared knob, in declaration order."""
+    return tuple(KNOBS.values())
+
+
+def _format_default(knob: Knob) -> str:
+    if knob.default is None:
+        return "unset"
+    if knob.kind == "bool":
+        return "on" if knob.default else "off"
+    return f"`{knob.default}`"
+
+
+def knobs_markdown() -> str:
+    """Markdown table of every knob, used to generate the docs/cli.md section."""
+    lines = [
+        "| Knob | Type | Default | Description |",
+        "| --- | --- | --- | --- |",
+    ]
+    for knob in all_knobs():
+        lines.append(
+            f"| `{knob.name}` | {knob.kind} | {_format_default(knob)} | {knob.doc} |"
+        )
+    return "\n".join(lines) + "\n"

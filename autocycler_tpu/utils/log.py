@@ -36,8 +36,8 @@ def _colour_enabled() -> bool:
 
 
 def _json_mode() -> bool:
-    return os.environ.get("AUTOCYCLER_LOG_JSON", "").strip().lower() \
-        in ("1", "true", "yes", "on")
+    from .knobs import knob_bool
+    return knob_bool("AUTOCYCLER_LOG_JSON")
 
 
 def _emit_json(record_type: str, text: str) -> None:

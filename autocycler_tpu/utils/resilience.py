@@ -44,6 +44,7 @@ from pathlib import Path
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..obs import metrics_registry
+from .knobs import knob_float, knob_int, knob_str
 from .misc import AutocyclerError
 
 # registry metric names (obs.metrics_registry): resilience events are
@@ -240,7 +241,7 @@ def fault_fire(site: str, key: str = "") -> Optional[FaultRule]:
     with _fault_lock:
         if _fault_plan is not None:
             return _fault_plan.fire(site, key)
-        spec = os.environ.get("AUTOCYCLER_FAULTS", "")
+        spec = knob_str("AUTOCYCLER_FAULTS") or ""
         if not spec:
             _env_plan = None
             return None
@@ -284,20 +285,19 @@ def set_subprocess_policy(timeout: Optional[float] = None,
                           backoff: Optional[float] = None) -> None:
     global _policy
     base = current_policy()
-    _policy = SubprocessPolicy(
-        timeout=timeout if timeout is not None else base.timeout,
-        retries=retries if retries is not None else base.retries,
-        backoff=backoff if backoff is not None else base.backoff)
+    with _fault_lock:
+        _policy = SubprocessPolicy(
+            timeout=timeout if timeout is not None else base.timeout,
+            retries=retries if retries is not None else base.retries,
+            backoff=backoff if backoff is not None else base.backoff)
 
 
 def current_policy() -> SubprocessPolicy:
     if _policy is not None:
         return _policy
-    timeout = os.environ.get("AUTOCYCLER_SUBPROCESS_TIMEOUT")
-    retries = os.environ.get("AUTOCYCLER_SUBPROCESS_RETRIES")
     return SubprocessPolicy(
-        timeout=float(timeout) if timeout else None,
-        retries=int(retries) if retries else 0)
+        timeout=knob_float("AUTOCYCLER_SUBPROCESS_TIMEOUT"),
+        retries=int(knob_int("AUTOCYCLER_SUBPROCESS_RETRIES")))
 
 
 def backoff_delay(attempt: int, base: float, key: str = "") -> float:

@@ -22,6 +22,7 @@ import time
 
 from ..obs import metrics_registry, trace
 from . import log
+from .knobs import knob_bool, knob_int, knob_str
 from .misc import format_duration
 
 # metric names (the single source of truth for every accessor below and
@@ -61,10 +62,7 @@ def _maybe_xprof(xprof_dir: str, kernel: str):
     Returns (profiler context or None, trace path or None); never raises —
     profiling is evidence, not a dependency."""
     import re
-    try:
-        limit = int(os.environ.get("AUTOCYCLER_XPROF_LIMIT", "2"))
-    except ValueError:
-        limit = 2
+    limit = int(knob_int("AUTOCYCLER_XPROF_LIMIT"))
     with _last_lock:
         n = _xprof_counts.get(kernel, 0)
         if n >= limit:
@@ -106,7 +104,7 @@ def device_dispatch(what: str = "", flops: float = None,
     with _last_lock:
         phase = "steady" if kernel in _first_seen else "first"
     xprof_cm = xprof_path = None
-    xprof_dir = os.environ.get("AUTOCYCLER_XPROF", "").strip()
+    xprof_dir = (knob_str("AUTOCYCLER_XPROF") or "").strip()
     if xprof_dir:
         xprof_cm, xprof_path = _maybe_xprof(xprof_dir, kernel)
     attrs = {"xprof": xprof_path} if xprof_path else {}
@@ -147,7 +145,7 @@ def device_dispatch(what: str = "", flops: float = None,
                             kernel=kernel, phase=phase)
         with _last_lock:
             _first_seen.add(kernel)
-        if os.environ.get("AUTOCYCLER_TIMINGS") and what:
+        if knob_bool("AUTOCYCLER_TIMINGS") and what:
             log.message(f"[timing] device {what}: {format_duration(elapsed)}")
 
 
@@ -302,7 +300,7 @@ def stage_timer(name: str):
     sub-stage splits recorded inside the stage) always accumulate into the
     registry read by :func:`stage_seconds` / :func:`substage_snapshot`, and
     the stage opens a "stage" span in the tracer."""
-    profile_dir = os.environ.get("AUTOCYCLER_PROFILE_DIR")
+    profile_dir = knob_str("AUTOCYCLER_PROFILE_DIR")
     jax_trace = None
     if profile_dir:
         try:
@@ -334,7 +332,7 @@ def stage_timer(name: str):
             STAGE_LATENCY_HIST, elapsed,
             help="per-stage wall latency distribution",
             buckets=metrics_registry.SECONDS_BUCKETS, stage=name)
-        if os.environ.get("AUTOCYCLER_TIMINGS"):
+        if knob_bool("AUTOCYCLER_TIMINGS"):
             log.message(f"[timing] {name}: {format_duration(elapsed)}")
             for sub, secs in substage_deltas(sub_before).items():
                 log.message(f"[timing] {name} · {sub}: "

@@ -68,12 +68,9 @@ def untrusted_reason(host_env: dict) -> str:
     loadavg per CPU above AUTOCYCLER_BENCH_LOAD_MAX, default 0.5): its wall
     times are machine noise, so the guard must not read them as code
     regressions. Returns "" when the run is trustworthy."""
-    import os
+    from autocycler_tpu.utils.knobs import knob_float
 
-    try:
-        max_load = float(os.environ.get("AUTOCYCLER_BENCH_LOAD_MAX", "0.5"))
-    except ValueError:
-        max_load = 0.5
+    max_load = float(knob_float("AUTOCYCLER_BENCH_LOAD_MAX"))
     amb = host_env.get("ambient_load_per_cpu")
     if isinstance(amb, (int, float)) and amb > max_load:
         return (f"ambient load {amb:.2f} per cpu at run start exceeds "
@@ -86,12 +83,9 @@ def _bench_threads() -> int:
     """Worker count for the threaded pipeline stages (compress grouping).
     AUTOCYCLER_BENCH_THREADS overrides; the default 4 matches the ISSUE-3
     acceptance configuration."""
-    import os
+    from autocycler_tpu.utils.knobs import knob_int
 
-    try:
-        return max(1, int(os.environ.get("AUTOCYCLER_BENCH_THREADS", "4")))
-    except ValueError:
-        return 4
+    return max(1, int(knob_int("AUTOCYCLER_BENCH_THREADS")))
 
 
 def _headline_dataset():
@@ -832,6 +826,59 @@ def bench_servesmoke() -> None:
         sys.exit(1)
 
 
+LINTSMOKE_PATH = Path(__file__).resolve().parent / "LINTSMOKE.json"
+
+
+def bench_lintsmoke() -> None:
+    """`python bench.py lintsmoke`: time a full `autocycler lint` pass
+    over the default targets and record wall time + finding count as an
+    artifact (``LINTSMOKE.json``) that `bench.py trend` surfaces. One
+    JSON line on stdout; exit 1 on non-baselined findings — the bench
+    fleet doubles as a contract canary."""
+    from autocycler_tpu.commands.lint import run as lint_run
+
+    result = lint_run(report_path=str(LINTSMOKE_PATH))
+    artifact = {
+        "bench": "lintsmoke",
+        "passed": not result["findings"],
+        "files": result["files"],
+        "wall_s": result["wall_s"],
+        "findings": len(result["findings"]),
+        "baselined": result["baselined"],
+    }
+    print(json.dumps(artifact))
+    if result["findings"]:
+        for f in result["findings"]:
+            print(f"{f['path']}:{f['line']}: [{f['rule']}] {f['message']}",
+                  file=sys.stderr)
+        sys.exit(1)
+
+
+def lintsmoke_row(root=None) -> dict:
+    """The latest lintsmoke artifact as one trend row; every field
+    optional (absent artifact → None-valued row, never a raise)."""
+    path = Path(root) / "LINTSMOKE.json" if root is not None \
+        else LINTSMOKE_PATH
+    row = {"files": None, "findings": None, "baselined": None,
+           "wall_s": None, "present": False}
+    try:
+        data = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return row
+    if not isinstance(data, dict):
+        return row
+    findings = data.get("findings")
+    row.update({
+        "present": True,
+        "files": data.get("files"),
+        "findings": (len(findings) if isinstance(findings, list)
+                     else findings),
+        "baselined": data.get("baselined"),
+        "wall_s": data.get("wall_s"),
+    })
+    return row
+
+
 GUARD_BASELINE_PATH = Path(__file__).resolve().parent / "BENCH_GUARD.json"
 GUARD_TOLERANCE = 1.25
 
@@ -1241,8 +1288,17 @@ def bench_trend() -> None:
                   f"{str(r['ok']) if r['ok'] is not None else '-':>5} "
                   f"{str(r['skipped']) if r['skipped'] is not None else '-':>8} "
                   f"{fmt(r['rc']):>4}", file=sys.stderr)
+    lint = lintsmoke_row()
+    if lint.get("present"):
+        verdict = ("clean" if not lint.get("findings")
+                   else f"{lint['findings']} finding(s)")
+        print("", file=sys.stderr)
+        print(f"lintsmoke: {verdict} across {lint.get('files')} files "
+              f"in {fmt(lint.get('wall_s'), '.2f')}s "
+              f"({lint.get('baselined') or 0} baselined)  (LINTSMOKE.json)",
+              file=sys.stderr)
     print(json.dumps({"bench": "trend", "rounds": rows,
-                      "multichip": mrows}))
+                      "multichip": mrows, "lintsmoke": lint}))
 
 
 def main() -> None:
@@ -1280,6 +1336,8 @@ def main() -> None:
         bench_faultsmoke()
     elif len(sys.argv) > 1 and sys.argv[1] == "servesmoke":
         bench_servesmoke()
+    elif len(sys.argv) > 1 and sys.argv[1] == "lintsmoke":
+        bench_lintsmoke()
     elif len(sys.argv) > 1 and sys.argv[1] == "guard":
         bench_guard(sys.argv[2:])
     elif len(sys.argv) > 1 and sys.argv[1] == "trend":

@@ -248,8 +248,14 @@ def test_probe_deadline_env_takes_precedence(monkeypatch, capsys):
     jnp.zeros(1).block_until_ready()  # backend init under pinned cpu
     probe = _fresh_probe()
     monkeypatch.setenv("AUTOCYCLER_PROBE_DEADLINE_S", "pear")
+    # the unified knob accessors own the warning now (utils/knobs.py);
+    # they warn once per process, so reset for this knob
+    from autocycler_tpu.utils import knobs as knobs_mod
+    knobs_mod._warned.discard("AUTOCYCLER_PROBE_DEADLINE_S")
     assert probe() is False
-    assert "malformed probe deadline" in capsys.readouterr().err
+    err = capsys.readouterr().err
+    assert "malformed float value 'pear' for AUTOCYCLER_PROBE_DEADLINE_S" \
+        in err
 
 
 def test_negative_probe_persists_across_processes(tmp_path, monkeypatch,

@@ -136,7 +136,7 @@ def test_doctor_json_stdout_is_one_report(tmp_path, capsys):
     assert rc == 0
     report = json.loads(capsys.readouterr().out)
     assert set(report) == {"env", "probe_state", "negative_cache",
-                           "probe_log", "async_probe", "actions"}
+                           "probe_log", "async_probe", "lint", "actions"}
 
 
 def test_doctor_text_render(tmp_path, capsys):

@@ -1,0 +1,546 @@
+"""The static-analysis suite: rule-family fixtures, the engine's escape
+hatches (suppressions, baseline), the knob accessors, and the tier-1
+repo self-lint.
+
+Each rule family gets (a) a positive fixture seeded with a violation —
+where one exists, modeled on a real pre-migration pattern from this
+repo's history — (b) the same violation silenced with an inline
+``# lint: ignore[...]``, and (c) exclusion via a baseline file. The
+self-lint test is the one that holds the bar: the shipped tree must
+produce zero non-baselined findings.
+"""
+
+import json
+import textwrap
+
+import pytest
+
+from autocycler_tpu.analysis import (LintContext, load_baseline, run_lint,
+                                     split_baseline, write_baseline)
+from autocycler_tpu.analysis.engine import rule_matches
+from autocycler_tpu.analysis.rules import rule_ids
+from autocycler_tpu.utils import knobs as knobs_mod
+from autocycler_tpu.utils.knobs import (KNOBS, knob_bool, knob_float,
+                                        knob_int, knob_str, knobs_markdown)
+
+pytestmark = pytest.mark.lint
+
+
+def lint_source(tmp_path, source, name="fixture.py", selectors=None,
+                docs=None):
+    """Write one fixture module and lint it; returns the findings list."""
+    path = tmp_path / name
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    ctx = LintContext(root=tmp_path, docs_path=docs)
+    findings, n_files = run_lint([path], ctx, selectors=selectors)
+    assert n_files == 1
+    return findings
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ---- knobs family ----
+
+# the pre-migration shape of ops/distance.py's negative-TTL read: a raw
+# os.environ.get with inline int parsing, exactly what the registry and
+# knobs.direct-read now forbid
+PRE_MIGRATION_ENV_READ = """
+    import os
+
+    def _probe_neg_ttl() -> float:
+        raw = os.environ.get("AUTOCYCLER_PROBE_NEG_TTL_S", "300")
+        try:
+            return float(raw or "300")
+        except ValueError:
+            return 300.0
+"""
+
+
+def test_knobs_direct_read_flagged(tmp_path):
+    findings = lint_source(tmp_path, PRE_MIGRATION_ENV_READ)
+    assert rules_of(findings) == ["knobs.direct-read"]
+    assert "AUTOCYCLER_PROBE_NEG_TTL_S" in findings[0].message
+
+
+def test_knobs_direct_read_suppressed(tmp_path):
+    src = PRE_MIGRATION_ENV_READ.replace(
+        '"300")',
+        '"300")  # lint: ignore[knobs.direct-read]', 1)
+    assert lint_source(tmp_path, src) == []
+
+
+def test_knobs_direct_read_variants(tmp_path):
+    findings = lint_source(tmp_path, """
+        import os
+        from os import getenv
+
+        NAME = "AUTOCYCLER_METRICS"
+        a = os.getenv("AUTOCYCLER_TIMINGS")
+        b = os.environ["AUTOCYCLER_TRACE_DIR"]
+        c = os.environ.get(NAME)
+    """)
+    assert rules_of(findings) == ["knobs.direct-read"] * 3
+
+
+def test_knobs_env_writes_are_legal(tmp_path):
+    findings = lint_source(tmp_path, """
+        import os
+
+        os.environ["AUTOCYCLER_TIMINGS"] = "1"
+        os.environ.setdefault("AUTOCYCLER_METRICS", "m.json")
+        os.environ.pop("AUTOCYCLER_TIMINGS", None)
+        del os.environ["AUTOCYCLER_METRICS"]
+    """)
+    assert findings == []
+
+
+def test_knobs_undeclared_accessor(tmp_path):
+    findings = lint_source(tmp_path, """
+        from autocycler_tpu.utils.knobs import knob_float
+
+        x = knob_float("AUTOCYCLER_NOT_A_REAL_KNOB")
+    """)
+    assert rules_of(findings) == ["knobs.undeclared"]
+
+
+def test_knobs_docs_drift_both_directions(tmp_path):
+    docs = tmp_path / "cli.md"
+    # documented-but-undeclared knob inside the marker block, and (since
+    # the table holds only one row) every declared knob missing
+    docs.write_text("usage: autocycler -a AUTOCYCLER_DIR\n"
+                    "<!-- knobs:begin -->\n"
+                    "| `AUTOCYCLER_NOT_A_REAL_KNOB` | str | unset | x |\n"
+                    "<!-- knobs:end -->\n")
+    findings = lint_source(tmp_path, "x = 1\n", docs=docs)
+    assert set(rules_of(findings)) == {"knobs.docs-drift"}
+    messages = " ".join(f.message for f in findings)
+    assert "AUTOCYCLER_NOT_A_REAL_KNOB is not declared" in messages
+    # the AUTOCYCLER_DIR placeholder outside the markers must NOT count
+    assert "AUTOCYCLER_DIR is not declared" not in messages
+    missing = [f for f in findings if "missing from the knob table"
+               in f.message]
+    assert len(missing) == len(KNOBS)
+
+
+def test_knobs_docs_markers_required(tmp_path):
+    docs = tmp_path / "cli.md"
+    docs.write_text("no markers here\n")
+    findings = lint_source(tmp_path, "x = 1\n", docs=docs)
+    assert rules_of(findings) == ["knobs.docs-drift"]
+    assert "markers" in findings[0].message
+
+
+def test_knobs_docs_round_trip(tmp_path):
+    """The generated table documents exactly the declared registry."""
+    docs = tmp_path / "cli.md"
+    docs.write_text("<!-- knobs:begin -->\n" + knobs_markdown()
+                    + "<!-- knobs:end -->\n")
+    assert lint_source(tmp_path, "x = 1\n", docs=docs) == []
+
+
+# ---- locks family ----
+
+# the pre-migration shape of utils/resilience.py's set_subprocess_policy:
+# a module with a Lock rebinding a module global without holding it
+PRE_MIGRATION_UNLOCKED_WRITE = """
+    import threading
+
+    _fault_lock = threading.Lock()
+    _policy = None
+
+    def set_policy(p):
+        global _policy
+        _policy = p
+"""
+
+
+def test_locks_unguarded_global(tmp_path):
+    findings = lint_source(tmp_path, PRE_MIGRATION_UNLOCKED_WRITE)
+    assert rules_of(findings) == ["locks.unguarded-global"]
+    assert "_policy" in findings[0].message
+
+
+def test_locks_guarded_write_is_clean(tmp_path):
+    findings = lint_source(tmp_path, """
+        import threading
+
+        _lock = threading.Lock()
+        _state = None
+
+        def set_state(s):
+            global _state
+            with _lock:
+                _state = s
+    """)
+    assert findings == []
+
+
+def test_locks_locked_suffix_contract(tmp_path):
+    # native.py's _get_lib_locked idiom: the suffix promises the caller
+    # holds the lock, so the write inside is exempt
+    findings = lint_source(tmp_path, """
+        import threading
+
+        _lib_lock = threading.Lock()
+        _lib = None
+
+        def _get_lib_locked():
+            global _lib
+            _lib = object()
+
+        def get_lib():
+            with _lib_lock:
+                _get_lib_locked()
+    """)
+    assert findings == []
+
+
+def test_locks_no_module_lock_no_findings(tmp_path):
+    findings = lint_source(tmp_path, """
+        _state = None
+
+        def set_state(s):
+            global _state
+            _state = s
+    """)
+    assert findings == []
+
+
+def test_locks_thread_daemon(tmp_path):
+    findings = lint_source(tmp_path, """
+        import threading
+
+        a = threading.Thread(target=print)
+        b = threading.Thread(target=print, daemon=True)
+        c = threading.Thread(target=print)  # lint: ignore[locks]
+    """)
+    assert rules_of(findings) == ["locks.thread-daemon"]
+    assert findings[0].line == 4
+
+
+# ---- purity family ----
+
+PURITY_FIXTURE = """
+    import time
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+
+    def _log_progress(x):
+        t = time.perf_counter()
+        print("step", t)
+        return x
+
+    @jax.jit
+    def step(x):
+        return _log_progress(x) + 1
+
+    @partial(jax.jit, static_argnums=0)
+    def step2(n, key):
+        return jax.random.uniform(key, (n,))
+
+    def host_only():
+        return time.perf_counter()
+"""
+
+
+def test_purity_reachable_impurity_flagged(tmp_path):
+    findings = lint_source(tmp_path, PURITY_FIXTURE)
+    reasons = [f.message for f in findings]
+    assert rules_of(findings) == ["purity.impure-call"] * 2
+    assert any("time.perf_counter" in r for r in reasons)
+    assert any("print()" in r for r in reasons)
+    # every finding names the callee and its jit reachability
+    assert all("_log_progress" in r and "reachable" in r for r in reasons)
+    # host_only is NOT reachable from a jit root: its clock call is legal
+    assert not any("host_only" in r for r in reasons)
+
+
+def test_purity_jax_random_is_legal(tmp_path):
+    findings = lint_source(tmp_path, """
+        import jax
+
+        @jax.jit
+        def draw(key):
+            return jax.random.normal(key, (4,))
+    """)
+    assert findings == []
+
+
+def test_purity_wrapper_call_roots(tmp_path):
+    findings = lint_source(tmp_path, """
+        import os
+
+        import jax
+
+        def kernel(x):
+            flag = os.environ
+            return x
+
+        fast = jax.jit(kernel)
+    """)
+    assert rules_of(findings) == ["purity.impure-call"]
+    assert "os.environ" in findings[0].message
+
+
+def test_purity_suppressed(tmp_path):
+    src = PURITY_FIXTURE.replace(
+        "t = time.perf_counter()",
+        "t = time.perf_counter()  # lint: ignore[purity]"
+    ).replace('print("step", t)',
+              'print("step", t)  # lint: ignore[purity.impure-call]')
+    assert lint_source(tmp_path, src) == []
+
+
+# ---- readers family ----
+
+READER_FIXTURE = """
+    import json
+
+    def read_status(path):
+        data = json.loads(open(path).read())
+        if not data:
+            raise ValueError("empty status")
+        return data
+"""
+
+
+def test_readers_raise_and_unguarded_io(tmp_path):
+    findings = lint_source(tmp_path, READER_FIXTURE)
+    assert sorted(rules_of(findings)) == [
+        "readers.raise", "readers.unguarded-io", "readers.unguarded-io"]
+
+
+def test_readers_guarded_reader_is_clean(tmp_path):
+    findings = lint_source(tmp_path, """
+        import json
+
+        def read_status(path):
+            try:
+                with open(path) as fh:
+                    return json.load(fh)
+            except (OSError, ValueError):
+                return {}
+    """)
+    assert findings == []
+
+
+def test_readers_writers_exempt(tmp_path):
+    findings = lint_source(tmp_path, """
+        import json
+
+        def write_status(path, data):
+            if not data:
+                raise ValueError("refusing to write nothing")
+            open(path, "w").write(json.dumps(data))
+
+        def render_report(data):
+            raise NotImplementedError
+    """)
+    assert findings == []
+
+
+def test_readers_suppressed(tmp_path):
+    src = READER_FIXTURE.replace(
+        "json.loads(open(path).read())",
+        "json.loads(open(path).read())  # lint: ignore[readers]"
+    ).replace('raise ValueError("empty status")',
+              'raise ValueError("empty status")  # lint: ignore')
+    assert lint_source(tmp_path, src) == []
+
+
+# ---- metrics family ----
+
+def test_metrics_name_rules(tmp_path):
+    findings = lint_source(tmp_path, """
+        from autocycler_tpu.obs import metrics_registry as mr
+
+        CACHE_HITS = "autocycler_cache_hits"
+
+        mr.counter_inc(CACHE_HITS)
+        mr.counter_inc("autocycler_jobs_total")
+        mr.gauge_set("autocycler_queue_total", 3)
+        mr.observe("autocycler_wait", 0.5)
+        mr.observe("autocycler_wait_seconds", 0.5)
+        mr.counter_inc("badprefix_things_total")
+    """)
+    msgs = [f.message for f in findings]
+    assert rules_of(findings) == ["metrics.name"] * 4
+    assert any("'autocycler_cache_hits' must end with _total" in m
+               for m in msgs)
+    assert any("'autocycler_queue_total' must not end with _total" in m
+               for m in msgs)
+    assert any("'autocycler_wait' needs a unit suffix" in m for m in msgs)
+    assert any("'badprefix_things_total' does not match" in m for m in msgs)
+
+
+def test_metrics_label_rules(tmp_path):
+    findings = lint_source(tmp_path, """
+        from autocycler_tpu.obs import metrics_registry as mr
+
+        mr.counter_inc("autocycler_jobs_total", le="0.5")
+        mr.counter_inc("autocycler_jobs_total", Stage="trim")
+        mr.counter_inc("autocycler_jobs_total", stage="trim",
+                       help="jobs", value=2)
+    """)
+    msgs = [f.message for f in findings]
+    assert rules_of(findings) == ["metrics.label"] * 2
+    assert any("'le' is reserved" in m for m in msgs)
+    assert any("'Stage' does not match" in m for m in msgs)
+
+
+def test_metrics_span_rules(tmp_path):
+    findings = lint_source(tmp_path, """
+        import os
+
+        from autocycler_tpu.obs import trace
+
+        def work(cmd):
+            with trace.span("Compress Stage"):
+                pass
+            with trace.span(f"subprocess {os.path.basename(cmd[0])}"):
+                pass
+            with trace.span("cluster qc"):
+                pass
+    """)
+    assert rules_of(findings) == ["metrics.span"]
+    assert "Compress Stage" in findings[0].message
+
+
+# ---- engine: selectors, baseline, parse errors ----
+
+def test_rule_selector_family_prefix(tmp_path):
+    findings = lint_source(tmp_path, PRE_MIGRATION_ENV_READ
+                           + PRE_MIGRATION_UNLOCKED_WRITE,
+                           selectors=["locks"])
+    assert rules_of(findings) == ["locks.unguarded-global"]
+
+
+def test_rule_matches():
+    assert rule_matches("knobs", "knobs.direct-read")
+    assert rule_matches("knobs.direct-read", "knobs.direct-read")
+    assert not rule_matches("knobs.direct", "knobs.direct-read")
+    assert not rule_matches("locks", "knobs.direct-read")
+
+
+def test_baseline_roundtrip(tmp_path):
+    findings = lint_source(tmp_path, PRE_MIGRATION_UNLOCKED_WRITE)
+    assert len(findings) == 1
+    baseline_path = tmp_path / "lint_baseline.json"
+    write_baseline(findings, baseline_path)
+    keys = load_baseline(baseline_path)
+    new, old = split_baseline(findings, keys)
+    assert new == [] and len(old) == 1
+    # a fresh finding in another file is not hidden by the baseline
+    other = lint_source(tmp_path, PRE_MIGRATION_UNLOCKED_WRITE,
+                        name="other.py")
+    new, old = split_baseline(other, keys)
+    assert len(new) == 1 and old == []
+
+
+def test_baseline_fingerprint_survives_line_moves(tmp_path):
+    before = lint_source(tmp_path, PRE_MIGRATION_UNLOCKED_WRITE)
+    after = lint_source(tmp_path, "# a new comment up top\n"
+                        + textwrap.dedent(PRE_MIGRATION_UNLOCKED_WRITE))
+    assert before[0].line != after[0].line
+    assert before[0].fingerprint() == after[0].fingerprint()
+
+
+def test_broken_baseline_hides_nothing(tmp_path):
+    path = tmp_path / "lint_baseline.json"
+    path.write_text("{not json")
+    assert load_baseline(path) == set()
+
+
+def test_syntax_error_becomes_finding(tmp_path):
+    findings = lint_source(tmp_path, "def broken(:\n    pass\n")
+    assert rules_of(findings) == ["engine.parse"]
+
+
+# ---- knob accessor semantics (the unified grammar) ----
+
+def test_knob_bool_grammar(monkeypatch):
+    for false_spelling in ("0", "false", "FALSE", "No", "off", " Off "):
+        monkeypatch.setenv("AUTOCYCLER_TIMESERIES", false_spelling)
+        assert knob_bool("AUTOCYCLER_TIMESERIES") is False, false_spelling
+    for true_spelling in ("1", "true", "yes", "on", "anything"):
+        monkeypatch.setenv("AUTOCYCLER_TIMESERIES", true_spelling)
+        assert knob_bool("AUTOCYCLER_TIMESERIES") is True, true_spelling
+    monkeypatch.delenv("AUTOCYCLER_TIMESERIES", raising=False)
+    assert knob_bool("AUTOCYCLER_TIMESERIES") is True     # declared default
+    monkeypatch.setenv("AUTOCYCLER_TIMESERIES", "")
+    assert knob_bool("AUTOCYCLER_TIMESERIES") is True
+    assert knob_bool("AUTOCYCLER_TIMESERIES", default=False) is False
+
+
+def test_knob_numeric_malformed_falls_back(monkeypatch, capsys):
+    knobs_mod._warned.clear()
+    monkeypatch.setenv("AUTOCYCLER_XPROF_LIMIT", "not-a-number")
+    assert knob_int("AUTOCYCLER_XPROF_LIMIT") == 2       # declared default
+    assert knob_int("AUTOCYCLER_XPROF_LIMIT", default=7) == 7
+    monkeypatch.setenv("AUTOCYCLER_DEVICE_PROBE_TTL", "12.5.3")
+    assert knob_float("AUTOCYCLER_DEVICE_PROBE_TTL") == 120.0
+    err = capsys.readouterr().err
+    # one warning per knob, not per read
+    assert err.count("AUTOCYCLER_XPROF_LIMIT") == 1
+    assert err.count("AUTOCYCLER_DEVICE_PROBE_TTL") == 1
+
+
+def test_knob_numeric_valid_values(monkeypatch):
+    monkeypatch.setenv("AUTOCYCLER_XPROF_LIMIT", " 5 ")
+    assert knob_int("AUTOCYCLER_XPROF_LIMIT") == 5
+    monkeypatch.setenv("AUTOCYCLER_DEVICE_PROBE_TTL", "45.5")
+    assert knob_float("AUTOCYCLER_DEVICE_PROBE_TTL") == 45.5
+
+
+def test_knob_str_empty_is_unset(monkeypatch):
+    monkeypatch.setenv("AUTOCYCLER_TRACE_DIR", "  ")
+    assert knob_str("AUTOCYCLER_TRACE_DIR") is None
+    monkeypatch.setenv("AUTOCYCLER_TRACE_DIR", "/runs")
+    assert knob_str("AUTOCYCLER_TRACE_DIR") == "/runs"
+
+
+def test_undeclared_knob_raises():
+    with pytest.raises(KeyError):
+        knob_str("AUTOCYCLER_NOT_A_REAL_KNOB")
+
+
+def test_registry_shape():
+    assert len(KNOBS) >= 40
+    for name, knob in KNOBS.items():
+        assert name.startswith("AUTOCYCLER_")
+        assert knob.kind in ("str", "bool", "int", "float")
+        assert knob.doc
+
+
+def test_knobs_markdown_covers_registry():
+    md = knobs_markdown()
+    for name in KNOBS:
+        assert f"`{name}`" in md
+
+
+# ---- the bar: the shipped tree self-lints clean ----
+
+def test_repo_self_lint_is_clean():
+    from autocycler_tpu.commands.lint import run
+
+    result = run()
+    rendered = "\n".join(
+        f"{f['path']}:{f['line']}: [{f['rule']}] {f['message']}"
+        for f in result["findings"])
+    assert result["findings"] == [], f"new lint findings:\n{rendered}"
+    assert result["files"] > 50
+
+
+def test_rule_ids_are_stable():
+    assert set(rule_ids()) == {
+        "knobs.direct-read", "knobs.undeclared", "knobs.docs-drift",
+        "locks.unguarded-global", "locks.thread-daemon",
+        "purity.impure-call",
+        "readers.raise", "readers.unguarded-io",
+        "metrics.name", "metrics.label", "metrics.span",
+    }
