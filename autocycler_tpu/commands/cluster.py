@@ -24,8 +24,11 @@ from ..models.simplify import merge_linear_paths
 from ..obs import ledger
 from ..obs import qc as obs_qc
 from ..ops.distance import pairwise_contig_distances
+from ..ops.sketch import sketch_contig_distances, sketch_params
 from ..utils import (format_float, load_file_lines, log, median, quit_with_error,
                      usize_division_rounded)
+from ..utils.cache import open_cache
+from ..utils.knobs import knob_int, knob_str
 from ..utils.timing import stage_timer
 
 
@@ -697,6 +700,53 @@ def save_data_to_tsv(sequences: List[Sequence], qc_results: Dict[int, ClusterQC]
                     f"{seq.consensus_weight()}\n")
 
 
+# ---------------- distance backend selection ----------------
+
+def resolve_distance_mode(n_contigs: int) -> str:
+    """'exact' | 'sketch' | 'verify' from AUTOCYCLER_SKETCH_DISTANCE.
+
+    'auto' (the default) engages sketching at AUTOCYCLER_SKETCH_MIN_CONTIGS
+    contigs and above — below that the exact path is both fast enough and
+    the oracle. 'on'/'off' force a backend; 'verify' runs BOTH, clusters
+    from the exact distances, and records the sketch-vs-exact max abs
+    error in QC + the ledger (the production parity probe)."""
+    raw = (knob_str("AUTOCYCLER_SKETCH_DISTANCE") or "auto").strip().lower()
+    if raw in ("0", "off", "false", "no", "exact"):
+        return "exact"
+    if raw in ("1", "on", "true", "yes", "sketch"):
+        return "sketch"
+    if raw == "verify":
+        return "verify"
+    threshold = int(knob_int("AUTOCYCLER_SKETCH_MIN_CONTIGS"))
+    return "sketch" if n_contigs >= threshold else "exact"
+
+
+def compute_distances(graph, sequences, autocycler_dir=None, use_jax=None
+                      ) -> Tuple[Dict[Tuple[int, int], float], dict]:
+    """The cluster distance dict plus a provenance record
+    ``{"mode", "sketch_s", "sketch_max_abs_error"?}``.
+
+    Sketch mode replaces the contig×unitig membership contraction with
+    bottom-s minimizer sketches and one batched containment grid
+    (ops.sketch); the distances flow through the identical
+    UPGMA/cutoff machinery either way. Sketches are content-addressed in
+    the warm-start cache so serve's daemon reuses them across jobs."""
+    mode = resolve_distance_mode(len(sequences))
+    k, w, s = sketch_params()
+    record = {"mode": mode, "sketch_s": s}
+    sketch = exact = None
+    if mode in ("sketch", "verify"):
+        sketch = sketch_contig_distances(
+            graph, sequences, cache=open_cache(autocycler_dir),
+            use_jax=use_jax)
+    if mode in ("exact", "verify"):
+        exact = pairwise_contig_distances(graph, sequences, use_jax=use_jax)
+    if mode == "verify":
+        record["sketch_max_abs_error"] = max(
+            (abs(sketch[p] - exact[p]) for p in exact), default=0.0)
+    return (exact if exact is not None else sketch), record
+
+
 # ---------------- entry point ----------------
 
 def cluster(autocycler_dir, cutoff: float = 0.2, min_assemblies: Optional[int] = None,
@@ -748,8 +798,15 @@ def cluster(autocycler_dir, cutoff: float = 0.2, min_assemblies: Optional[int] =
     log.explanation("Every pairwise distance between contigs is calculated based on the "
                     "similarity of their paths through the graph.")
     with stage_timer("cluster/distances"):
-        asym = precomputed_distances if precomputed_distances is not None else \
-            pairwise_contig_distances(graph, sequences, use_jax=use_jax)
+        if precomputed_distances is not None:
+            asym = precomputed_distances
+            distance_record = {"mode": "precomputed"}
+        else:
+            asym, distance_record = compute_distances(
+                graph, sequences, autocycler_dir=autocycler_dir,
+                use_jax=use_jax)
+        obs_qc.record("cluster_distance", contigs=len(sequences),
+                      **distance_record)
         save_distance_matrix(asym, sequences,
                              clustering_dir / "pairwise_distances.phylip")
 
@@ -777,7 +834,9 @@ def cluster(autocycler_dir, cutoff: float = 0.2, min_assemblies: Optional[int] =
                  clustering_dir / "clustering.newick",
                  clustering_dir / "clustering.tsv",
                  clustering_dir / "clustering.yaml"]
-        + sorted(clustering_dir.glob("qc_*/cluster_*/1_untrimmed.gfa")))
+        + sorted(clustering_dir.glob("qc_*/cluster_*/1_untrimmed.gfa")),
+        **{f"distance_{key}": value
+           for key, value in distance_record.items()})
 
     log.section_header("Finished!")
     log.explanation("You can now run autocycler trim on each cluster.")

@@ -97,6 +97,10 @@ class UnitigGraph:
         # position-COORDINATE edits (repeat expansion) keep it valid because
         # the (number, strand) sequence of every path is unchanged
         self._paths_cache = None
+        # same P-line paths in array form (numbers int64[], strands bool[]),
+        # kept so bulk consumers (get_sequences_for_ids) never touch
+        # per-piece python tuples; invalidated together with _paths_cache
+        self._paths_arrays_cache = None
 
     # ---------------- loading ----------------
 
@@ -245,6 +249,7 @@ class UnitigGraph:
             paths_cache[seq_id] = list(zip(numbers.tolist(), strands.tolist()))
         self.stamp_paths_batch(entries, luts=luts)
         self._paths_cache = paths_cache
+        self._paths_arrays_cache = {e[0]: (e[2], e[3]) for e in entries}
         return sequences
 
     def stamp_paths_batch(self, entries, luts=None) -> None:
@@ -394,8 +399,83 @@ class UnitigGraph:
     def get_sequence_from_path_signed(self, path: List[int]) -> np.ndarray:
         return self.get_sequence_from_path([(abs(n), n >= 0) for n in path])
 
+    def _path_arrays_for_sequences(self, seq_ids
+                                   ) -> Dict[int, Tuple[np.ndarray, np.ndarray]]:
+        """(numbers int64[], strands bool[]) per path. The GFA loader's
+        array cache is returned directly; a mutated graph falls back to
+        the tuple sweep and converts once."""
+        cache = self._paths_arrays_cache
+        if cache is not None and all(sid in cache for sid in seq_ids):
+            return {sid: cache[sid] for sid in seq_ids}
+        out: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        for sid, path in self.get_unitig_paths_for_sequences(seq_ids).items():
+            nums = np.fromiter((p[0] for p in path), np.int64, len(path))
+            strs = np.fromiter((p[1] for p in path), bool, len(path))
+            out[sid] = (nums, strs)
+        return out
+
+    def get_sequences_for_ids(self, seq_ids) -> Dict[int, np.ndarray]:
+        """Reconstruct many sequences at once: every unitig strand that
+        any path touches is laid out once in a flat byte pool, pool
+        offsets live in dense LUTs indexed by unitig number, and each
+        path becomes a single fancy-index gather (one cumsum of per-piece
+        position jumps). Bit-identical to get_sequence_from_path per id
+        (asserted in tests), but O(total bp) array work with no per-piece
+        python — the difference dominates on SNP-shredded graphs where
+        pieces average tens of bases."""
+        seq_ids = list(seq_ids)
+        out: Dict[int, np.ndarray] = {}
+        if not seq_ids:
+            return out
+        if not self.unitigs:
+            return {sid: np.zeros(0, np.uint8) for sid in seq_ids}
+        arrs = self._path_arrays_for_sequences(seq_ids)
+        max_num = max(u.number for u in self.unitigs)
+        # reverse strands are computed lazily per unitig; only pool the
+        # ones some path actually walks backwards
+        rev_used = np.zeros(max_num + 1, bool)
+        for sid in seq_ids:
+            nums, strs = arrs[sid]
+            if nums.size:
+                rev_used[nums[~strs]] = True
+        len_lut = np.zeros(max_num + 1, np.int64)
+        start_lut = np.zeros(2 * (max_num + 1), np.int64)
+        parts: List[np.ndarray] = []
+        cursor = 0
+        for u in self.unitigs:
+            n = len(u.forward_seq)
+            len_lut[u.number] = n
+            start_lut[2 * u.number + 1] = cursor
+            parts.append(u.forward_seq)
+            cursor += n
+            if rev_used[u.number]:
+                start_lut[2 * u.number] = cursor
+                parts.append(u.reverse_seq)
+                cursor += n
+        pool = np.concatenate(parts) if parts else np.zeros(0, np.uint8)
+        for sid in seq_ids:
+            nums, strs = arrs[sid]
+            ln = len_lut[nums]
+            nz = ln > 0
+            if not nz.all():
+                nums, strs, ln = nums[nz], strs[nz], ln[nz]
+            if not nums.size:
+                out[sid] = np.zeros(0, np.uint8)
+                continue
+            st = start_lut[2 * nums + strs]
+            total = int(ln.sum())
+            # positions walk each piece start..start+len-1 consecutively:
+            # ones everywhere, piece-boundary jumps patched in, one cumsum
+            step = np.ones(total, np.int64)
+            step[0] = st[0]
+            ends = np.cumsum(ln)
+            step[ends[:-1]] = st[1:] - st[:-1] - ln[:-1] + 1
+            out[sid] = pool[np.cumsum(step)]
+        return out
+
     def invalidate_paths_cache(self) -> None:
         self._paths_cache = None
+        self._paths_arrays_cache = None
 
     def get_unitig_paths_for_sequences(self, seq_ids) -> Dict[int, List[Tuple[int, bool]]]:
         """Paths for many sequences in one sweep: every unitig's forward-

@@ -659,6 +659,27 @@ def intersections_to_distances(inter: np.ndarray, ids: List[int]
             for a in range(len(ids)) for b in range(len(ids))}
 
 
+def _blocked_intersections(M: np.ndarray, w: np.ndarray,
+                           block: int) -> np.ndarray:
+    """Host intersection contraction in row blocks: the weighted int64 copy
+    of M exists only ``block`` rows at a time, so peak transient memory is
+    one int64 transpose of M plus a block instead of two full weighted
+    copies. Pure integer arithmetic in the same order per cell, so the
+    result is bit-identical to the whole-matrix contraction."""
+    S = M.shape[0]
+    inter = np.empty((S, S), np.int64)
+    Mt = np.ascontiguousarray(M.T, dtype=np.int64)
+    for lo in range(0, S, block):
+        hi = min(lo + block, S)
+        inter[lo:hi] = (M[lo:hi].astype(np.int64) * w[None, :]) @ Mt
+    return inter
+
+
+def _distance_block() -> int:
+    from ..utils.knobs import knob_int
+    return int(knob_int("AUTOCYCLER_DISTANCE_BLOCK"))
+
+
 def pairwise_distance_matrix(M: np.ndarray, w: np.ndarray,
                              use_jax=None) -> np.ndarray:
     """Asymmetric distance matrix D[a, b] = 1 - |A∩B|_len / |A|_len."""
@@ -672,6 +693,12 @@ def pairwise_distance_matrix(M: np.ndarray, w: np.ndarray,
             # background probe is pending this answers False (host matmul,
             # bit-identical) rather than stalling the stage on attach
             use_jax = device_attached()
+    if not use_jax:
+        # AUTOCYCLER_DISTANCE_BLOCK bounds the exact host path's peak
+        # memory on thousands-of-contigs inputs (default off: whole matrix)
+        block = _distance_block()
+        if 0 < block < M.shape[0]:
+            return _intersections_to_matrix(_blocked_intersections(M, w, block))
     Mw = M.astype(np.int64) * w[None, :]
     if use_jax and exceeds_int32_accumulation(Mw):
         use_jax = False

@@ -1,0 +1,370 @@
+"""Minimizer-sketch contig distance: bottom-s MinHash over windowed
+minimizers, compared as one batched device Jaccard/containment grid.
+
+The exact path (ops.distance) scales with total unitig content: the
+membership matrix is contigs × unitigs and the intersection contraction
+is O(n² · U). Sketching (minimizers per minimap/Li 2016, signature
+partitioning per KMC 2) reduces every contig to a FIXED-size vector:
+
+1. k-mers are taken over the 5-symbol code space (ops.encode, ``.ACGT``
+   → 0..4) and base-5 packed into uint64 (k ≤ 27 fits exactly), so the
+   pack is a bijection and no dot-containing window ever contributes;
+2. each packed k-mer is mixed with splitmix64 and folded to uint32 (JAX
+   has no uint64 without x64 — the grid kernel must compare 32-bit
+   values), canonicalised as min(forward hash, aligned reverse-complement
+   hash) so a contig and its reverse complement sketch identically;
+3. a sliding window of ``w`` consecutive k-mer positions keeps only each
+   window's minimum hash (the minimizers), and the sorted-unique
+   minimizer set is truncated to its ``s`` smallest values (bottom-s
+   MinHash). Sorting ascending makes ``s``-truncation prefix-stable:
+   a sketch at s' < s is exactly the first s' entries of the sketch at s.
+
+Sketches are stacked into an ``(n_contigs, s)`` uint32 matrix (rows
+sorted ascending, padded with ``SENTINEL``), which is exactly the shape
+JAX wants: pairwise intersection counts are sorted-merge lookups via
+``searchsorted``, ``vmap``ped over all pairs in one device dispatch. The
+host numpy oracle runs the same integer algorithm, so device and host
+intersection counts are bit-identical and the float conversion is one
+shared expression (mirroring ops.distance's contract).
+
+The distance is the same asymmetric containment shape as the exact path:
+``d[a, b] = 1 - |sketch(a) ∩ sketch(b)| / |sketch(a)|`` — an estimator
+of the unitig-length containment the exact path computes, converted
+through the identical UPGMA/cutoff machinery in commands/cluster.py.
+The exact path remains the oracle: below the auto threshold and in
+parity tests, clustering decisions must match.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .encode import encode_both_strands
+
+# uint32 max doubles as the pad value: real hashes equal to it are dropped
+# during sketching, so a sentinel cell can never match a query
+SENTINEL = np.uint32(0xFFFFFFFF)
+
+MAX_K = 27      # 5**27 < 2**63: base-5 pack of a k-mer fits uint64 exactly
+
+# device-dispatch thresholds over the stacked sketch-matrix element count
+# (n_contigs * s), mirroring ops.distance's pair: above the JAX threshold
+# the batched grid wins on any backend; between the two the probe future
+# is consulted non-blockingly (host grid while pending, bit-identical)
+_JAX_THRESHOLD = 4096 * 1024
+_TPU_THRESHOLD = 1 << 16
+
+_SPLITMIX_GAMMA = np.uint64(0x9E3779B97F4A7C15)
+_SPLITMIX_M1 = np.uint64(0xBF58476D1CE4E5B9)
+_SPLITMIX_M2 = np.uint64(0x94D049BB133111EB)
+
+
+def sketch_params() -> Tuple[int, int, int]:
+    """(k, w, s) from the AUTOCYCLER_SKETCH_* knobs, clamped to sane
+    ranges (k to [4, MAX_K] so the base-5 pack stays exact, w/s to >= 1)."""
+    from ..utils.knobs import knob_int
+    k = min(max(int(knob_int("AUTOCYCLER_SKETCH_K")), 4), MAX_K)
+    w = max(int(knob_int("AUTOCYCLER_SKETCH_W")), 1)
+    s = max(int(knob_int("AUTOCYCLER_SKETCH_S")), 1)
+    return k, w, s
+
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    """Vectorised splitmix64 finaliser (uint64 wrap-around arithmetic)."""
+    z = x + _SPLITMIX_GAMMA
+    z = (z ^ (z >> np.uint64(30))) * _SPLITMIX_M1
+    z = (z ^ (z >> np.uint64(27))) * _SPLITMIX_M2
+    return z ^ (z >> np.uint64(31))
+
+
+def _pack_poly(codes: np.ndarray, k: int, reverse: bool = False) -> np.ndarray:
+    """Base-5 pack of every k-mer of one code strand, evaluated in
+    O(log k) array passes instead of k.
+
+    ``reverse=False``: P[i] = sum_j codes[i+j] * 5**(k-1-j) — the naive
+    left-to-right pack loop's polynomial, bit-identical by associativity.
+    ``reverse=True``: R[i] = sum_j codes[i+j] * 5**j — the mirrored
+    polynomial, which over complemented codes equals the pack of the
+    reverse-complement k-mer aligned to the forward position.
+
+    Both use square-and-multiply over the concatenation monoid
+    ``concat(A_a, B_b)[i] = A[i] * 5**b + B[i+a]`` (coefficients swap
+    sides when reversed); values stay < 5**MAX_K < 2**63, so uint64
+    arithmetic is exact."""
+    base = codes.astype(np.uint64)
+
+    def _concat(A, a, B, b):
+        m = A.shape[0] - b
+        if reverse:
+            return A[:m] + B[a:a + m] * np.uint64(5 ** a)
+        return A[:m] * np.uint64(5 ** b) + B[a:a + m]
+
+    acc, acc_len = None, 0
+    for bit in bin(k)[2:]:
+        if acc is not None:
+            acc = _concat(acc, acc_len, acc, acc_len)
+            acc_len *= 2
+        if bit == "1":
+            if acc is None:
+                acc, acc_len = base, 1
+            else:
+                acc = _concat(acc, acc_len, base, 1)
+                acc_len += 1
+    return acc if acc is not base else base.copy()
+
+
+def _kmer_hashes(codes: np.ndarray, k: int) -> np.ndarray:
+    """uint32 mixed hash per k-mer start position of one code strand."""
+    packed = _pack_poly(codes, k)
+    return (_splitmix64(packed) >> np.uint64(32)).astype(np.uint32)
+
+
+def _window_minima(vals: np.ndarray, w: int) -> np.ndarray:
+    """Minimum over every window of ``w`` consecutive positions, in
+    O(log w) array passes (same square-and-multiply shape as _pack_poly:
+    ``concat(A_a, B_b)[i] = min(A[i], B[i+a])`` is associative)."""
+
+    def _concat(A, a, B, b):
+        m = A.shape[0] - b
+        return np.minimum(A[:m], B[a:a + m])
+
+    acc, acc_len = None, 0
+    for bit in bin(w)[2:]:
+        if acc is not None:
+            acc = _concat(acc, acc_len, acc, acc_len)
+            acc_len *= 2
+        if bit == "1":
+            if acc is None:
+                acc, acc_len = vals, 1
+            else:
+                acc = _concat(acc, acc_len, vals, 1)
+                acc_len += 1
+    return acc if acc is not vals else vals.copy()
+
+
+def sketch_from_codes(fwd: np.ndarray, rc: np.ndarray, k: int, w: int,
+                      s: int) -> Tuple[np.ndarray, int]:
+    """Bottom-s minimizer sketch of one contig from its code-space strands
+    (``Sequence.encoded_strands()`` order: forward, reverse complement).
+
+    Returns ``(sketch, m)``: a length-``s`` uint32 vector sorted ascending
+    and padded with :data:`SENTINEL`, plus the count ``m`` of real values.
+    Deterministic in the sequence content and (k, w, s) alone, and
+    strand-symmetric: a contig and its reverse complement sketch
+    identically (canonical hash + window-set symmetry).
+    """
+    n = fwd.shape[0]
+    if n < k + w - 1:
+        return np.full(s, SENTINEL, np.uint32), 0
+    hf = _kmer_hashes(fwd, k)
+    # the rc k-mer starting at rc-position (n - k - i) is the reverse
+    # complement of the fwd k-mer at position i. Its pack equals the
+    # MIRRORED polynomial over the complement strand at position i, and
+    # rc[::-1] IS the complement strand (encode_both_strands builds rc as
+    # complement(fwd)[::-1]) — so one reversed-coefficient pack of that
+    # view replaces packing rc and re-aligning with hr[::-1]
+    pr = _pack_poly(np.ascontiguousarray(rc[::-1]), k, reverse=True)
+    hr = (_splitmix64(pr) >> np.uint64(32)).astype(np.uint32)
+    canon = np.minimum(hf, hr)
+    # windows containing a dot ('.'; pad/separator, code 0) never
+    # contribute — the cumulative zero count gives dots-per-k-window
+    zeros = np.zeros(n + 1, np.int64)
+    np.cumsum(fwd == 0, out=zeros[1:])
+    dotted = (zeros[k:] - zeros[:-k]) > 0
+    canon[dotted] = SENTINEL
+    minima = _window_minima(canon, w)
+    # each minimizer typically wins ~w consecutive windows: collapsing
+    # equal-value runs first shrinks the np.unique sort ~w-fold without
+    # changing the value SET (runs only ever drop duplicates)
+    if minima.size > 1:
+        keep = np.empty(minima.size, bool)
+        keep[0] = True
+        np.not_equal(minima[1:], minima[:-1], out=keep[1:])
+        minima = minima[keep]
+    minimizers = np.unique(minima)
+    if minimizers.size and minimizers[-1] == SENTINEL:
+        minimizers = minimizers[:-1]
+    minimizers = minimizers[:s]
+    m = int(minimizers.size)
+    sketch = np.full(s, SENTINEL, np.uint32)
+    sketch[:m] = minimizers
+    return sketch, m
+
+
+def _contig_forward_bytes(seq, recon) -> np.ndarray:
+    """A contig's forward ASCII bytes: the in-memory strand when present,
+    else the bulk-reconstructed bytes (cluster loads sequences from GFA
+    P-lines with ``Sequence.without_seq`` — empty strands)."""
+    if seq.forward_seq.size:
+        return seq.forward_seq
+    return recon[seq.id]
+
+
+def sketch_matrix(graph, sequences, cache=None,
+                  params: Optional[Tuple[int, int, int]] = None
+                  ) -> Tuple[np.ndarray, np.ndarray, List[int]]:
+    """Stacked ``(n_contigs, s)`` uint32 sketch matrix, per-contig valid
+    counts (int64) and sequence ids, in ``sequences`` order.
+
+    ``cache`` (utils.cache.EncodeCache or None) stores each contig's
+    sketch content-addressed by the sha256 of its forward bytes plus
+    (k, w, s) — serve's warm daemon reuses sketches across jobs exactly
+    like the parse/repair caches, and any byte change misses by
+    construction."""
+    k, w, s = params if params is not None else sketch_params()
+    missing = [q.id for q in sequences if not q.forward_seq.size]
+    recon = graph.get_sequences_for_ids(missing) if missing else {}
+    S = np.full((len(sequences), s), SENTINEL, np.uint32)
+    valid = np.zeros(len(sequences), np.int64)
+    ids: List[int] = []
+    for i, seq in enumerate(sequences):
+        ids.append(seq.id)
+        key = None
+        if cache is not None:
+            from ..utils.cache import content_hash
+            key = content_hash(_contig_forward_bytes(seq, recon).tobytes())
+            hit = cache.load_sketch(key, k, w, s)
+            if hit is not None:
+                S[i], valid[i] = hit
+                continue
+        if seq.forward_seq.size:
+            fwd, rc = seq.encoded_strands()
+        else:
+            fwd, rc = encode_both_strands(_contig_forward_bytes(seq, recon))
+        S[i], valid[i] = sketch_from_codes(fwd, rc, k, w, s)
+        if cache is not None and key is not None:
+            cache.store_sketch(key, k, w, s, S[i], int(valid[i]))
+    return S, valid, ids
+
+
+def _sketch_intersections_searchsorted(S: np.ndarray) -> np.ndarray:
+    """Pairwise intersection counts over sorted sketch rows — the numpy
+    oracle, bit-identical to the device grid (same sorted-merge integer
+    algorithm; only the vectorisation differs)."""
+    n, s = S.shape
+    out = np.empty((n, n), np.int64)
+    real = S != SENTINEL
+    flat = S.reshape(-1)
+    for b in range(n):
+        idx = np.searchsorted(S[b], flat).reshape(n, s)
+        np.minimum(idx, s - 1, out=idx)
+        out[:, b] = ((S[b][idx] == S) & real).sum(axis=1)
+    return out
+
+
+def sketch_intersections_host(S: np.ndarray) -> np.ndarray:
+    """Pairwise intersection counts — fast host production path. All
+    sketch values are tokenised once (one global np.unique), then each
+    row's token set is flipped on in a boolean lookup table and every
+    other row is one O(n·s) gather — no per-pair log-s search. Counting
+    set membership either way yields the same integers, pinned against
+    :func:`_sketch_intersections_searchsorted` (and hence the device
+    grid) in tests/test_sketch.py."""
+    n, s = S.shape
+    real = S != SENTINEL
+    uniq, tok = np.unique(S, return_inverse=True)
+    T = tok.reshape(n, s)
+    out = np.empty((n, n), np.int64)
+    lut = np.zeros(uniq.size, bool)
+    for b in range(n):
+        tb = T[b][real[b]]
+        lut[tb] = True
+        out[:, b] = np.count_nonzero(lut[T] & real, axis=1)
+        lut[tb] = False
+    return out
+
+
+def _sketch_intersections_jax(S: np.ndarray) -> np.ndarray:
+    """One batched device dispatch: nested-vmap ``searchsorted`` lookup of
+    every sketch row against every other. Rows are padded to a multiple of
+    64 with all-sentinel rows (zero intersections by construction) so the
+    compiled grid is reused across runs via the persistent compile cache."""
+    from ..utils.jaxcache import configure_compile_cache
+    configure_compile_cache()
+    import jax
+    import jax.numpy as jnp
+
+    from ..utils.timing import device_dispatch
+    n, s = S.shape
+    n_pad = -(-n // 64) * 64
+    S_p = np.full((n_pad, s), SENTINEL, np.uint32)
+    S_p[:n] = S
+
+    def _grid(mat):
+        sent = jnp.uint32(SENTINEL)
+
+        def against(target, query):
+            idx = jnp.minimum(jnp.searchsorted(target, query), s - 1)
+            hit = (target[idx] == query) & (query != sent)
+            return jnp.sum(hit.astype(jnp.int32))
+
+        def row(query):
+            return jax.vmap(lambda t: against(t, query))(mat)
+
+        return jax.vmap(row)(mat)
+
+    with device_dispatch("sketch jaccard grid",
+                         flops=2.0 * n_pad * n_pad * s):
+        inter = np.asarray(jax.jit(_grid)(jnp.asarray(S_p)))
+    return inter[:n, :n].astype(np.int64)
+
+
+def _containment_to_matrix(inter: np.ndarray, valid: np.ndarray
+                           ) -> np.ndarray:
+    """Integer intersection counts -> asymmetric distance matrix, one float
+    expression shared by the host and device paths (ops.distance pattern).
+    Rows with empty sketches (contig shorter than k + w - 1) are defined as
+    distance 1 to everything and 0 to themselves."""
+    a_len = valid.astype(np.float64)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        D = 1.0 - inter / a_len[:, None]
+    empty = valid == 0
+    if empty.any():
+        D[empty, :] = 1.0
+        idx = np.flatnonzero(empty)
+        D[idx, idx] = 0.0
+    return D
+
+
+def sketch_distance_matrix(S: np.ndarray, valid: np.ndarray,
+                           use_jax=None) -> np.ndarray:
+    """Asymmetric sketch distance D[a, b] = 1 - inter(a, b) / |sketch(a)|,
+    with the same auto/host-fallback dispatch contract as
+    ops.distance.pairwise_distance_matrix."""
+    if use_jax is None:
+        if S.size >= _JAX_THRESHOLD:
+            use_jax = True
+        elif S.size < _TPU_THRESHOLD:
+            use_jax = False
+        else:
+            from .distance import device_attached
+            use_jax = device_attached()
+    if use_jax:
+        try:
+            inter = _sketch_intersections_jax(S)
+        except Exception as e:  # noqa: BLE001 — host fallback for ANY
+            # device failure, surfaced like the exact matmul's fallback
+            import sys
+
+            from ..utils.timing import record_device_failure
+            what = f"device sketch grid failed ({type(e).__name__}: {e})"
+            record_device_failure(what, exc=e)
+            print(f"autocycler: {what}; falling back to host grid",
+                  file=sys.stderr)
+            inter = sketch_intersections_host(S)
+    else:
+        inter = sketch_intersections_host(S)
+    return _containment_to_matrix(inter, valid)
+
+
+def sketch_contig_distances(graph, sequences, cache=None, use_jax=None
+                            ) -> Dict[Tuple[int, int], float]:
+    """Sketch distances keyed by (seq_a.id, seq_b.id) — the same
+    reference-shaped dict as ops.distance.pairwise_contig_distances, so
+    cluster's UPGMA/cutoff path consumes either interchangeably."""
+    S, valid, ids = sketch_matrix(graph, sequences, cache=cache)
+    D = sketch_distance_matrix(S, valid, use_jax=use_jax)
+    return {(ids[a], ids[b]): float(D[a, b])
+            for a in range(len(ids)) for b in range(len(ids))}

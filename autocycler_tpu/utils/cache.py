@@ -147,6 +147,9 @@ class EncodeCache:
     def _repair_path(self, combined_hash: str, k: int) -> Path:
         return self.dir / f"repair-{combined_hash[:24]}-k{k}.npz"
 
+    def _sketch_path(self, seq_hash: str, k: int, w: int, s: int) -> Path:
+        return self.dir / f"sketch-{seq_hash[:24]}-k{k}w{w}s{s}.npz"
+
     # ---- byte-budget LRU ----
 
     @staticmethod
@@ -269,6 +272,44 @@ class EncodeCache:
             _atomic_write(self._repair_path(combined_hash, k), buf.getvalue())
             self.enforce_budget()
         except Exception:  # noqa: BLE001
+            pass
+
+    # ---- per-contig minimizer-sketch cache ----
+
+    def load_sketch(self, seq_hash: str, k: int, w: int, s: int
+                    ) -> Optional[Tuple[np.ndarray, int]]:
+        """A contig's cached bottom-s minimizer sketch ``(sketch, m)`` —
+        length-s uint32 sorted vector plus valid count — keyed by the
+        sha256 of its forward bytes and the (k, w, s) sketch parameters,
+        or None on a miss. Content addressing makes sharing across serve
+        jobs safe: any byte or parameter change misses by construction."""
+        path = self._sketch_path(seq_hash, k, w, s)
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                sketch = z["sketch"]
+                m = int(z["m"])
+        except Exception:  # noqa: BLE001 — missing/corrupt entry == miss
+            _count("sketch_misses")
+            return None
+        if sketch.shape != (s,) or sketch.dtype != np.uint32 \
+                or not 0 <= m <= s:
+            _count("sketch_misses")
+            return None
+        _count("sketch_hits")
+        self._touch(path)
+        return sketch, m
+
+    def store_sketch(self, seq_hash: str, k: int, w: int, s: int,
+                     sketch: np.ndarray, m: int) -> None:
+        try:
+            self.dir.mkdir(parents=True, exist_ok=True)
+            buf = io.BytesIO()
+            np.savez(buf, sketch=np.asarray(sketch, np.uint32),
+                     m=np.int64(m))
+            _atomic_write(self._sketch_path(seq_hash, k, w, s),
+                          buf.getvalue())
+            self.enforce_budget()
+        except Exception:  # noqa: BLE001 — cache writes never fail the run
             pass
 
 

@@ -224,6 +224,43 @@ KNOBS: Dict[str, Knob] = dict(
             600.0,
             "Seconds to wait for distributed mesh initialisation before aborting.",
         ),
+        # --- cluster distance: sketching & blocking ------------------------
+        _k(
+            "AUTOCYCLER_SKETCH_DISTANCE",
+            "str",
+            "auto",
+            "Cluster distance backend: 'auto' (sketch above AUTOCYCLER_SKETCH_MIN_CONTIGS), 'on'/'off' to force, 'verify' runs both and records the error.",
+        ),
+        _k(
+            "AUTOCYCLER_SKETCH_MIN_CONTIGS",
+            "int",
+            256,
+            "Contig count at which 'auto' sketch mode switches from the exact distance path to minimizer sketches.",
+        ),
+        _k(
+            "AUTOCYCLER_SKETCH_S",
+            "int",
+            1024,
+            "Bottom-s MinHash sketch size per contig (entries in the sorted sketch vector).",
+        ),
+        _k(
+            "AUTOCYCLER_SKETCH_W",
+            "int",
+            11,
+            "Minimizer window: number of consecutive k-mer positions per window minimum.",
+        ),
+        _k(
+            "AUTOCYCLER_SKETCH_K",
+            "int",
+            21,
+            "Minimizer k-mer size (clamped to 27 so the base-5 pack stays exact in uint64).",
+        ),
+        _k(
+            "AUTOCYCLER_DISTANCE_BLOCK",
+            "int",
+            0,
+            "Row-block size for the exact host distance contraction; <=0 computes the whole matrix at once.",
+        ),
         # --- caches --------------------------------------------------------
         _k(
             "AUTOCYCLER_COMPILE_CACHE",

@@ -879,6 +879,109 @@ def lintsmoke_row(root=None) -> dict:
     return row
 
 
+SKETCHSMOKE_PATH = Path(__file__).resolve().parent / "SKETCHSMOKE.json"
+
+
+def bench_sketchsmoke() -> None:
+    """`python bench.py sketchsmoke`: exact vs minimizer-sketch contig
+    distances on a 200-contig synthetic input (100 assemblies of a 90 kb
+    chromosome + 2 kb plasmid, SNP-shredded to tens of thousands of
+    unitigs — the regime cluster's AUTOCYCLER_SKETCH_DISTANCE auto
+    threshold targets). Dataset generation and compression are untimed
+    setup; the timed region is exactly the two distance computations,
+    both on the host path so the comparison is deterministic. Passes
+    when the sketch path is >= 3x faster AND the UPGMA cluster decisions
+    at the default 0.2 cutoff are identical to the exact oracle's.
+    Writes SKETCHSMOKE.json (surfaced by `bench.py trend`); one JSON
+    line on stdout; exit 1 on fail."""
+    import shutil
+
+    tests_dir = str(Path(__file__).resolve().parent / "tests")
+    if tests_dir not in sys.path:
+        sys.path.insert(0, tests_dir)
+    from synthetic import make_assemblies_fast
+
+    from autocycler_tpu.commands.cluster import (make_symmetrical_distances,
+                                                 normalise_tree, upgma)
+    from autocycler_tpu.commands.compress import compress
+    from autocycler_tpu.models import UnitigGraph
+    from autocycler_tpu.ops.distance import pairwise_contig_distances
+    from autocycler_tpu.ops.sketch import (sketch_contig_distances,
+                                           sketch_params)
+
+    def partition(asym, sequences, cutoff=0.2):
+        sym = make_symmetrical_distances(asym, sequences)
+        tree = upgma(sym, sequences)
+        normalise_tree(tree)
+        return {frozenset(tree.get_tips(c))
+                for c in tree.automatic_clustering(cutoff)}
+
+    t0 = time.perf_counter()
+    tmp = Path(tempfile.mkdtemp(prefix="autocycler_sketchsmoke_"))
+    asm = make_assemblies_fast(tmp, n_assemblies=100, chromosome_len=90_000,
+                               plasmid_len=2_000, n_snps=180, seed=9)
+    out = tmp / "autocycler"
+    compress(asm, out, k_size=51, use_jax=False)
+    graph, sequences = UnitigGraph.from_gfa_file(out / "input_assemblies.gfa")
+    setup_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    exact = pairwise_contig_distances(graph, sequences, use_jax=False)
+    exact_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    sketched = sketch_contig_distances(graph, sequences, use_jax=False)
+    sketch_s = time.perf_counter() - t0
+
+    identical = partition(exact, sequences) == partition(sketched, sequences)
+    speedup = exact_s / sketch_s if sketch_s else None
+    err = max(abs(sketched[p] - exact[p]) for p in exact)
+    passed = bool(identical and speedup is not None and speedup >= 3.0)
+    artifact = {
+        "bench": "sketchsmoke",
+        "passed": passed,
+        "contigs": len(sequences),
+        "unitigs": len(graph.unitigs),
+        "sketch_s_param": sketch_params()[2],
+        "setup_s": round(setup_s, 2),
+        "exact_wall_s": round(exact_s, 3),
+        "sketch_wall_s": round(sketch_s, 3),
+        "speedup": round(speedup, 2) if speedup is not None else None,
+        "identical_clusters": identical,
+        "max_abs_err": round(err, 4),
+    }
+    SKETCHSMOKE_PATH.write_text(json.dumps(artifact, indent=2) + "\n")
+    print(json.dumps(artifact))
+    shutil.rmtree(tmp, ignore_errors=True)
+    if not passed:
+        sys.exit(1)
+
+
+def sketchsmoke_row(root=None) -> dict:
+    """The latest sketchsmoke artifact as one trend row; every field
+    optional (absent/invalid artifact → None-valued row, never a raise)."""
+    path = Path(root) / "SKETCHSMOKE.json" if root is not None \
+        else SKETCHSMOKE_PATH
+    row = {"present": False, "passed": None, "speedup": None,
+           "exact_wall_s": None, "sketch_wall_s": None,
+           "identical_clusters": None, "max_abs_err": None}
+    try:
+        data = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return row
+    if not isinstance(data, dict):
+        return row
+    row.update({
+        "present": True,
+        "passed": data.get("passed"),
+        "speedup": data.get("speedup"),
+        "exact_wall_s": data.get("exact_wall_s"),
+        "sketch_wall_s": data.get("sketch_wall_s"),
+        "identical_clusters": data.get("identical_clusters"),
+        "max_abs_err": data.get("max_abs_err"),
+    })
+    return row
+
+
 GUARD_BASELINE_PATH = Path(__file__).resolve().parent / "BENCH_GUARD.json"
 GUARD_TOLERANCE = 1.25
 
@@ -1297,8 +1400,20 @@ def bench_trend() -> None:
               f"in {fmt(lint.get('wall_s'), '.2f')}s "
               f"({lint.get('baselined') or 0} baselined)  (LINTSMOKE.json)",
               file=sys.stderr)
+    sketch = sketchsmoke_row()
+    if sketch.get("present"):
+        verdict = "ok" if sketch.get("passed") else "FAIL"
+        print("", file=sys.stderr)
+        print(f"sketchsmoke: {verdict} "
+              f"{fmt(sketch.get('speedup'), '.2f')}x over exact "
+              f"(exact {fmt(sketch.get('exact_wall_s'), '.2f')}s, "
+              f"sketch {fmt(sketch.get('sketch_wall_s'), '.2f')}s, "
+              f"clusters identical: {sketch.get('identical_clusters')})  "
+              f"(SKETCHSMOKE.json)",
+              file=sys.stderr)
     print(json.dumps({"bench": "trend", "rounds": rows,
-                      "multichip": mrows, "lintsmoke": lint}))
+                      "multichip": mrows, "lintsmoke": lint,
+                      "sketchsmoke": sketch}))
 
 
 def main() -> None:
@@ -1338,6 +1453,8 @@ def main() -> None:
         bench_servesmoke()
     elif len(sys.argv) > 1 and sys.argv[1] == "lintsmoke":
         bench_lintsmoke()
+    elif len(sys.argv) > 1 and sys.argv[1] == "sketchsmoke":
+        bench_sketchsmoke()
     elif len(sys.argv) > 1 and sys.argv[1] == "guard":
         bench_guard(sys.argv[2:])
     elif len(sys.argv) > 1 and sys.argv[1] == "trend":

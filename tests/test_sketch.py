@@ -1,0 +1,375 @@
+"""Minimizer-sketch distance: extraction invariants, device-grid parity,
+sketch-vs-exact clustering decisions, caching and the exact-path
+satellites (int32 accumulation boundary, blocked contraction)."""
+
+import hashlib
+import random
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from autocycler_tpu.commands.cluster import (cluster, make_symmetrical_distances,
+                                             normalise_tree, resolve_distance_mode,
+                                             upgma)
+from autocycler_tpu.commands.compress import compress
+from autocycler_tpu.models import UnitigGraph
+from autocycler_tpu.ops import sketch as sk
+from autocycler_tpu.ops.distance import (exceeds_int32_accumulation,
+                                         pairwise_contig_distances,
+                                         pairwise_distance_matrix)
+from autocycler_tpu.ops.encode import decode_codes, encode_both_strands
+from autocycler_tpu.utils.cache import EncodeCache, purge_cache
+from synthetic import make_assemblies, random_genome, revcomp, rotate
+
+pytestmark = pytest.mark.sketch
+
+
+def _random_strands(seed, n=30_000):
+    rng = np.random.default_rng(seed)
+    seq = rng.choice(np.frombuffer(b"ACGT", np.uint8), size=n)
+    return encode_both_strands(seq)
+
+
+# ---------------- satellites: exact path ----------------
+
+def test_exceeds_int32_accumulation_boundary():
+    """Direct boundary test: a weighted row sum of exactly int32 max is
+    safe; one more wraps."""
+    lim = np.iinfo(np.int32).max
+    assert not exceeds_int32_accumulation(np.zeros((0, 3), np.int64))
+    assert not exceeds_int32_accumulation(np.array([[lim]], np.int64))
+    assert not exceeds_int32_accumulation(np.array([[lim - 1, 1]], np.int64))
+    assert exceeds_int32_accumulation(np.array([[lim, 1]], np.int64))
+    assert exceeds_int32_accumulation(np.array([[1, 1], [lim, 1]], np.int64))
+
+
+@pytest.mark.parametrize("block", [1, 7, 16, 1000])
+def test_distance_block_bit_identical(monkeypatch, block):
+    rng = np.random.default_rng(3)
+    M = (rng.random((23, 140)) < 0.4).astype(np.uint8)
+    w = rng.integers(1, 9000, 140).astype(np.int64)
+    monkeypatch.delenv("AUTOCYCLER_DISTANCE_BLOCK", raising=False)
+    whole = pairwise_distance_matrix(M, w, use_jax=False)
+    monkeypatch.setenv("AUTOCYCLER_DISTANCE_BLOCK", str(block))
+    blocked = pairwise_distance_matrix(M, w, use_jax=False)
+    assert np.array_equal(whole, blocked, equal_nan=True)
+
+
+# ---------------- sketch extraction ----------------
+
+def test_sketch_sorted_padded_and_deterministic():
+    fwd, rc = _random_strands(0)
+    k, w, s = sk.sketch_params()
+    sketch, m = sk.sketch_from_codes(fwd, rc, k, w, s)
+    assert sketch.shape == (s,) and sketch.dtype == np.uint32
+    assert 0 < m <= s
+    assert np.all(np.diff(sketch[:m].astype(np.int64)) > 0)  # sorted unique
+    assert np.all(sketch[m:] == sk.SENTINEL)
+    again, m2 = sk.sketch_from_codes(fwd, rc, k, w, s)
+    assert m2 == m and np.array_equal(sketch, again)
+
+
+def test_sketch_strand_symmetric():
+    """A contig and its reverse complement sketch identically (canonical
+    min-of-strand-hashes plus window-set symmetry)."""
+    fwd, rc = _random_strands(1)
+    f2, r2 = encode_both_strands(decode_codes(rc))
+    k, w, s = 15, 5, 256
+    a, ma = sk.sketch_from_codes(fwd, rc, k, w, s)
+    b, mb = sk.sketch_from_codes(f2, r2, k, w, s)
+    assert ma == mb and np.array_equal(a, b)
+
+
+def test_sketch_s_truncation_monotonic():
+    """The sketch at s' < s is exactly the first s' entries of the sketch
+    at s (bottom-s over a sorted set is prefix-stable)."""
+    fwd, rc = _random_strands(2)
+    k, w = 21, 11
+    big, m_big = sk.sketch_from_codes(fwd, rc, k, w, 2048)
+    for s_small in (32, 256, 1024):
+        small, m_small = sk.sketch_from_codes(fwd, rc, k, w, s_small)
+        assert m_small == min(s_small, m_big)
+        assert np.array_equal(small[:m_small], big[:m_small])
+
+
+def test_sketch_short_and_dotted_input():
+    k, w, s = 21, 11, 64
+    tiny = np.frombuffer(b"ACGTACGT", np.uint8)
+    sketch, m = sk.sketch_from_codes(*encode_both_strands(tiny), k, w, s)
+    assert m == 0 and np.all(sketch == sk.SENTINEL)
+    # an all-dot sequence has no valid k-mer windows at all
+    dots = np.full(500, ord("."), np.uint8)
+    sketch, m = sk.sketch_from_codes(*encode_both_strands(dots), k, w, s)
+    assert m == 0
+    # dots split a sequence: only windows free of dots contribute, so the
+    # sketch of "left . right" is a subset of union of the halves' k-mers
+    rng = np.random.default_rng(4)
+    half = rng.choice(np.frombuffer(b"ACGT", np.uint8), size=2000)
+    joined = np.concatenate([half, [ord(".")], half[::-1]])
+    sketch, m = sk.sketch_from_codes(*encode_both_strands(joined), k, w, 4096)
+    assert m > 0
+
+
+def test_sketch_determinism_across_processes(tmp_path):
+    """Same content + params -> byte-identical sketch in a fresh process
+    (no process-seeded hashing anywhere in the pipeline)."""
+    prog = (
+        "import hashlib, numpy as np\n"
+        "from autocycler_tpu.ops.sketch import sketch_from_codes\n"
+        "from autocycler_tpu.ops.encode import encode_both_strands\n"
+        "rng = np.random.default_rng(123)\n"
+        "seq = rng.choice(np.frombuffer(b'ACGT', np.uint8), size=20000)\n"
+        "sketch, m = sketch_from_codes(*encode_both_strands(seq), 21, 11, 512)\n"
+        "print(m, hashlib.sha256(sketch.tobytes()).hexdigest())\n"
+    )
+    out = subprocess.run([sys.executable, "-c", prog], text=True,
+                         capture_output=True, check=True,
+                         cwd=Path(__file__).resolve().parent.parent)
+    rng = np.random.default_rng(123)
+    seq = rng.choice(np.frombuffer(b"ACGT", np.uint8), size=20000)
+    sketch, m = sk.sketch_from_codes(*encode_both_strands(seq), 21, 11, 512)
+    expect = f"{m} {hashlib.sha256(sketch.tobytes()).hexdigest()}"
+    assert out.stdout.strip() == expect
+
+
+# ---------------- the batched grid ----------------
+
+def _stacked_sketches(n=9, s=128, seed=5):
+    rng = np.random.default_rng(seed)
+    base = rng.choice(np.frombuffer(b"ACGT", np.uint8), size=4000)
+    S = np.empty((n, s), np.uint32)
+    valid = np.empty(n, np.int64)
+    for i in range(n - 1):
+        seq = base.copy()
+        sites = rng.choice(len(seq), size=40 * i, replace=False)
+        seq[sites] = rng.choice(np.frombuffer(b"ACGT", np.uint8), len(sites))
+        S[i], valid[i] = sk.sketch_from_codes(
+            *encode_both_strands(seq), 15, 7, s)
+    S[-1], valid[-1] = np.full(s, sk.SENTINEL, np.uint32), 0  # empty sketch
+    return S, valid
+
+
+def test_grid_host_oracle_properties():
+    S, valid = _stacked_sketches()
+    inter = sk.sketch_intersections_host(S)
+    assert np.array_equal(np.diag(inter), valid)     # self-intersection = m
+    assert np.array_equal(inter, inter.T)            # set intersection is symmetric
+    D = sk.sketch_distance_matrix(S, valid, use_jax=False)
+    assert np.all(np.diag(D) == 0.0)
+    assert np.all((D >= 0.0) & (D <= 1.0))
+    assert np.all(D[-1, :-1] == 1.0)                 # empty sketch: far from all
+
+
+def test_grid_fast_host_matches_searchsorted_oracle():
+    """The tokenised-LUT production grid counts exactly what the
+    searchsorted oracle counts, including sentinel padding and
+    duplicate-heavy rows."""
+    S, _ = _stacked_sketches(n=11, s=96, seed=17)
+    assert np.array_equal(sk.sketch_intersections_host(S),
+                          sk._sketch_intersections_searchsorted(S))
+    rng = np.random.default_rng(3)
+    # adversarial: tiny value range forces cross-row collisions, ragged
+    # valid counts exercise every sentinel layout
+    S2 = np.full((13, 32), sk.SENTINEL, np.uint32)
+    for i in range(13):
+        m = int(rng.integers(0, 33))
+        vals = np.unique(rng.integers(0, 40, m).astype(np.uint32))
+        S2[i, :vals.size] = vals
+    assert np.array_equal(sk.sketch_intersections_host(S2),
+                          sk._sketch_intersections_searchsorted(S2))
+
+
+def test_grid_device_matches_host_bitwise():
+    """The vmap'd searchsorted grid and the numpy oracle agree exactly
+    (integer counts, shared float conversion)."""
+    S, valid = _stacked_sketches()
+    host = sk.sketch_intersections_host(S)
+    dev = sk._sketch_intersections_jax(S)
+    assert np.array_equal(host, dev)
+    Dh = sk.sketch_distance_matrix(S, valid, use_jax=False)
+    Dd = sk.sketch_distance_matrix(S, valid, use_jax=True)
+    assert np.array_equal(Dh, Dd)
+
+
+def test_bulk_reconstruction_matches_per_path(tmp_path):
+    """get_sequences_for_ids (pooled gather) is bit-identical to
+    get_sequence_from_path, on both the GFA array-cache path and the
+    position-sweep fallback after a cache invalidation."""
+    asm = make_assemblies(tmp_path, n_assemblies=3, chromosome_len=5000,
+                          plasmid_len=700, n_snps=8, seed=3)
+    graph, sequences = _compress_dir(tmp_path, asm, "out")
+    ids = [q.id for q in sequences]
+    paths = graph.get_unitig_paths_for_sequences(ids)
+    expect = {sid: graph.get_sequence_from_path(paths[sid]) for sid in ids}
+    assert graph._paths_arrays_cache is not None
+    bulk = graph.get_sequences_for_ids(ids)
+    assert set(bulk) == set(ids)
+    for sid in ids:
+        assert np.array_equal(bulk[sid], expect[sid])
+    graph.invalidate_paths_cache()          # force the sweep fallback
+    assert graph._paths_arrays_cache is None
+    bulk2 = graph.get_sequences_for_ids(ids)
+    for sid in ids:
+        assert np.array_equal(bulk2[sid], expect[sid])
+    assert graph.get_sequences_for_ids([]) == {}
+
+
+# ---------------- parity with the exact path ----------------
+
+def _partition(asym, sequences, cutoff=0.2):
+    """The set of tip-id clusters the UPGMA/cutoff path decides."""
+    sym = make_symmetrical_distances(asym, sequences)
+    tree = upgma(sym, sequences)
+    normalise_tree(tree)
+    return {frozenset(tree.get_tips(c))
+            for c in tree.automatic_clustering(cutoff)}
+
+
+def _compress_dir(tmp_path, asm_dir, name):
+    out = tmp_path / name
+    compress(asm_dir, out, k_size=51, use_jax=False)
+    return UnitigGraph.from_gfa_file(out / "input_assemblies.gfa")
+
+
+def test_parity_random_genomes(tmp_path):
+    """Sketch and exact distances produce the same cluster decisions at
+    the default cutoff on rotated + mutated synthetic assemblies."""
+    asm = make_assemblies(tmp_path, n_assemblies=4, chromosome_len=9000,
+                          plasmid_len=1200, n_snps=12, seed=11)
+    graph, sequences = _compress_dir(tmp_path, asm, "out")
+    exact = pairwise_contig_distances(graph, sequences, use_jax=False)
+    sketched = sk.sketch_contig_distances(graph, sequences, use_jax=False)
+    assert set(exact) == set(sketched)
+    assert _partition(exact, sequences) == _partition(sketched, sequences)
+
+
+def test_parity_plasmid_rich_adversarial(tmp_path):
+    """Adversarial plasmid-rich genomes: several small replicons, rotated
+    and strand-flipped per assembly, one plasmid missing from one assembly
+    — cluster decisions still match the exact oracle."""
+    rng = random.Random(7)
+    chromosome = random_genome(rng, 8000)
+    plasmids = [random_genome(rng, n) for n in (2600, 1400, 900)]
+    asm_dir = tmp_path / "plasmid_rich"
+    asm_dir.mkdir()
+    for i in range(4):
+        parts = [f">chromosome_{i}\n{rotate(chromosome, rng.randrange(8000))}\n"]
+        for j, plasmid in enumerate(plasmids):
+            if i == 2 and j == 2:
+                continue  # dropped replicon: min_assemblies pressure
+            p = rotate(plasmid, rng.randrange(len(plasmid)))
+            if (i + j) % 2:
+                p = revcomp(p)
+            parts.append(f">plasmid_{i}_{j}\n{p}\n")
+        (asm_dir / f"assembly_{i + 1}.fasta").write_text("".join(parts))
+    graph, sequences = _compress_dir(tmp_path, asm_dir, "out")
+    exact = pairwise_contig_distances(graph, sequences, use_jax=False)
+    sketched = sk.sketch_contig_distances(graph, sequences, use_jax=False)
+    assert _partition(exact, sequences) == _partition(sketched, sequences)
+
+
+def test_cluster_end_to_end_sketch_mode(tmp_path, monkeypatch):
+    """`cluster` with AUTOCYCLER_SKETCH_DISTANCE=on reproduces the exact
+    path's cluster assignments end to end (reconstructing contig bytes
+    from the graph, since GFA-loaded sequences carry no strands), and
+    journals the distance mode + sketch size."""
+    from autocycler_tpu.obs import qc as obs_qc
+
+    asm = make_assemblies(tmp_path, n_assemblies=4, chromosome_len=7000,
+                          plasmid_len=1000, n_snps=6, seed=21)
+    out = tmp_path / "out"
+    compress(asm, out, k_size=51, use_jax=False)
+
+    def assignments():
+        tsv = (out / "clustering" / "clustering.tsv").read_text().splitlines()
+        return {line.split("\t")[0]: line.split("\t")[2] for line in tsv[1:]}
+
+    monkeypatch.setenv("AUTOCYCLER_SKETCH_DISTANCE", "off")
+    cluster(out, use_jax=False)
+    exact_assign = assignments()
+    obs_qc.reset()
+    monkeypatch.setenv("AUTOCYCLER_SKETCH_DISTANCE", "on")
+    cluster(out, use_jax=False)
+    assert assignments() == exact_assign
+    entries = [e for e in obs_qc.entries()
+               if e["stage"] == "cluster_distance"]
+    assert entries and entries[-1]["metrics"]["mode"] == "sketch"
+    assert entries[-1]["metrics"]["sketch_s"] == 1024
+
+
+def test_verify_mode_records_error(tmp_path, monkeypatch):
+    from autocycler_tpu.obs import qc as obs_qc
+
+    asm = make_assemblies(tmp_path, n_assemblies=3, chromosome_len=6000,
+                          plasmid_len=900, n_snps=0, seed=31)
+    out = tmp_path / "out"
+    compress(asm, out, k_size=51, use_jax=False)
+    obs_qc.reset()
+    monkeypatch.setenv("AUTOCYCLER_SKETCH_DISTANCE", "verify")
+    cluster(out, use_jax=False)
+    entries = [e for e in obs_qc.entries()
+               if e["stage"] == "cluster_distance"]
+    assert entries[-1]["metrics"]["mode"] == "verify"
+    err = entries[-1]["metrics"]["sketch_max_abs_error"]
+    assert 0.0 <= err <= 1.0
+
+
+def test_resolve_distance_mode(monkeypatch):
+    monkeypatch.delenv("AUTOCYCLER_SKETCH_DISTANCE", raising=False)
+    monkeypatch.setenv("AUTOCYCLER_SKETCH_MIN_CONTIGS", "10")
+    assert resolve_distance_mode(9) == "exact"
+    assert resolve_distance_mode(10) == "sketch"
+    for raw, want in (("off", "exact"), ("0", "exact"), ("exact", "exact"),
+                      ("on", "sketch"), ("1", "sketch"), ("sketch", "sketch"),
+                      ("verify", "verify"), ("auto", "exact")):
+        monkeypatch.setenv("AUTOCYCLER_SKETCH_DISTANCE", raw)
+        assert resolve_distance_mode(3) == want, raw
+
+
+# ---------------- cache ----------------
+
+def test_sketch_cache_roundtrip_and_mismatch(tmp_path):
+    cache = EncodeCache(tmp_path / "c")
+    sketch = np.sort(np.random.default_rng(6).integers(
+        0, 2**32 - 1, 64, dtype=np.uint64).astype(np.uint32))
+    cache.store_sketch("ab" * 32, 21, 11, 64, sketch, 64)
+    hit = cache.load_sketch("ab" * 32, 21, 11, 64)
+    assert hit is not None
+    got, m = hit
+    assert m == 64 and np.array_equal(got, sketch)
+    # any parameter change misses by construction
+    assert cache.load_sketch("ab" * 32, 21, 11, 128) is None
+    assert cache.load_sketch("ab" * 32, 19, 11, 64) is None
+    assert cache.load_sketch("cd" * 32, 21, 11, 64) is None
+
+
+def test_sketch_matrix_uses_cache_and_clean_purges(tmp_path, monkeypatch):
+    """sketch_matrix round-trips through the content-addressed cache, and
+    `autocycler clean --cache` purges sketch entries with the rest."""
+    from autocycler_tpu.commands.clean import clean_cache
+
+    asm = make_assemblies(tmp_path, n_assemblies=3, chromosome_len=6000,
+                          plasmid_len=900, n_snps=0, seed=41)
+    out = tmp_path / "out"
+    compress(asm, out, k_size=51, use_jax=False)
+    graph, sequences = UnitigGraph.from_gfa_file(out / "input_assemblies.gfa")
+    cache = EncodeCache(tmp_path / "cachedir")
+    cold, valid_cold, _ = sk.sketch_matrix(graph, sequences, cache=cache)
+    entries = list((tmp_path / "cachedir").glob("sketch-*.npz"))
+    assert len(entries) == len(sequences)
+    warm, valid_warm, _ = sk.sketch_matrix(graph, sequences, cache=cache)
+    assert np.array_equal(cold, warm)
+    assert np.array_equal(valid_cold, valid_warm)
+    clean_cache(tmp_path / "cachedir")
+    assert not list((tmp_path / "cachedir").glob("sketch-*.npz"))
+
+
+def test_purge_cache_counts_sketch_entries(tmp_path):
+    cache = EncodeCache(tmp_path)
+    cache.store_sketch("ef" * 32, 21, 11, 32,
+                       np.zeros(32, np.uint32), 0)
+    removed, reclaimed = purge_cache(tmp_path)
+    assert removed == 1 and reclaimed > 0
