@@ -120,6 +120,10 @@ def batch(assemblies_parent, out_parent, k_size: int = 51,
                 sequences, _ = load_sequences(
                     iso, k_size, InputAssemblyMetrics(), max_contigs, threads,
                     cache=open_cache(out_parent / iso.name))
+                # streamed k-mer spill lives under the isolate's out dir, so
+                # bins from concurrent/killed batch runs never collide
+                from ..stream import prepare_stream_root
+                prepare_stream_root(out_parent / iso.name)
                 graph = build_unitig_graph(sequences, k_size, threads=threads)
                 simplify_structure(graph, sequences)
                 out_dir = out_parent / iso.name

@@ -57,6 +57,14 @@ def clean_cache(cache_dir) -> None:
         os.remove(report_path)
         log.message(f"Purged lint report {report_path}: "
                     f"{report_bytes} bytes reclaimed")
+    # streamed k-mer spill bins are per-run scratch; anything still on disk
+    # here was left behind by a killed or crashed run
+    from ..stream import purge_stream_spills
+    sp_removed, sp_reclaimed = purge_stream_spills(cache_dir)
+    if sp_removed:
+        log.message(f"Purged stream spill dirs under {cache_dir}: "
+                    f"{sp_removed} run dir{'' if sp_removed == 1 else 's'}, "
+                    f"{sp_reclaimed} bytes reclaimed")
     log.message()
 
 

@@ -60,6 +60,10 @@ def compress(assemblies_dir, autocycler_dir, k_size: int = 51,
     os.makedirs(autocycler_dir, exist_ok=True)
     from ..ops.distance import set_probe_cache_dir, start_background_probe
     set_probe_cache_dir(Path(autocycler_dir) / ".cache")
+    # streamed k-mer grouping spills under <autocycler_dir>/.stream; sweep
+    # orphans a killed run left behind before this run starts spilling
+    from ..stream import prepare_stream_root
+    prepare_stream_root(autocycler_dir)
     # No-op when cli.main() already started it; covers library callers that
     # enter compress() directly. Started after the cache dir is set so the
     # runner can adopt a persisted negative result without spawning jax.

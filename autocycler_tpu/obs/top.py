@@ -226,6 +226,17 @@ def render_top_frame(root) -> Optional[str]:
                          f"{obs_report._fmt_bytes(rss[-1])} "
                          f"(peak {obs_report._fmt_bytes(max(rss))})")
 
+        spill = _gauge_series(entries, "autocycler_stream_spill_bytes")
+        bin_deltas = _counter_delta_series(
+            entries, "autocycler_stream_bins_total")
+        if any(spill) or any(bin_deltas):
+            bits = [f"disk {sparkline(spill)} now "
+                    f"{obs_report._fmt_bytes(spill[-1] if spill else 0)} "
+                    f"(peak {obs_report._fmt_bytes(max(spill) if spill else 0)})"]
+            if any(bin_deltas):
+                bits.append(f"bins +{int(sum(bin_deltas))} in view")
+            lines.append("Spill        " + " · ".join(bits))
+
         summary = summarize_timeseries(entries) or {}
         span = summary.get("span_s")
         tick_bits = f"{summary.get('ticks', len(entries))} ticks"

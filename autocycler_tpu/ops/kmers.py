@@ -1203,14 +1203,19 @@ def build_kmer_index(sequences, k: int, use_jax: UseJax = None,
 
     use_jax = _resolve_use_jax(use_jax)
     workers = _effective_workers(_resolve_threads(threads))
+    # streamed two-pass disk-spill grouping (stream/: KMC 2-style signature
+    # bins + global rank merge) takes over the whole grouping stage when
+    # enabled — the fused/in-memory paths below stay the parity oracle
+    from ..stream import resolve_stream_mode
+    stream_on = bool(M) and k > 1 and resolve_stream_mode(M, k)
     if use_fused is None:
         # the single fused native pass wins single-threaded; with usable
         # extra workers on a large input the radix-partitioned grouping
         # pipeline below beats it (concurrent cache-resident buckets)
-        use_fused = (not use_jax
+        use_fused = (not stream_on and not use_jax
                      and not _host_radix_enabled(M, k, workers, None))
     from .. import native
-    if use_fused and M and native.available():
+    if not stream_on and use_fused and M and native.available():
         # the kernel translates ASCII -> symbols inline; no encode pass
         res = native.build_occ_index(buf, fwd_off, rev_off, seq_len, k)
         if res is not None:
@@ -1252,20 +1257,39 @@ def build_kmer_index(sequences, k: int, use_jax: UseJax = None,
     codes = np.concatenate(strand_codes) if strand_codes \
         else encode_bytes(buf)
 
-    # byte start of every occurrence window, built per contiguous strand run
-    # (avoids materialising seq/strand/pos arrays of size M)
-    start_runs = []
-    for i in range(S):
-        L_i = int(seq_len[i])
-        start_runs.append(fwd_off[i] + np.arange(L_i, dtype=np.int64))
-        start_runs.append(rev_off[i] + np.arange(L_i, dtype=np.int64))
-    starts = np.concatenate(start_runs) if start_runs else np.zeros(0, np.int64)
-
     # ---- k-mer grouping ----
-    # per-window ids come back in ORIGINAL order (no scatter needed to
-    # reconstruct occ_kid); dispatch policy lives in group_windows_full
-    gid, order, depth, first_occ = group_windows_stats(codes, starts, k,
-                                                       use_jax, threads)
+    # streamed path first when enabled: disk-spill bins bound the grouping
+    # working set; any spill failure degrades VISIBLY to the in-memory path
+    stats = None
+    if stream_on:
+        from ..stream import stream_group_windows_stats
+        from ..utils.misc import AutocyclerError
+        from ..utils.resilience import record_degrade
+        try:
+            from ..utils.timing import substage
+            with substage("stream-kmers"):
+                stats = stream_group_windows_stats(
+                    codes, seq_len, fwd_off, rev_off, occ_off, k,
+                    use_jax=use_jax, threads=threads)
+        except (AutocyclerError, OSError) as e:
+            record_degrade("stream-kmers", "stream", "in-memory",
+                           f"{type(e).__name__}: {e}")
+
+    starts = None
+    if stats is None:
+        # byte start of every occurrence window, built per contiguous strand
+        # run (avoids materialising seq/strand/pos arrays of size M)
+        start_runs = []
+        for i in range(S):
+            L_i = int(seq_len[i])
+            start_runs.append(fwd_off[i] + np.arange(L_i, dtype=np.int64))
+            start_runs.append(rev_off[i] + np.arange(L_i, dtype=np.int64))
+        starts = np.concatenate(start_runs) if start_runs \
+            else np.zeros(0, np.int64)
+        # per-window ids come back in ORIGINAL order (no scatter needed to
+        # reconstruct occ_kid); dispatch policy lives in group_windows_full
+        stats = group_windows_stats(codes, starts, k, use_jax, threads)
+    gid, order, depth, first_occ = stats
     occ_kid = gid.astype(np.int32)
     U = len(depth)
     depth = depth.astype(np.int64, copy=False)
@@ -1297,7 +1321,14 @@ def build_kmer_index(sequences, k: int, use_jax: UseJax = None,
     # suffices to group the 2U gram instances at the unique k-mers'
     # representative windows: the prefix gram starts at the representative
     # byte offset, the suffix gram one byte later.
-    rep_byte = starts[first_occ]
+    if starts is not None:
+        rep_byte = starts[first_occ]
+    else:
+        # streamed path never materialised the M-sized starts array; the
+        # representative byte offsets follow from the occurrence layout
+        from ..stream import occ_byte_starts
+        rep_byte = occ_byte_starts(first_occ, seq_len, fwd_off, rev_off,
+                                   occ_off)
     gram_starts = np.concatenate([rep_byte, rep_byte + 1])
     gorder, ggid_sorted = group_windows(codes, gram_starts, k - 1, use_jax,
                                         threads)

@@ -1,0 +1,51 @@
+"""Streaming k-mer binning: two-pass disk-spill grouping for
+metagenome-scale compress (the KMC 2 / Gerbil architecture, arXiv:1407.1507
+and arXiv:1607.06618, on top of the existing device kernels).
+
+``build_kmer_index`` dispatches here behind ``AUTOCYCLER_STREAM_KMERS``
+(off/on/auto); the in-memory path stays the parity oracle, and any spill
+failure degrades the run back to it visibly (``record_degrade``) instead
+of crashing. See :mod:`.driver` for the pipeline and
+``docs/performance.md`` for the operational story.
+"""
+
+from .binner import StreamBinner
+from .driver import (BINS_TOTAL, QUARANTINED_BINS_TOTAL, SPILL_BYTES_GAUGE,
+                     stream_group_windows_stats)
+from .merge import merge_ranks
+from .planner import StreamPlan, plan_stream, resolve_stream_mode
+from .sorter import BinGroups, occ_byte_starts, sort_bin
+from .spill import (ORPHANS_SWEPT_TOTAL, purge_stream_spills,
+                    read_bin_records, set_stream_root, stream_root,
+                    sweep_orphan_spills)
+
+__all__ = [
+    "BINS_TOTAL",
+    "BinGroups",
+    "ORPHANS_SWEPT_TOTAL",
+    "QUARANTINED_BINS_TOTAL",
+    "SPILL_BYTES_GAUGE",
+    "StreamBinner",
+    "StreamPlan",
+    "merge_ranks",
+    "occ_byte_starts",
+    "plan_stream",
+    "prepare_stream_root",
+    "purge_stream_spills",
+    "read_bin_records",
+    "resolve_stream_mode",
+    "set_stream_root",
+    "sort_bin",
+    "stream_group_windows_stats",
+    "stream_root",
+    "sweep_orphan_spills",
+]
+
+
+def prepare_stream_root(autocycler_dir) -> None:
+    """Compress/batch startup wiring: install ``<dir>/.stream`` as the
+    spill root and sweep any orphaned run dirs a killed run left behind."""
+    from pathlib import Path
+    root = Path(autocycler_dir) / ".stream"
+    set_stream_root(root)
+    sweep_orphan_spills(root)

@@ -1,0 +1,119 @@
+"""Pass 1: stream windows chunk by chunk into minimizer-signature bins.
+
+Every k-window of every padded strand is one record. A window's bin is a
+pure function of its CONTENT: the minimizer signature is the minimum
+splitmix64-mixed hash over the window's constituent ``sig_k``-mers
+(``ops.sketch``'s ``_kmer_hashes`` + ``_window_minima`` primitives, both
+O(log) array passes), reduced modulo the bin count. Identical k-mers
+therefore always land in the same bin — each k-mer group is wholly
+contained in exactly one bin, which is what lets pass 2 sort bins
+independently and the merge assign exact global lexicographic ranks.
+
+Consecutive windows usually share a minimizer (a super-k-mer), so bin ids
+arrive in long runs and the per-chunk stable sort that routes records to
+write buffers touches few distinct bins per chunk. Buffers are bounded:
+``plan.flush_records`` records per bin, appended to the bin file when full,
+so pass-1 host memory is O(chunk + buffers) however large the input is.
+
+Dot-padded windows are binned like any others — '.' is symbol 0 of the
+5-symbol code space and part of window content, exactly as the in-memory
+grouping treats it.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import List
+
+import numpy as np
+
+from ..ops.sketch import _kmer_hashes, _window_minima
+from ..utils.resilience import fault_fire
+from .planner import StreamPlan
+from .spill import bin_filename, write_manifest
+
+
+class StreamBinner:
+    """Routes one run's window stream into ``plan.n_bins`` on-disk bins
+    under ``run_dir``. Feed strand runs in occurrence order (per sequence:
+    forward strand then reverse strand), then :meth:`close` — records in
+    every bin are strictly ascending occurrence indices, which pass 2's
+    reader validates and the stable per-bin sort relies on for exact
+    first-occurrence parity with the in-memory oracle."""
+
+    def __init__(self, run_dir, plan: StreamPlan, k: int):
+        self.run_dir = Path(run_dir)
+        self.plan = plan
+        self.k = int(k)
+        self.sig_k = min(plan.sig_k, self.k)
+        n = plan.n_bins
+        self._bufs: List[List[np.ndarray]] = [[] for _ in range(n)]
+        self._buffered = np.zeros(n, np.int64)
+        self.counts = np.zeros(n, np.int64)      # records per bin (total)
+        self.spill_bytes = 0
+        write_manifest(self.run_dir, self.k, self.sig_k, n)
+
+    # ---- pass-1 streaming ----
+
+    def add_run(self, run_codes: np.ndarray, occ_start: int) -> None:
+        """Bin every window of one padded strand run (length L + k - 1
+        codes -> L windows, occurrence indices occ_start..occ_start+L-1),
+        in chunks of at most ``plan.chunk_windows`` windows."""
+        L = len(run_codes) - self.k + 1
+        if L <= 0:
+            return
+        chunk = max(1, self.plan.chunk_windows)
+        w = self.k - self.sig_k + 1
+        for lo in range(0, L, chunk):
+            hi = min(lo + chunk, L)
+            # sig_k-mer hashes for positions lo .. hi-1+k-sig_k, then the
+            # sliding minimum over w positions = each window's minimizer
+            hashes = _kmer_hashes(run_codes[lo:hi + self.k - 1], self.sig_k)
+            minima = _window_minima(hashes, w)
+            bins = (minima % np.uint32(self.plan.n_bins)).astype(np.int64)
+            occs = np.arange(occ_start + lo, occ_start + hi, dtype=np.int64)
+            self._route(bins, occs)
+
+    def _route(self, bins: np.ndarray, occs: np.ndarray) -> None:
+        order = np.argsort(bins, kind="stable")
+        sorted_bins = bins[order]
+        sorted_occs = occs[order]
+        uniq, seg_start = np.unique(sorted_bins, return_index=True)
+        seg_end = np.append(seg_start[1:], len(sorted_bins))
+        for b, s, e in zip(uniq, seg_start, seg_end):
+            b = int(b)
+            self._bufs[b].append(sorted_occs[s:e])
+            self._buffered[b] += e - s
+            if self._buffered[b] >= self.plan.flush_records:
+                self._flush(b)
+
+    def _flush(self, b: int) -> None:
+        if not self._bufs[b]:
+            return
+        data = np.ascontiguousarray(
+            np.concatenate(self._bufs[b]).astype("<i8", copy=False))
+        path = self.run_dir / bin_filename(b)
+        if fault_fire("stream_write", path.name) is not None:
+            raise OSError(f"fault injection: stream bin write failed: {path}")
+        with open(path, "ab") as f:
+            f.write(data.tobytes())
+        self.counts[b] += len(data)
+        self.spill_bytes += data.nbytes
+        self._bufs[b] = []
+        self._buffered[b] = 0
+
+    # ---- finalisation ----
+
+    def close(self) -> dict:
+        """Flush every buffer and seal the manifest with per-bin record
+        counts (pass 2 cross-checks them). Returns the spill summary."""
+        for b in range(self.plan.n_bins):
+            self._flush(b)
+        nonempty = int(np.count_nonzero(self.counts))
+        write_manifest(self.run_dir, self.k, self.sig_k, self.plan.n_bins,
+                       counts=self.counts.tolist(),
+                       spill_bytes=self.spill_bytes)
+        return {"bins": nonempty, "n_bins": self.plan.n_bins,
+                "records": int(self.counts.sum()),
+                "spill_bytes": int(self.spill_bytes),
+                "sig_k": int(self.sig_k)}

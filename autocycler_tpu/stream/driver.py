@@ -1,0 +1,163 @@
+"""The two-pass streamed grouping driver.
+
+Drop-in producer of the exact ``(gid, order, depth, first_occ)`` tuple
+``ops.kmers.group_windows_stats`` returns over the full window set — same
+dtypes, same lexicographic global ranks, same stable within-group
+occurrence order — built without ever holding the whole window sort in
+host memory:
+
+1. pass 1 (:class:`.binner.StreamBinner`) spills occurrence records into
+   minimizer-signature bins under the run's ``.stream`` dir;
+2. pass 2 (:mod:`.sorter`) sorts each bin with the existing grouping
+   kernels; the bin reader's corruption verdicts quarantine bad bins
+   (:class:`~autocycler_tpu.utils.resilience.SpillError`) instead of
+   crashing — the caller degrades to the in-memory oracle;
+3. the merge (:mod:`.merge`) ranks bin representatives globally, and the
+   stitch scatters per-bin results into the final M-sized arrays.
+
+Spill posture is observable: ``autocycler_stream_spill_bytes`` (gauge,
+live during pass 1, zeroed when the run dir is removed),
+``autocycler_stream_bins_total`` (counter of bins written), quarantined-bin
+and orphan-sweep counters, a spill line in ``autocycler top``, and bin
+lineage (count, bytes, signature width) in the run ledger.
+"""
+
+from __future__ import annotations
+
+import shutil
+import tempfile
+from pathlib import Path
+from typing import Tuple
+
+import numpy as np
+
+from ..obs import ledger, metrics_registry
+from ..utils.resilience import SpillError
+from ..utils.timing import substage
+from .binner import StreamBinner
+from .merge import merge_ranks
+from .planner import StreamPlan, plan_stream
+from .sorter import sort_bin
+from .spill import (bin_filename, new_run_dir, read_bin_records,
+                    stream_root)
+
+SPILL_BYTES_GAUGE = "autocycler_stream_spill_bytes"
+BINS_TOTAL = "autocycler_stream_bins_total"
+QUARANTINED_BINS_TOTAL = "autocycler_stream_quarantined_bins_total"
+
+
+def _set_spill_gauge(value: int) -> None:
+    metrics_registry.gauge_set(
+        SPILL_BYTES_GAUGE, float(value),
+        help="bytes currently spilled to .stream k-mer bins")
+
+
+def stream_group_windows_stats(codes: np.ndarray, seq_len: np.ndarray,
+                               fwd_byte_off: np.ndarray,
+                               rev_byte_off: np.ndarray,
+                               occ_off: np.ndarray, k: int, use_jax=None,
+                               threads=None,
+                               plan: StreamPlan = None
+                               ) -> Tuple[np.ndarray, np.ndarray,
+                                          np.ndarray, np.ndarray]:
+    """Streamed equivalent of ``group_windows_stats`` over every window of
+    every strand. Raises :class:`SpillError` (or OSError from the spill
+    layer) on corruption/exhaustion; callers catch and fall back to the
+    in-memory path."""
+    S = len(seq_len)
+    M = int(2 * seq_len.sum())
+    if plan is None:
+        plan = plan_stream(M, k)
+    root = stream_root()
+    temp_root = None
+    if root is None:
+        # library callers without compress's wiring still stream correctly;
+        # the tempdir is removed with the run dir below
+        temp_root = Path(tempfile.mkdtemp(prefix="autocycler-stream-"))
+        root = temp_root
+    root.mkdir(parents=True, exist_ok=True)
+    run_dir = new_run_dir(root)
+    try:
+        # ---- pass 1: signature binning with bounded buffers ----
+        with substage("stream-bin"):
+            binner = StreamBinner(run_dir, plan, k)
+            for i in range(S):
+                L = int(seq_len[i])
+                fo, ro = int(fwd_byte_off[i]), int(rev_byte_off[i])
+                base = int(occ_off[i])
+                binner.add_run(codes[fo:fo + L + k - 1], base)
+                binner.add_run(codes[ro:ro + L + k - 1], base + L)
+                _set_spill_gauge(binner.spill_bytes)
+            summary = binner.close()
+        _set_spill_gauge(summary["spill_bytes"])
+        metrics_registry.counter_inc(
+            BINS_TOTAL, summary["bins"],
+            help="stream spill bins written by pass 1")
+        ledger.record_stage("stream-spill", bins=summary["bins"],
+                            n_bins=summary["n_bins"],
+                            records=summary["records"],
+                            spill_bytes=summary["spill_bytes"],
+                            sig_k=summary["sig_k"],
+                            mem_budget_mb=plan.mem_budget_bytes >> 20)
+
+        # ---- pass 2: per-bin sort/count with the existing kernels ----
+        groups = []
+        with substage("stream-sort"):
+            for b in range(plan.n_bins):
+                expected = int(binner.counts[b])
+                if expected == 0:
+                    continue
+                occ, reason = read_bin_records(run_dir / bin_filename(b),
+                                               expected=expected)
+                if occ is None:
+                    metrics_registry.counter_inc(
+                        QUARANTINED_BINS_TOTAL, 1,
+                        help="stream bins quarantined as corrupt in pass 2")
+                    raise SpillError(f"bin {b} quarantined: {reason}")
+                groups.append(sort_bin(codes, occ, seq_len, fwd_byte_off,
+                                       rev_byte_off, occ_off, k,
+                                       use_jax=use_jax, threads=threads))
+
+        # ---- merge: bin-local ranks -> global lexicographic ranks ----
+        with substage("stream-merge"):
+            rep_starts = np.concatenate([g.rep_start for g in groups]) \
+                if groups else np.zeros(0, np.int64)
+            grank = merge_ranks(codes, rep_starts, k, plan.merge_parts)
+
+        # ---- stitch: scatter per-bin groups into the M-sized outputs ----
+        with substage("stream-stitch"):
+            U = len(rep_starts)
+            depth = np.empty(U, np.int64)
+            first_occ = np.empty(U, np.int64)
+            off = 0
+            for g in groups:
+                u = len(g.depth)
+                gr = grank[off:off + u]
+                depth[gr] = g.depth
+                first_occ[gr] = g.first_occ
+                off += u
+            group_start = np.zeros(U + 1, np.int64)
+            np.cumsum(depth, out=group_start[1:])
+            gid = np.empty(M, np.int64)
+            order = np.empty(M, np.int64)
+            off = 0
+            for g in groups:
+                u = len(g.depth)
+                gr = grank[off:off + u]
+                occ_count = len(g.occ_sorted)
+                # element j of the bin's grouped occurrences sits at global
+                # position group_start[rank of its group] + its within-group
+                # offset (local position minus its group's local start)
+                local_start = np.zeros(u, np.int64)
+                np.cumsum(g.depth[:-1], out=local_start[1:])
+                pos = (np.repeat(group_start[gr] - local_start, g.depth)
+                       + np.arange(occ_count, dtype=np.int64))
+                order[pos] = g.occ_sorted
+                gid[g.occ_sorted] = np.repeat(gr, g.depth)
+                off += u
+        return gid, order, depth, first_occ
+    finally:
+        shutil.rmtree(run_dir, ignore_errors=True)
+        if temp_root is not None:
+            shutil.rmtree(temp_root, ignore_errors=True)
+        _set_spill_gauge(0)

@@ -1,0 +1,113 @@
+"""Streaming plan: bin count, chunk size and buffer sizes from input stats.
+
+The two-pass spill pipeline (KMC 2 arXiv:1407.1507 / Gerbil arXiv:1607.06618)
+has three memory consumers that must share one host budget
+(``AUTOCYCLER_STREAM_MEM_MB``):
+
+- pass 1 chunk temporaries: the minimizer-signature computation holds a few
+  transient arrays per window of the current chunk;
+- pass 1 write buffers: one bounded record buffer per on-disk bin;
+- pass 2 per-bin sort: the grouping kernels' working set scales with the
+  records of the single bin being sorted, so the bin count is chosen to make
+  one bin's sort fit the budget.
+
+Everything here is a pure function of (window count, k, knobs) so the plan
+is deterministic and unit-testable without touching the disk.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..utils.knobs import knob_int, knob_str
+
+# pass-2 per-record working set of the host grouping kernels: the byte
+# starts (8) + order/gid outputs (16) + the packed lexsort keys
+# (4 bytes per int32 word, SYMS_PER_WORD=10 symbols per word)
+_SORT_BYTES_BASE = 24
+# pass-1 per-window chunk temporaries: uint64 polynomial pack + uint32
+# hash + window minima + occurrence index + the stable bin sort
+_PASS1_BYTES_PER_WINDOW = 48
+# merge per-rep working set mirrors the pass-2 sort record
+_RECORD_BYTES = 8
+
+
+def _sort_bytes_per_record(k: int) -> int:
+    return _SORT_BYTES_BASE + 4 * ((k + 9) // 10)
+
+
+@dataclass(frozen=True)
+class StreamPlan:
+    """One streamed-grouping run's shape, fixed before pass 1 starts."""
+
+    n_bins: int            # on-disk signature bins
+    chunk_windows: int     # pass-1 windows binned per chunk
+    flush_records: int     # per-bin buffered records before a disk append
+    sig_k: int             # minimizer signature m-mer length
+    merge_parts: int       # radix chunks for the global rank merge
+    mem_budget_bytes: int  # the budget the sizes were derived from
+    est_windows: int       # window count the plan was sized for
+
+    @property
+    def buffer_bytes(self) -> int:
+        """Worst-case bytes held across all bin write buffers."""
+        return self.n_bins * self.flush_records * _RECORD_BYTES
+
+
+def _clamp(value: int, lo: int, hi: int) -> int:
+    return max(lo, min(hi, int(value)))
+
+
+def plan_stream(total_windows: int, k: int) -> StreamPlan:
+    """Size bins/chunks/buffers for ``total_windows`` windows of length ``k``
+    under the ``AUTOCYCLER_STREAM_MEM_MB`` budget. Explicit
+    ``AUTOCYCLER_STREAM_BINS`` / ``AUTOCYCLER_STREAM_CHUNK`` values override
+    the derived sizes (tests force multi-bin/multi-chunk paths on tiny
+    inputs this way)."""
+    total_windows = max(1, int(total_windows))
+    mem_mb = max(64, int(knob_int("AUTOCYCLER_STREAM_MEM_MB")))
+    budget = mem_mb << 20
+
+    # pass 2 gets half the budget: records per bin so one bin sorts in-budget
+    sort_bytes = _sort_bytes_per_record(k)
+    target_bin_records = max(1, (budget // 2) // sort_bytes)
+    n_bins = _clamp(-(-total_windows // target_bin_records), 8, 1024)
+    bins_override = int(knob_int("AUTOCYCLER_STREAM_BINS"))
+    if bins_override > 0:
+        n_bins = _clamp(bins_override, 1, 4096)
+
+    # pass 1 chunk temporaries get an eighth of the budget
+    chunk = _clamp((budget // 8) // _PASS1_BYTES_PER_WINDOW, 1 << 12, 1 << 22)
+    chunk_override = int(knob_int("AUTOCYCLER_STREAM_CHUNK"))
+    if chunk_override > 0:
+        chunk = _clamp(chunk_override, 1, 1 << 24)
+
+    # bounded write buffers get another eighth, split evenly across bins
+    flush = _clamp((budget // 8) // (n_bins * _RECORD_BYTES), 256, 1 << 20)
+
+    # the merge ranks at most one rep per window; chunk it like pass 2
+    merge_parts = _clamp(-(-total_windows * sort_bytes // (budget // 2)),
+                         16, 4096)
+
+    sig_k = _clamp(int(knob_int("AUTOCYCLER_STREAM_SIG_K")), 4, min(k, 27))
+    return StreamPlan(n_bins=n_bins, chunk_windows=chunk, flush_records=flush,
+                      sig_k=sig_k, merge_parts=merge_parts,
+                      mem_budget_bytes=budget, est_windows=total_windows)
+
+
+_MODE_OFF = ("off", "0", "no", "false")
+
+
+def resolve_stream_mode(total_windows: int, k: int) -> bool:
+    """Dispatch policy for the streamed grouping path: 'on'/'off' force,
+    'auto' (the default, and any unrecognised value) engages above the
+    ``AUTOCYCLER_STREAM_AUTO_WINDOWS`` threshold — large enough that every
+    in-RAM workload keeps the lower-latency in-memory path."""
+    mode = (knob_str("AUTOCYCLER_STREAM_KMERS") or "auto").strip().lower()
+    if mode == "on":
+        return True
+    if mode in _MODE_OFF:
+        return False
+    if total_windows <= 0 or k < 2:
+        return False
+    return total_windows >= int(knob_int("AUTOCYCLER_STREAM_AUTO_WINDOWS"))

@@ -1,0 +1,67 @@
+"""Pass 2: sort/count one bin with the existing grouping kernels.
+
+A bin is a list of ascending occurrence indices whose windows all share a
+minimizer signature. Byte starts are recomputed arithmetically from the
+sequence layout (no M-sized global ``starts`` array is ever materialised on
+the streamed path), then the bin goes through ``ops.kmers``'s
+:func:`group_windows_stats` — the same fused radix rank+depth+first-occ
+dispatch (native hash kernel / numpy lexsort / device radix) the in-memory
+path uses, just at bin scale, so one bin's working set fits the plan's
+budget and per-group statistics come out bit-identical.
+
+Per-bin results are bin-local ranks; :mod:`.merge` lifts them to global
+lexicographic ranks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..ops.kmers import group_windows_stats
+
+
+def occ_byte_starts(occ: np.ndarray, seq_len: np.ndarray,
+                    fwd_byte_off: np.ndarray, rev_byte_off: np.ndarray,
+                    occ_off: np.ndarray) -> np.ndarray:
+    """Byte offset (into the concatenated padded strand buffer) of each
+    occurrence's window start — the arithmetic inverse of the occurrence
+    layout (per sequence: L forward windows then L reverse windows)."""
+    occ = np.asarray(occ, dtype=np.int64)
+    seq_idx = np.searchsorted(occ_off, occ, side="right") - 1
+    rel = occ - occ_off[seq_idx]
+    L = seq_len[seq_idx]
+    fwd = rel < L
+    return np.where(fwd, fwd_byte_off[seq_idx] + rel,
+                    rev_byte_off[seq_idx] + rel - L)
+
+
+@dataclass
+class BinGroups:
+    """One bin's groups in bin-local lexicographic order, with every field
+    already lifted to GLOBAL occurrence coordinates."""
+
+    occ_sorted: np.ndarray   # occurrences grouped by local rank, ascending
+    depth: np.ndarray        # per-group occurrence count
+    first_occ: np.ndarray    # smallest occurrence index per group
+    rep_start: np.ndarray    # byte start of each group's first occurrence
+
+
+def sort_bin(codes: np.ndarray, occ: np.ndarray, seq_len: np.ndarray,
+             fwd_byte_off: np.ndarray, rev_byte_off: np.ndarray,
+             occ_off: np.ndarray, k: int, use_jax=None,
+             threads=None) -> BinGroups:
+    """Group one bin's windows. The bin's records are ascending occurrence
+    indices and the grouping sort is stable, so within every group the
+    occurrence order is ascending and ``first_occ`` is the true global
+    minimum — the properties the oracle's ``group_windows_stats`` output
+    has over the full window set."""
+    starts = occ_byte_starts(occ, seq_len, fwd_byte_off, rev_byte_off,
+                             occ_off)
+    _, order, depth, first_local = group_windows_stats(
+        codes, starts, k, use_jax=use_jax, threads=threads)
+    return BinGroups(occ_sorted=occ[order],
+                     depth=depth.astype(np.int64, copy=False),
+                     first_occ=occ[first_local],
+                     rep_start=starts[first_local])

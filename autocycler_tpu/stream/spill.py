@@ -1,0 +1,191 @@
+"""On-disk spill layout for the streamed k-mer grouping.
+
+Layout under ``<autocycler_dir>/.stream/``::
+
+    .stream/
+      run-<pid>-<token>/
+        manifest.json        {"version": 1, "pid": ..., "k": ..., "sig_k":
+                              ..., "n_bins": ..., "counts": [...], ...}
+        bin-0000.u64         little-endian int64 occurrence indices
+        bin-0001.u64
+        ...
+
+A live run owns exactly one run dir and removes it when grouping finishes
+(success or failure). Runs killed mid-pass leave their dir behind; the
+orphan sweep on the next compress startup removes every run dir whose
+recorded pid is no longer alive (and any dir without a readable manifest).
+
+Bin files are raw little-endian int64 records. The reader is never-raise:
+torn tails (size not a whole record multiple), count mismatches against the
+manifest, non-ascending records and unreadable files all come back as a
+``(None, reason)`` verdict for the caller to quarantine — a corrupt spill
+must degrade the run to the in-memory oracle, not crash it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import uuid
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..obs import metrics_registry
+from ..utils.resilience import fault_fire
+
+MANIFEST_NAME = "manifest.json"
+MANIFEST_VERSION = 1
+RECORD_DTYPE = "<i8"
+RECORD_BYTES = 8
+
+ORPHANS_SWEPT_TOTAL = "autocycler_stream_orphans_swept_total"
+
+_root_lock = threading.Lock()
+_stream_root: Optional[Path] = None
+
+
+def set_stream_root(path) -> None:
+    """Install the spill root (``<autocycler_dir>/.stream``) for this
+    process; compress/batch call this before building the unitig graph."""
+    global _stream_root
+    with _root_lock:
+        _stream_root = Path(path) if path is not None else None
+
+
+def stream_root() -> Optional[Path]:
+    with _root_lock:
+        return _stream_root
+
+
+def bin_filename(b: int) -> str:
+    return f"bin-{b:04d}.u64"
+
+
+def new_run_dir(root: Path) -> Path:
+    run = Path(root) / f"run-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+    run.mkdir(parents=True, exist_ok=False)
+    return run
+
+
+def write_manifest(run_dir: Path, k: int, sig_k: int, n_bins: int,
+                   counts: Optional[List[int]] = None,
+                   spill_bytes: int = 0) -> None:
+    payload = {"version": MANIFEST_VERSION, "pid": os.getpid(), "k": int(k),
+               "sig_k": int(sig_k), "n_bins": int(n_bins),
+               "spill_bytes": int(spill_bytes),
+               "counts": [int(c) for c in counts] if counts is not None
+               else None}
+    tmp = Path(run_dir) / (MANIFEST_NAME + ".tmp")
+    tmp.write_text(json.dumps(payload) + "\n")
+    os.replace(tmp, Path(run_dir) / MANIFEST_NAME)
+
+
+def read_manifest(run_dir) -> Optional[dict]:
+    """The run manifest, or None when missing/unreadable (never raises)."""
+    try:
+        data = json.loads((Path(run_dir) / MANIFEST_NAME).read_text())
+    except (OSError, ValueError):
+        return None
+    return data if isinstance(data, dict) else None
+
+
+def read_bin_records(path, expected: Optional[int] = None
+                     ) -> Tuple[Optional[np.ndarray], Optional[str]]:
+    """Load one bin file's occurrence records: ``(records, None)`` on
+    success, ``(None, reason)`` on any corruption — never raises.
+
+    Validity means: readable, a whole number of records, the manifest's
+    record count when given, and strictly ascending occurrence indices
+    (pass 1 appends each occurrence exactly once in ascending order, so
+    anything else is a torn or mangled file)."""
+    if fault_fire("stream_read", os.path.basename(str(path))) is not None:
+        return None, "fault injection: forced corrupt bin read"
+    try:
+        data = Path(path).read_bytes()
+    except OSError as e:
+        return None, f"unreadable bin file: {e}"
+    if len(data) % RECORD_BYTES:
+        return None, (f"torn bin file: {len(data)} bytes is not a whole "
+                      f"multiple of the {RECORD_BYTES}-byte record")
+    occ = np.frombuffer(data, dtype=RECORD_DTYPE).astype(np.int64)
+    if expected is not None and len(occ) != int(expected):
+        return None, (f"bin holds {len(occ)} records but the manifest "
+                      f"recorded {int(expected)}")
+    if len(occ) and (occ[0] < 0 or np.any(np.diff(occ) <= 0)):
+        return None, "bin records are not strictly ascending"
+    return occ, None
+
+
+def _dir_bytes(path: Path) -> int:
+    total = 0
+    for p in path.rglob("*"):
+        try:
+            if p.is_file():
+                total += p.stat().st_size
+        except OSError:
+            continue
+    return total
+
+
+def _pid_alive(pid: int) -> bool:
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except OSError:
+        return True      # exists but not ours (EPERM)
+    return True
+
+
+def sweep_orphan_spills(root) -> int:
+    """Remove run dirs left behind by killed runs: every ``run-*`` dir under
+    ``root`` whose manifest pid is dead (or whose manifest is unreadable).
+    Returns the number of dirs removed; fires the orphan-sweep counter when
+    any were."""
+    root = Path(root)
+    if not root.is_dir():
+        return 0
+    swept = 0
+    for run in sorted(root.glob("run-*")):
+        if not run.is_dir():
+            continue
+        manifest = read_manifest(run)
+        pid = int(manifest.get("pid") or 0) if manifest else 0
+        if pid == os.getpid() or (manifest is not None and _pid_alive(pid)):
+            continue
+        shutil.rmtree(run, ignore_errors=True)
+        swept += 1
+    if swept:
+        metrics_registry.counter_inc(
+            ORPHANS_SWEPT_TOTAL, swept,
+            help="orphaned stream spill dirs removed at startup")
+        from ..utils import log
+        log.message(f"Swept {swept} orphaned .stream spill "
+                    f"director{'y' if swept == 1 else 'ies'} under {root}")
+    return swept
+
+
+def purge_stream_spills(cache_dir) -> Tuple[int, int]:
+    """``autocycler clean --cache`` hook: remove the whole ``.stream``
+    spill tree under an autocycler dir. Returns (run dirs removed, bytes
+    reclaimed); (0, 0) when there is nothing to purge."""
+    target = Path(cache_dir)
+    if target.name == ".stream":
+        root = target
+    elif target.name == ".cache":
+        # clean --cache accepts the cache dir itself; spills live beside it
+        root = target.parent / ".stream"
+    else:
+        root = target / ".stream"
+    if not root.is_dir():
+        return 0, 0
+    removed = sum(1 for p in root.glob("run-*") if p.is_dir())
+    reclaimed = _dir_bytes(root)
+    shutil.rmtree(root, ignore_errors=True)
+    return removed, reclaimed

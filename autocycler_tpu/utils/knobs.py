@@ -261,6 +261,43 @@ KNOBS: Dict[str, Knob] = dict(
             0,
             "Row-block size for the exact host distance contraction; <=0 computes the whole matrix at once.",
         ),
+        # --- streaming k-mer spill (two-pass disk binning) ------------------
+        _k(
+            "AUTOCYCLER_STREAM_KMERS",
+            "str",
+            "auto",
+            "Streamed two-pass k-mer grouping: 'on'/'off' force it, 'auto' engages above AUTOCYCLER_STREAM_AUTO_WINDOWS windows.",
+        ),
+        _k(
+            "AUTOCYCLER_STREAM_MEM_MB",
+            "int",
+            512,
+            "Host working-set budget in MiB for the streamed grouping (sizes bins, pass-1 chunks and write buffers).",
+        ),
+        _k(
+            "AUTOCYCLER_STREAM_AUTO_WINDOWS",
+            "int",
+            64_000_000,
+            "Window count (2x total input bases) at which 'auto' streaming engages.",
+        ),
+        _k(
+            "AUTOCYCLER_STREAM_BINS",
+            "int",
+            0,
+            "Override the planned on-disk bin count; <=0 lets the planner size bins from the memory budget.",
+        ),
+        _k(
+            "AUTOCYCLER_STREAM_CHUNK",
+            "int",
+            0,
+            "Override the planned pass-1 chunk size in windows; <=0 lets the planner choose.",
+        ),
+        _k(
+            "AUTOCYCLER_STREAM_SIG_K",
+            "int",
+            11,
+            "Minimizer-signature m-mer length for bin assignment (clamped to k and 27).",
+        ),
         # --- caches --------------------------------------------------------
         _k(
             "AUTOCYCLER_COMPILE_CACHE",

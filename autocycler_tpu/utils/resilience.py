@@ -96,6 +96,13 @@ class BackendError(AutocyclerError):
     unavailable or misbehaving and no fallback exists."""
 
 
+class SpillError(AutocyclerError):
+    """The streamed k-mer grouping's on-disk spill is unusable (torn or
+    truncated bin, manifest/record mismatch, duplicate representatives
+    across bins). Callers quarantine the spill and degrade to the
+    in-memory grouping path instead of crashing the run."""
+
+
 class IsolateError(AutocyclerError):
     """A per-isolate failure inside a multi-isolate batch: quarantined and
     recorded in the run manifest instead of killing the whole run."""
@@ -157,8 +164,10 @@ def collect_errors() -> ErrorCollector:
 #   native_load   native._get_lib_locked (library load fails)
 #   native_abi    native._get_lib_locked (ABI version mismatch)
 #   native_build  native._build (rebuild fails)
+#   stream_write  stream.binner bin-file append, keyed by bin filename
+#   stream_read   stream.spill.read_bin_records, keyed by bin filename
 FAULT_SITES = ("subprocess", "fasta", "gfa", "native_load", "native_abi",
-               "native_build")
+               "native_build", "stream_write", "stream_read")
 
 
 @dataclass

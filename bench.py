@@ -982,6 +982,162 @@ def sketchsmoke_row(root=None) -> dict:
     return row
 
 
+STREAMSMOKE_PATH = Path(__file__).resolve().parent / "STREAMSMOKE.json"
+
+# one child process per grouping mode; each prints exactly one JSON line:
+# the GFA digest plus the RSS delta sampled across build_unitig_graph only
+# (baseline after load, sampler stopped before the GFA write), so the two
+# modes' grouping working sets are compared with identical surroundings
+_STREAMSMOKE_CHILD = r"""
+import hashlib, json, os, sys, threading, time
+from pathlib import Path
+
+asm_dir, out_dir, k = sys.argv[1], sys.argv[2], int(sys.argv[3])
+from autocycler_tpu.commands.compress import load_sequences
+from autocycler_tpu.metrics import InputAssemblyMetrics
+from autocycler_tpu.ops.graph_build import build_unitig_graph
+from autocycler_tpu.stream import prepare_stream_root
+
+page = os.sysconf("SC_PAGE_SIZE")
+
+def rss():
+    with open("/proc/self/statm") as f:
+        return int(f.read().split()[1]) * page
+
+os.makedirs(out_dir, exist_ok=True)
+prepare_stream_root(out_dir)
+sequences, _ = load_sequences(asm_dir, k, InputAssemblyMetrics(), 25, 1)
+peak = [0]
+stop = threading.Event()
+
+def sample():
+    while not stop.is_set():
+        peak[0] = max(peak[0], rss())
+        time.sleep(0.02)
+
+base = rss()
+t = threading.Thread(target=sample, daemon=True)
+t.start()
+graph = build_unitig_graph(sequences, k, use_jax=False, threads=1)
+stop.set()
+t.join()
+gfa = Path(out_dir) / "input_assemblies.gfa"
+graph.save_gfa(gfa, sequences)
+print(json.dumps({"sha256": hashlib.sha256(gfa.read_bytes()).hexdigest(),
+                  "base_rss": base, "peak_rss": max(peak[0], rss()),
+                  "delta": max(peak[0], rss()) - base}))
+"""
+
+
+def bench_streamsmoke() -> None:
+    """`python bench.py streamsmoke`: streamed two-pass disk-spill k-mer
+    grouping vs the in-memory oracle on a ~100-contig synthetic input
+    (100 assemblies of a 90 kb chromosome + 2 kb plasmid, ~18M windows
+    at k=51). Each mode runs in its own child process with the host
+    grouping pinned to the monolithic numpy backend, sampling RSS across
+    build_unitig_graph only. Passes when the two GFAs are byte-identical
+    AND the streamed grouping RSS delta stays within the
+    AUTOCYCLER_STREAM_MEM_MB budget while the in-memory delta exceeds
+    it. Writes STREAMSMOKE.json (surfaced by `bench.py trend`); one JSON
+    line on stdout; exit 1 on fail."""
+    import os
+    import shutil
+    import subprocess
+
+    tests_dir = str(Path(__file__).resolve().parent / "tests")
+    if tests_dir not in sys.path:
+        sys.path.insert(0, tests_dir)
+    from synthetic import make_assemblies_fast
+
+    budget_mb = 768
+    k = 51
+    t0 = time.perf_counter()
+    tmp = Path(tempfile.mkdtemp(prefix="autocycler_streamsmoke_"))
+    asm = make_assemblies_fast(tmp, n_assemblies=100, chromosome_len=90_000,
+                               plasmid_len=2_000, n_snps=180, seed=9)
+    child = tmp / "child.py"
+    child.write_text(_STREAMSMOKE_CHILD)
+    setup_s = time.perf_counter() - t0
+
+    repo_root = str(Path(__file__).resolve().parent)
+
+    def run(mode_env, out_name):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        env.update({"JAX_PLATFORMS": "cpu",
+                    "AUTOCYCLER_HOST_GROUPING": "numpy",
+                    "AUTOCYCLER_STREAM_MEM_MB": str(budget_mb)})
+        env.update(mode_env)
+        t = time.perf_counter()
+        res = subprocess.run(
+            [sys.executable, str(child), str(asm), str(tmp / out_name),
+             str(k)], env=env, capture_output=True, text=True, timeout=1800)
+        wall = time.perf_counter() - t
+        if res.returncode != 0:
+            print(res.stdout, file=sys.stderr)
+            print(res.stderr, file=sys.stderr)
+            raise RuntimeError(f"streamsmoke child ({out_name}) failed "
+                               f"rc={res.returncode}")
+        return json.loads(res.stdout.strip().splitlines()[-1]), wall
+
+    streamed, stream_wall = run({"AUTOCYCLER_STREAM_KMERS": "on"}, "streamed")
+    in_mem, mem_wall = run({"AUTOCYCLER_STREAM_KMERS": "off"}, "inmem")
+
+    budget_bytes = budget_mb << 20
+    identical = streamed["sha256"] == in_mem["sha256"]
+    stream_bounded = streamed["delta"] <= budget_bytes
+    mem_exceeds = in_mem["delta"] > budget_bytes
+    passed = bool(identical and stream_bounded and mem_exceeds)
+    artifact = {
+        "bench": "streamsmoke",
+        "passed": passed,
+        "identical_gfa": identical,
+        "budget_mb": budget_mb,
+        "stream_delta_mb": round(streamed["delta"] / 2**20, 1),
+        "inmem_delta_mb": round(in_mem["delta"] / 2**20, 1),
+        "stream_bounded": stream_bounded,
+        "inmem_exceeds_budget": mem_exceeds,
+        "rss_reduction": round(in_mem["delta"] / streamed["delta"], 2)
+        if streamed["delta"] else None,
+        "stream_wall_s": round(stream_wall, 2),
+        "inmem_wall_s": round(mem_wall, 2),
+        "setup_s": round(setup_s, 2),
+        "gfa_sha256": streamed["sha256"],
+    }
+    STREAMSMOKE_PATH.write_text(json.dumps(artifact, indent=2) + "\n")
+    print(json.dumps(artifact))
+    shutil.rmtree(tmp, ignore_errors=True)
+    if not passed:
+        sys.exit(1)
+
+
+def streamsmoke_row(root=None) -> dict:
+    """The latest streamsmoke artifact as one trend row; every field
+    optional (absent/invalid artifact → None-valued row, never a raise)."""
+    path = Path(root) / "STREAMSMOKE.json" if root is not None \
+        else STREAMSMOKE_PATH
+    row = {"present": False, "passed": None, "identical_gfa": None,
+           "budget_mb": None, "stream_delta_mb": None, "inmem_delta_mb": None,
+           "rss_reduction": None}
+    try:
+        data = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return row
+    if not isinstance(data, dict):
+        return row
+    row.update({
+        "present": True,
+        "passed": data.get("passed"),
+        "identical_gfa": data.get("identical_gfa"),
+        "budget_mb": data.get("budget_mb"),
+        "stream_delta_mb": data.get("stream_delta_mb"),
+        "inmem_delta_mb": data.get("inmem_delta_mb"),
+        "rss_reduction": data.get("rss_reduction"),
+    })
+    return row
+
+
 GUARD_BASELINE_PATH = Path(__file__).resolve().parent / "BENCH_GUARD.json"
 GUARD_TOLERANCE = 1.25
 
@@ -1411,9 +1567,21 @@ def bench_trend() -> None:
               f"clusters identical: {sketch.get('identical_clusters')})  "
               f"(SKETCHSMOKE.json)",
               file=sys.stderr)
+    stream = streamsmoke_row()
+    if stream.get("present"):
+        verdict = "ok" if stream.get("passed") else "FAIL"
+        print("", file=sys.stderr)
+        print(f"streamsmoke: {verdict} "
+              f"{fmt(stream.get('rss_reduction'), '.2f')}x RSS reduction "
+              f"(stream {fmt(stream.get('stream_delta_mb'), '.0f')}MB vs "
+              f"in-mem {fmt(stream.get('inmem_delta_mb'), '.0f')}MB, "
+              f"budget {fmt(stream.get('budget_mb'))}MB, "
+              f"GFA identical: {stream.get('identical_gfa')})  "
+              f"(STREAMSMOKE.json)",
+              file=sys.stderr)
     print(json.dumps({"bench": "trend", "rounds": rows,
                       "multichip": mrows, "lintsmoke": lint,
-                      "sketchsmoke": sketch}))
+                      "sketchsmoke": sketch, "streamsmoke": stream}))
 
 
 def main() -> None:
@@ -1455,6 +1623,8 @@ def main() -> None:
         bench_lintsmoke()
     elif len(sys.argv) > 1 and sys.argv[1] == "sketchsmoke":
         bench_sketchsmoke()
+    elif len(sys.argv) > 1 and sys.argv[1] == "streamsmoke":
+        bench_streamsmoke()
     elif len(sys.argv) > 1 and sys.argv[1] == "guard":
         bench_guard(sys.argv[2:])
     elif len(sys.argv) > 1 and sys.argv[1] == "trend":

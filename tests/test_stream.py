@@ -1,0 +1,462 @@
+"""Streamed two-pass disk-spill k-mer grouping (stream/): planner sizing,
+pass-1 binning + pass-2 sort + global rank merge parity against the
+in-memory oracle, the never-raise bin reader, fault-injected spill
+corruption (quarantine + degrade, never a crash), the orphan sweep,
+`clean --cache` purging and the `top` spill line."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from autocycler_tpu.models.sequence import Sequence
+from autocycler_tpu.ops.kmers import build_kmer_index, group_windows_stats
+from autocycler_tpu.stream import (plan_stream, prepare_stream_root,
+                                   purge_stream_spills, read_bin_records,
+                                   resolve_stream_mode, set_stream_root,
+                                   stream_group_windows_stats, stream_root,
+                                   sweep_orphan_spills)
+from autocycler_tpu.stream.sorter import occ_byte_starts
+from autocycler_tpu.stream.spill import (bin_filename, new_run_dir,
+                                         write_manifest)
+from autocycler_tpu.utils import resilience as rz
+
+pytestmark = pytest.mark.stream
+
+K = 15
+
+STREAM_KNOBS = ("AUTOCYCLER_STREAM_KMERS", "AUTOCYCLER_STREAM_MEM_MB",
+                "AUTOCYCLER_STREAM_AUTO_WINDOWS", "AUTOCYCLER_STREAM_BINS",
+                "AUTOCYCLER_STREAM_CHUNK", "AUTOCYCLER_STREAM_SIG_K",
+                "AUTOCYCLER_FAULTS")
+
+
+@pytest.fixture(autouse=True)
+def _clean_stream_state(monkeypatch):
+    for name in STREAM_KNOBS:
+        monkeypatch.delenv(name, raising=False)
+    set_stream_root(None)
+    rz.set_fault_plan(None)
+    rz._reset_degrades_for_tests()
+    yield
+    set_stream_root(None)
+    rz.set_fault_plan(None)
+    rz._reset_degrades_for_tests()
+
+
+def _random_seqs(seed=0, lengths=(500, 333, 801, 64)):
+    rng = np.random.default_rng(seed)
+    return ["".join(rng.choice(list("ACGT"), size=n)) for n in lengths]
+
+
+def _adversarial_seqs():
+    """Duplication-heavy + plasmid-rich: a repeated block shared across
+    several contigs (deep k-mer groups spanning sequences) plus many short
+    plasmid-like contigs (lots of window-0 and dot-padded windows)."""
+    rng = np.random.default_rng(7)
+    core = "".join(rng.choice(list("ACGT"), size=400))
+    seqs = [core * 3, core[:150] + core[:150], core[::-1]]
+    seqs += ["".join(rng.choice(list("ACGT"), size=n))
+             for n in (40, 51, 33, 64, 29, 77)]
+    seqs += [seqs[3], seqs[4]]          # exact duplicate contigs
+    return seqs
+
+
+def _objects(seqs, k=K):
+    return [Sequence.with_seq(i + 1, s, "t.fa", f"c{i}", k // 2)
+            for i, s in enumerate(seqs)]
+
+
+def _layout(seqs, k=K):
+    """The (codes, seq_len, fwd_off, rev_off, occ_off, starts) layout
+    build_kmer_index derives, for driving the stats-level APIs directly."""
+    objs = _objects(seqs, k)
+    bufs, seq_len, fwd_off, rev_off, occ_off = [], [], [], [], []
+    pos = occ = 0
+    for o in objs:
+        f, r = o.encoded_strands()
+        L = len(f) - k + 1
+        seq_len.append(L)
+        fwd_off.append(pos); bufs.append(f); pos += len(f)
+        rev_off.append(pos); bufs.append(r); pos += len(r)
+        occ_off.append(occ); occ += 2 * L
+    codes = np.concatenate(bufs)
+    seq_len = np.array(seq_len, np.int64)
+    fwd_off = np.array(fwd_off, np.int64)
+    rev_off = np.array(rev_off, np.int64)
+    occ_off = np.array(occ_off, np.int64)
+    # occurrence order interleaves per sequence: forward run then reverse run
+    runs = []
+    for i in range(len(objs)):
+        L = int(seq_len[i])
+        runs.append(np.arange(fwd_off[i], fwd_off[i] + L, dtype=np.int64))
+        runs.append(np.arange(rev_off[i], rev_off[i] + L, dtype=np.int64))
+    starts = np.concatenate(runs)
+    return codes, seq_len, fwd_off, rev_off, occ_off, starts
+
+
+# ---------------------------------------------------------------------------
+# planner
+# ---------------------------------------------------------------------------
+
+def test_plan_is_deterministic_and_clamped(monkeypatch):
+    monkeypatch.setenv("AUTOCYCLER_STREAM_MEM_MB", "512")
+    a = plan_stream(10_000_000, 51)
+    b = plan_stream(10_000_000, 51)
+    assert a == b
+    assert 8 <= a.n_bins <= 1024
+    assert 1 << 12 <= a.chunk_windows <= 1 << 22
+    assert 256 <= a.flush_records <= 1 << 20
+    assert 16 <= a.merge_parts <= 4096
+    assert a.buffer_bytes <= a.mem_budget_bytes
+    # tiny budget floors at 64 MiB; tiny input still gets >= 8 bins
+    monkeypatch.setenv("AUTOCYCLER_STREAM_MEM_MB", "1")
+    tiny = plan_stream(100, 15)
+    assert tiny.mem_budget_bytes == 64 << 20
+    assert tiny.n_bins >= 8
+
+
+def test_plan_scales_bins_with_input(monkeypatch):
+    monkeypatch.setenv("AUTOCYCLER_STREAM_MEM_MB", "64")
+    small = plan_stream(1_000_000, 51)
+    big = plan_stream(400_000_000, 51)
+    assert big.n_bins > small.n_bins
+
+
+def test_plan_overrides(monkeypatch):
+    monkeypatch.setenv("AUTOCYCLER_STREAM_BINS", "3")
+    monkeypatch.setenv("AUTOCYCLER_STREAM_CHUNK", "500")
+    monkeypatch.setenv("AUTOCYCLER_STREAM_SIG_K", "9")
+    p = plan_stream(1_000_000, 51)
+    assert p.n_bins == 3 and p.chunk_windows == 500 and p.sig_k == 9
+    # sig_k clamps to k and to the 27-symbol exact-pack cap
+    monkeypatch.setenv("AUTOCYCLER_STREAM_SIG_K", "99")
+    assert plan_stream(1000, 15).sig_k == 15
+    assert plan_stream(1000, 51).sig_k == 27
+
+
+def test_resolve_stream_mode(monkeypatch):
+    monkeypatch.setenv("AUTOCYCLER_STREAM_KMERS", "on")
+    assert resolve_stream_mode(10, 15)
+    monkeypatch.setenv("AUTOCYCLER_STREAM_KMERS", "off")
+    assert not resolve_stream_mode(10**12, 15)
+    monkeypatch.setenv("AUTOCYCLER_STREAM_KMERS", "auto")
+    monkeypatch.setenv("AUTOCYCLER_STREAM_AUTO_WINDOWS", "1000")
+    assert resolve_stream_mode(1000, 15)
+    assert not resolve_stream_mode(999, 15)
+
+
+# ---------------------------------------------------------------------------
+# parity with the in-memory oracle
+# ---------------------------------------------------------------------------
+
+def _assert_stats_parity(seqs, monkeypatch, k=K):
+    codes, seq_len, fwd_off, rev_off, occ_off, starts = _layout(seqs, k)
+    oracle = group_windows_stats(codes, starts, k, False, 1)
+    monkeypatch.setenv("AUTOCYCLER_STREAM_BINS", "11")
+    monkeypatch.setenv("AUTOCYCLER_STREAM_CHUNK", "257")
+    streamed = stream_group_windows_stats(codes, seq_len, fwd_off, rev_off,
+                                          occ_off, k, use_jax=False,
+                                          threads=1)
+    for name, a, b in zip(("gid", "order", "depth", "first_occ"),
+                          oracle, streamed):
+        assert np.array_equal(a, b), name
+        assert a.dtype == b.dtype == np.int64, name
+
+
+def test_stats_parity_random(monkeypatch, tmp_path):
+    set_stream_root(tmp_path / ".stream")
+    _assert_stats_parity(_random_seqs(), monkeypatch)
+
+
+def test_stats_parity_adversarial(monkeypatch, tmp_path):
+    set_stream_root(tmp_path / ".stream")
+    _assert_stats_parity(_adversarial_seqs(), monkeypatch)
+
+
+def test_stats_parity_without_wired_root(monkeypatch):
+    # library callers with no compress wiring stream into a tempdir
+    assert stream_root() is None
+    _assert_stats_parity(_random_seqs(seed=3, lengths=(120, 80)), monkeypatch)
+
+
+def test_occ_byte_starts_matches_dense_layout():
+    codes, seq_len, fwd_off, rev_off, occ_off, starts = _layout(
+        _adversarial_seqs())
+    M = len(starts)
+    got = occ_byte_starts(np.arange(M, dtype=np.int64), seq_len, fwd_off,
+                          rev_off, occ_off)
+    assert np.array_equal(got, starts)
+
+
+def test_build_kmer_index_parity_streamed_vs_oracle(monkeypatch, tmp_path):
+    seqs = _adversarial_seqs()
+    idx_mem = build_kmer_index(_objects(seqs), K, use_jax=False,
+                               use_fused=False)
+    set_stream_root(tmp_path / ".stream")
+    monkeypatch.setenv("AUTOCYCLER_STREAM_KMERS", "on")
+    monkeypatch.setenv("AUTOCYCLER_STREAM_BINS", "9")
+    monkeypatch.setenv("AUTOCYCLER_STREAM_CHUNK", "333")
+    idx_st = build_kmer_index(_objects(seqs), K, use_jax=False,
+                              use_fused=False)
+    assert not rz.degrade_events("stream-kmers")   # streamed path succeeded
+    for name in ("depth", "first_pos", "rep_byte", "rev_kid", "prefix_gid",
+                 "suffix_gid", "in_count", "out_count", "succ", "occ_kid",
+                 "first_occ", "occ_sorted", "group_start"):
+        assert np.array_equal(getattr(idx_mem, name), getattr(idx_st, name)), \
+            name
+    # the run dir is removed on success; only the empty root remains
+    assert not list((tmp_path / ".stream").glob("run-*"))
+
+
+def test_compress_gfa_byte_identical_streamed(monkeypatch, tmp_path):
+    from autocycler_tpu.commands.compress import compress
+
+    asm = tmp_path / "asm"
+    asm.mkdir()
+    rng = np.random.default_rng(11)
+    for i in range(3):
+        contigs = ["".join(rng.choice(list("ACGT"), size=900)),
+                   "".join(rng.choice(list("ACGT"), size=220))]
+        with open(asm / f"a{i}.fasta", "w") as f:
+            for j, c in enumerate(contigs):
+                f.write(f">a{i}_c{j}\n{c}\n")
+
+    monkeypatch.setenv("AUTOCYCLER_STREAM_KMERS", "off")
+    compress(asm, tmp_path / "out_mem", k_size=51, use_jax=False)
+    monkeypatch.setenv("AUTOCYCLER_STREAM_KMERS", "on")
+    monkeypatch.setenv("AUTOCYCLER_STREAM_BINS", "7")
+    monkeypatch.setenv("AUTOCYCLER_STREAM_CHUNK", "129")
+    compress(asm, tmp_path / "out_st", k_size=51, use_jax=False)
+    mem = (tmp_path / "out_mem" / "input_assemblies.gfa").read_bytes()
+    st = (tmp_path / "out_st" / "input_assemblies.gfa").read_bytes()
+    assert mem == st
+    assert not rz.degrade_events("stream-kmers")
+    # compress wired the spill root under its own autocycler dir
+    assert (tmp_path / "out_st" / ".stream").is_dir()
+    assert not list((tmp_path / "out_st" / ".stream").glob("run-*"))
+
+
+# ---------------------------------------------------------------------------
+# the never-raise bin reader
+# ---------------------------------------------------------------------------
+
+def test_read_bin_records_never_raises(tmp_path):
+    missing = tmp_path / "nope.u64"
+    occ, reason = read_bin_records(missing)
+    assert occ is None and "unreadable" in reason
+
+    torn = tmp_path / "torn.u64"
+    torn.write_bytes(np.arange(4, dtype="<i8").tobytes() + b"\x01\x02\x03")
+    occ, reason = read_bin_records(torn)
+    assert occ is None and "torn" in reason
+
+    short = tmp_path / "short.u64"
+    short.write_bytes(np.arange(4, dtype="<i8").tobytes())
+    occ, reason = read_bin_records(short, expected=9)
+    assert occ is None and "manifest" in reason
+
+    shuffled = tmp_path / "shuffled.u64"
+    shuffled.write_bytes(np.array([3, 1, 2], dtype="<i8").tobytes())
+    occ, reason = read_bin_records(shuffled)
+    assert occ is None and "ascending" in reason
+
+    good = tmp_path / "good.u64"
+    good.write_bytes(np.array([0, 5, 9], dtype="<i8").tobytes())
+    occ, reason = read_bin_records(good, expected=3)
+    assert reason is None and np.array_equal(occ, [0, 5, 9])
+
+
+# ---------------------------------------------------------------------------
+# fault injection: spill corruption degrades, never crashes
+# ---------------------------------------------------------------------------
+
+@pytest.mark.faultinject
+def test_corrupt_bin_quarantines_and_degrades(monkeypatch, tmp_path):
+    from autocycler_tpu.obs import metrics_registry
+    from autocycler_tpu.stream import QUARANTINED_BINS_TOTAL
+
+    set_stream_root(tmp_path / ".stream")
+    seqs = _random_seqs(seed=5)
+    monkeypatch.setenv("AUTOCYCLER_STREAM_KMERS", "off")
+    idx_mem = build_kmer_index(_objects(seqs), K, use_jax=False,
+                               use_fused=False)
+    monkeypatch.setenv("AUTOCYCLER_STREAM_KMERS", "on")
+    monkeypatch.setenv("AUTOCYCLER_STREAM_BINS", "5")
+    monkeypatch.setenv("AUTOCYCLER_FAULTS", "stream_read:bin-0002:fail:1")
+    idx_st = build_kmer_index(_objects(seqs), K, use_jax=False,
+                              use_fused=False)
+    events = rz.degrade_events("stream-kmers")
+    assert events and events[0]["from"] == "stream"
+    assert "SpillError" in events[0]["reason"]
+    snap = metrics_registry.snapshot()
+    vals = snap.get(QUARANTINED_BINS_TOTAL, {}).get("values", [])
+    assert vals and vals[0]["value"] >= 1
+    # degraded run still produced the oracle's arrays
+    assert np.array_equal(idx_mem.occ_kid, idx_st.occ_kid)
+    assert np.array_equal(idx_mem.depth, idx_st.depth)
+    # the failed run's spill dir was cleaned up
+    assert not list((tmp_path / ".stream").glob("run-*"))
+
+
+@pytest.mark.faultinject
+def test_write_fault_mid_pass1_degrades(monkeypatch, tmp_path):
+    set_stream_root(tmp_path / ".stream")
+    seqs = _random_seqs(seed=6)
+    monkeypatch.setenv("AUTOCYCLER_STREAM_KMERS", "off")
+    idx_mem = build_kmer_index(_objects(seqs), K, use_jax=False,
+                               use_fused=False)
+    monkeypatch.setenv("AUTOCYCLER_STREAM_KMERS", "on")
+    monkeypatch.setenv("AUTOCYCLER_STREAM_BINS", "5")
+    monkeypatch.setenv("AUTOCYCLER_FAULTS", "stream_write::fail:1")
+    idx_st = build_kmer_index(_objects(seqs), K, use_jax=False,
+                              use_fused=False)
+    events = rz.degrade_events("stream-kmers")
+    assert events and events[0]["to"] == "in-memory"
+    assert "OSError" in events[0]["reason"]
+    assert np.array_equal(idx_mem.occ_kid, idx_st.occ_kid)
+    assert not list((tmp_path / ".stream").glob("run-*"))
+
+
+# ---------------------------------------------------------------------------
+# orphan sweep, prepare_stream_root, clean --cache
+# ---------------------------------------------------------------------------
+
+def test_sweep_orphan_spills(tmp_path):
+    root = tmp_path / ".stream"
+    root.mkdir()
+    dead = new_run_dir(root)
+    write_manifest(dead, K, 11, 4)
+    # rewrite the manifest with a pid that cannot be alive
+    data = json.loads((dead / "manifest.json").read_text())
+    data["pid"] = 2**22 + 12345
+    (dead / "manifest.json").write_text(json.dumps(data))
+
+    live = new_run_dir(root)
+    write_manifest(live, K, 11, 4)          # carries our own live pid
+
+    broken = root / "run-99999-deadbeef"
+    broken.mkdir()
+    (broken / "manifest.json").write_text("{not json")
+
+    assert sweep_orphan_spills(root) == 2
+    assert not dead.exists() and not broken.exists()
+    assert live.exists()
+    assert sweep_orphan_spills(root) == 0    # idempotent
+
+
+def test_prepare_stream_root_sets_and_sweeps(tmp_path):
+    root = tmp_path / ".stream"
+    root.mkdir(parents=True)
+    orphan = root / "run-1-aaaa"
+    orphan.mkdir()
+    (orphan / "manifest.json").write_text(json.dumps(
+        {"version": 1, "pid": 2**22 + 54321, "k": K, "sig_k": 11,
+         "n_bins": 4, "spill_bytes": 0, "counts": None}))
+    prepare_stream_root(tmp_path)
+    assert stream_root() == root
+    assert not orphan.exists()
+
+
+def test_purge_stream_spills_variants(tmp_path):
+    root = tmp_path / ".stream"
+    run = root / "run-1-bbbb"
+    run.mkdir(parents=True)
+    (run / bin_filename(0)).write_bytes(b"\x00" * 64)
+    removed, reclaimed = purge_stream_spills(tmp_path)
+    assert removed == 1 and reclaimed >= 64
+    assert not root.exists()
+    # accepts the .cache dir itself (spills live beside it)
+    (tmp_path / ".cache").mkdir()
+    run2 = root / "run-2-cccc"
+    run2.mkdir(parents=True)
+    removed, _ = purge_stream_spills(tmp_path / ".cache")
+    assert removed == 1 and not root.exists()
+    assert purge_stream_spills(tmp_path) == (0, 0)
+
+
+def test_clean_cache_purges_stream_spills(tmp_path, capsys):
+    from autocycler_tpu.commands.clean import clean_cache
+
+    (tmp_path / ".cache").mkdir()
+    run = tmp_path / ".stream" / "run-3-dddd"
+    run.mkdir(parents=True)
+    (run / bin_filename(0)).write_bytes(b"\x00" * 128)
+    clean_cache(tmp_path)
+    assert not (tmp_path / ".stream").exists()
+    captured = capsys.readouterr()
+    assert "stream spill" in captured.out + captured.err
+
+
+# ---------------------------------------------------------------------------
+# observability: top spill line, streamsmoke trend row
+# ---------------------------------------------------------------------------
+
+def test_top_renders_spill_line(tmp_path):
+    from autocycler_tpu.obs.top import render_top_frame
+
+    entries = [
+        {"ts": 100.0 + i, "interval_s": 1.0,
+         "gauges": {"autocycler_stream_spill_bytes": float(i) * 2**20},
+         "counters": {"autocycler_stream_bins_total": float(i % 2)},
+         "host": {"rss_bytes": 10.0 * 2**20}}
+        for i in range(5)
+    ]
+    with open(tmp_path / "timeseries.jsonl", "w") as f:
+        for e in entries:
+            f.write(json.dumps(e) + "\n")
+    frame = render_top_frame(tmp_path)
+    assert "Spill" in frame
+    assert "bins +2 in view" in frame
+
+
+def test_top_omits_spill_line_when_never_spilled(tmp_path):
+    from autocycler_tpu.obs.top import render_top_frame
+
+    with open(tmp_path / "timeseries.jsonl", "w") as f:
+        f.write(json.dumps({"ts": 1.0, "gauges": {}, "counters": {},
+                            "host": {"rss_bytes": 1.0}}) + "\n")
+    assert "Spill" not in render_top_frame(tmp_path)
+
+
+def test_streamsmoke_row_schema_tolerant(tmp_path):
+    import sys
+    sys.path.insert(0, "/root/repo")
+    import bench
+
+    row = bench.streamsmoke_row(root=tmp_path)          # no artifact
+    assert row["present"] is False and row["passed"] is None
+
+    (tmp_path / "STREAMSMOKE.json").write_text("{garbage")
+    assert bench.streamsmoke_row(root=tmp_path)["present"] is False
+
+    (tmp_path / "STREAMSMOKE.json").write_text(json.dumps(
+        {"passed": True, "rss_reduction": 2.5}))        # partial schema
+    row = bench.streamsmoke_row(root=tmp_path)
+    assert row["present"] and row["passed"] is True
+    assert row["rss_reduction"] == 2.5 and row["budget_mb"] is None
+
+
+# ---------------------------------------------------------------------------
+# ledger lineage
+# ---------------------------------------------------------------------------
+
+def test_stream_spill_stage_recorded_in_ledger(monkeypatch, tmp_path):
+    from autocycler_tpu.obs import ledger
+
+    set_stream_root(tmp_path / ".stream")
+    monkeypatch.setenv("AUTOCYCLER_STREAM_BINS", "5")
+    codes, seq_len, fwd_off, rev_off, occ_off, _ = _layout(
+        _random_seqs(seed=9, lengths=(150, 90)))
+    recorded = []
+    monkeypatch.setattr(ledger, "record_stage",
+                        lambda stage, **kw: recorded.append((stage, kw)))
+    stream_group_windows_stats(codes, seq_len, fwd_off, rev_off, occ_off, K,
+                               use_jax=False, threads=1)
+    stages = dict(recorded)
+    assert "stream-spill" in stages
+    lineage = stages["stream-spill"]
+    assert lineage["bins"] >= 1 and lineage["spill_bytes"] > 0
+    assert lineage["sig_k"] == min(K, 11)
+    assert lineage["records"] == int(2 * seq_len.sum())
