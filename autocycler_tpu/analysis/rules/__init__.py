@@ -9,6 +9,7 @@ family means appending an instance here (docs/static-analysis.md walks
 through it).
 """
 
+from .faults import FaultRules
 from .knobs import KnobRules
 from .locks import LockRules
 from .metrics import MetricsRules
@@ -21,6 +22,7 @@ ALL_RULES = (
     PurityRules(),
     ReaderRules(),
     MetricsRules(),
+    FaultRules(),
 )
 
 
@@ -32,5 +34,5 @@ def rule_ids():
     return tuple(out)
 
 
-__all__ = ["ALL_RULES", "KnobRules", "LockRules", "MetricsRules",
-           "PurityRules", "ReaderRules", "rule_ids"]
+__all__ = ["ALL_RULES", "FaultRules", "KnobRules", "LockRules",
+           "MetricsRules", "PurityRules", "ReaderRules", "rule_ids"]

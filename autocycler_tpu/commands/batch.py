@@ -103,35 +103,83 @@ def batch(assemblies_parent, out_parent, k_size: int = 51,
         return 0
     errs = collect_errors()
 
+    # stage-granular resume: an interrupted isolate re-enters at its last
+    # verified checkpoint (every recorded output re-hashes clean) instead
+    # of starting over. Cluster-verified isolates skip straight to the
+    # trim screen; compress-verified ones reload the unitig graph from the
+    # on-disk GFA (bit-identical membership by the round-trip identity
+    # tests/test_parallel.py asserts) and redo distances + clustering.
+    resume_cluster = set()
+    resume_compress = set()
+    if resume:
+        for iso in todo:
+            if manifest.stage_complete(iso.name, "cluster"):
+                resume_cluster.add(iso.name)
+            elif manifest.stage_complete(iso.name, "compress"):
+                resume_compress.add(iso.name)
+
+    def _cluster_outputs(out_dir: Path) -> List[Path]:
+        clustering = out_dir / "clustering"
+        return [clustering / "pairwise_distances.phylip",
+                clustering / "clustering.newick",
+                clustering / "clustering.tsv",
+                clustering / "clustering.yaml"] \
+            + sorted(clustering.glob("qc_*/cluster_*/1_untrimmed.gfa"))
+
     # ---- per-isolate compress (quarantined) ----
+    from ..models import UnitigGraph
     compressed = []   # (iso, (sequences, ids), M, w)
     with stage_timer("batch/compress"):
         for iso in todo:
             manifest.start(iso.name)
-            log.message(f"Compressing isolate {iso.name}")
+            out_dir = out_parent / iso.name
+            if iso.name in resume_cluster:
+                log.message(f"{iso.name}: compress + cluster checkpoints "
+                            "verified — resuming at trim (--resume)")
+                ledger.record_stage(
+                    "compress", outputs=[out_dir / "input_assemblies.gfa"],
+                    skipped=True)
+                ledger.record_stage(
+                    "cluster", outputs=_cluster_outputs(out_dir),
+                    skipped=True)
+                continue
             with trace.span(f"isolate/{iso.name}", cat="isolate",
                             stage="compress"), obs_qc.scope(iso.name), \
                     errs.quarantine(iso.name):
-                from ..metrics import InputAssemblyMetrics
-                from ..utils.cache import open_cache
-                # warm-start caches live under the isolate's out dir, so a
-                # --resume (or repeat) run skips load+encode+repair for
-                # isolates whose inputs have not changed
-                sequences, _ = load_sequences(
-                    iso, k_size, InputAssemblyMetrics(), max_contigs, threads,
-                    cache=open_cache(out_parent / iso.name))
-                # streamed k-mer spill lives under the isolate's out dir, so
-                # bins from concurrent/killed batch runs never collide
-                from ..stream import prepare_stream_root
-                prepare_stream_root(out_parent / iso.name)
-                graph = build_unitig_graph(sequences, k_size, threads=threads)
-                simplify_structure(graph, sequences)
-                out_dir = out_parent / iso.name
-                os.makedirs(out_dir, exist_ok=True)
-                graph.save_gfa(out_dir / "input_assemblies.gfa", sequences)
-                obs_qc.compress_qc(graph, sequences)
-                ledger.record_stage(
-                    "compress", outputs=[out_dir / "input_assemblies.gfa"])
+                if iso.name in resume_compress:
+                    log.message(f"{iso.name}: compress checkpoint verified "
+                                "— reloading unitig graph (--resume)")
+                    graph, sequences = UnitigGraph.from_gfa_file(
+                        out_dir / "input_assemblies.gfa")
+                    ledger.record_stage(
+                        "compress",
+                        outputs=[out_dir / "input_assemblies.gfa"],
+                        skipped=True)
+                else:
+                    log.message(f"Compressing isolate {iso.name}")
+                    from ..metrics import InputAssemblyMetrics
+                    from ..utils.cache import open_cache
+                    # warm-start caches live under the isolate's out dir,
+                    # so a --resume (or repeat) run skips load+encode+
+                    # repair for isolates whose inputs have not changed
+                    sequences, _ = load_sequences(
+                        iso, k_size, InputAssemblyMetrics(), max_contigs,
+                        threads, cache=open_cache(out_dir))
+                    # streamed k-mer spill lives under the isolate's out
+                    # dir, so bins from concurrent/killed batch runs never
+                    # collide
+                    from ..stream import prepare_stream_root
+                    prepare_stream_root(out_dir)
+                    graph = build_unitig_graph(sequences, k_size,
+                                               threads=threads)
+                    simplify_structure(graph, sequences)
+                    os.makedirs(out_dir, exist_ok=True)
+                    graph.save_gfa(out_dir / "input_assemblies.gfa",
+                                   sequences)
+                    obs_qc.compress_qc(graph, sequences)
+                    ledger.record_stage(
+                        "compress",
+                        outputs=[out_dir / "input_assemblies.gfa"])
                 M, w, ids = membership_matrix(graph, sequences)
                 compressed.append((iso, (sequences, ids), M, w))
                 del graph
@@ -143,9 +191,11 @@ def batch(assemblies_parent, out_parent, k_size: int = 51,
                 manifest.fail(iso.name, str(errs.errors[iso.name].cause),
                               stage="compress")
             else:
-                manifest.advance(iso.name, "compress")
+                manifest.stage_done(
+                    iso.name, "compress",
+                    outputs=[out_dir / "input_assemblies.gfa"])
     log.message()
-    if not compressed:
+    if not compressed and not resume_cluster:
         raise AutocyclerError(
             f"all {len(todo)} isolate(s) failed during compress; "
             f"see {manifest_path}")
@@ -157,10 +207,11 @@ def batch(assemblies_parent, out_parent, k_size: int = 51,
     with stage_timer("batch/distances"):
         mesh = make_mesh()
         inters = batched_membership_intersections(
-            mesh, [c[2] for c in compressed], [c[3] for c in compressed])
+            mesh, [c[2] for c in compressed], [c[3] for c in compressed]) \
+            if compressed else []
 
     # ---- per-isolate clustering (quarantined) ----
-    clustered = []
+    clustered = [iso for iso in todo if iso.name in resume_cluster]
     with stage_timer("batch/cluster"):
         for (iso, (sequences, ids), _, _), inter in zip(compressed, inters):
             with trace.span(f"isolate/{iso.name}", cat="isolate",
@@ -175,7 +226,10 @@ def batch(assemblies_parent, out_parent, k_size: int = 51,
                 manifest.fail(iso.name, str(errs.errors[iso.name].cause),
                               stage="cluster")
             else:
-                manifest.advance(iso.name, "cluster")
+                manifest.stage_done(iso.name, "cluster",
+                                    outputs=_cluster_outputs(
+                                        out_parent / iso.name))
+    clustered.sort(key=lambda p: p.name)
 
     log.section_header("Batched trim screen")
     log.explanation("Every isolate's trim overlap DPs (start-end + both hairpin "
@@ -240,6 +294,11 @@ def batch(assemblies_parent, out_parent, k_size: int = 51,
                 manifest.fail(iso.name, str(errs.errors[iso.name].cause),
                               stage="finalise")
             else:
+                manifest.stage_done(
+                    iso.name, "finalise",
+                    outputs=[out_parent / iso.name / "consensus_assembly.gfa",
+                             out_parent / iso.name
+                             / "consensus_assembly.fasta"])
                 manifest.done(iso.name)
                 completed.append(iso.name)
 

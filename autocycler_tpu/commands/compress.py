@@ -96,6 +96,11 @@ def compress(assemblies_dir, autocycler_dir, k_size: int = 51,
     _save_metrics(metrics, assembly_count, sequences, graph, out_yaml)
     qc.compress_qc(graph, sequences)
     ledger.record_stage("compress", outputs=[out_gfa, out_yaml])
+    # registered crash point: artifacts are flushed but no caller-side
+    # manifest has recorded the stage yet — a crash here re-runs compress
+    # on resume, idempotently and byte-identically
+    from ..utils.resilience import crash_point
+    crash_point("post-stage", "compress")
 
     log.section_header("Finished!")
     log.explanation("You can now run autocycler cluster to group contigs based on their "

@@ -47,8 +47,10 @@ def reset() -> None:
         _stages.clear()
 
 
-def _hash_file(path) -> Optional[dict]:
-    """{"sha256", "bytes"} of a file, streamed; None when unreadable."""
+def artifact_hash(path) -> Optional[dict]:
+    """{"sha256", "bytes"} of a file, streamed; None when unreadable.
+    Shared with resilience's stage checkpoints so manifest stage records
+    and ledger entries agree on artifact identity."""
     h = hashlib.sha256()
     size = 0
     try:
@@ -59,6 +61,9 @@ def _hash_file(path) -> Optional[dict]:
     except OSError:
         return None
     return {"sha256": h.hexdigest(), "bytes": size}
+
+
+_hash_file = artifact_hash
 
 
 def record_inputs(paths) -> None:
@@ -215,6 +220,8 @@ def write_ledger(run_dir, command: Optional[str] = None) -> Optional[Path]:
         with os.fdopen(fd, "w") as f:
             json.dump(payload, f, indent=2, sort_keys=True)
             f.write("\n")
+        from ..utils.resilience import crash_point  # lazy: avoids cycle
+        crash_point("pre-artifact-rename", str(path))
         os.replace(tmp, path)
         return path
     except OSError:

@@ -131,6 +131,12 @@ def render_top_frame(root) -> Optional[str]:
     root = Path(root)
     info = _load_json(root / "serve.json")
     manifest = _load_json(root / "serve_manifest.json")
+    if manifest is None:
+        # a torn manifest (daemon crashed mid-save) still renders: the
+        # resilience reader falls back to the last good .bak state
+        from ..utils.resilience import read_manifest
+        data = read_manifest(root / "serve_manifest.json")
+        manifest = data if data.get("items") else None
     entries = _load_entries(root)
     if not entries and info is None and manifest is None:
         return None
@@ -295,6 +301,8 @@ def _latency_line(slo: Optional[dict], entries: List[dict]) -> Optional[str]:
             burn = slo.get("burn_rate")
             if isinstance(burn, (int, float)):
                 line += f" (burn {burn:g})"
+            if slo.get("shedding"):
+                line += "  SHEDDING"
         else:
             line += "  SLO: no objective set"
     return line

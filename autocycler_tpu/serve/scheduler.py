@@ -36,8 +36,8 @@ from ..obs import ledger, metrics_registry, trace
 from ..obs import qc as obs_qc
 from ..obs.metrics_registry import SECONDS_BUCKETS
 from ..utils import AutocyclerError, log
-from ..utils.resilience import RunManifest
-from .protocol import JobSpec
+from ..utils.resilience import InputError, RunManifest
+from .protocol import JobSpec, parse_job_spec
 from .slo import SloTracker
 
 MANIFEST_NAME = "serve_manifest.json"
@@ -47,6 +47,7 @@ MANIFEST_NAME = "serve_manifest.json"
 JOBS_TOTAL = "autocycler_serve_jobs_total"
 SUBMITTED_TOTAL = "autocycler_serve_submitted_total"
 REJECTED_TOTAL = "autocycler_serve_rejected_total"
+SHED_TOTAL = "autocycler_serve_shed_total"
 QUEUE_DEPTH = "autocycler_serve_queue_depth"
 JOB_SECONDS = "autocycler_serve_job_seconds"
 
@@ -67,6 +68,7 @@ class Job:
         self.out_dir = out_dir
         self.state = "queued"
         self.error: Optional[str] = None
+        self.resumed = False              # replayed after a daemon restart
         self.submitted_epoch = time.time()
         self.started_epoch: Optional[float] = None
         self.finished_epoch: Optional[float] = None
@@ -111,12 +113,13 @@ class Scheduler:
         # construction (the sampler and /healthz read it mid-job)
         self.slo = SloTracker()
         self.manifest = RunManifest.load(self.root / MANIFEST_NAME)
-        # a previous daemon died mid-job: those entries can never complete
-        # now — record the interruption so `/jobs` history and the manifest
-        # agree (docs/failure-modes.md "daemon restart")
-        for name, entry in self.manifest.items.items():
-            if entry.get("status") == "running":
-                self.manifest.fail(name, "interrupted by daemon restart")
+        # crash-safe replay: a previous daemon's unfinished jobs come back.
+        # Jobs still "pending" re-enqueue in submission order; jobs caught
+        # "running" resume from their last checkpointed stage when the
+        # worker picks them up (docs/failure-modes.md "daemon restart").
+        replay: List[Job] = []
+        for name in sorted(self.manifest.items):   # ids sort = submit order
+            entry = self.manifest.items[name]
             # resume the id sequence past every recorded job so a restarted
             # daemon never reuses (and silently overwrites) a prior job id
             try:
@@ -124,6 +127,45 @@ class Scheduler:
                                     int(name.rsplit("-", 1)[1]) + 1)
             except (IndexError, ValueError):
                 pass
+            status = entry.get("status")
+            if status not in ("pending", "running"):
+                continue
+            spec_data = entry.get("spec")
+            if not isinstance(spec_data, dict):
+                # pre-replay manifests carried no spec: nothing to re-run,
+                # so record the interruption the way older daemons did
+                if status == "running":
+                    self.manifest.fail(name, "interrupted by daemon restart")
+                continue
+            try:
+                spec = parse_job_spec(spec_data)
+            except InputError as e:
+                self.manifest.fail(name, f"unreplayable job spec: {e}")
+                continue
+            run_dir = self.root / "jobs" / name
+            out_dir = Path(entry.get("out_dir") or (run_dir / "out"))
+            job = Job(name, spec, run_dir, out_dir)
+            job.resumed = status == "running"
+            submitted = entry.get("submitted_epoch")
+            if isinstance(submitted, (int, float)):
+                job.submitted_epoch = float(submitted)
+            replay.append(job)
+        for job in replay:
+            try:
+                self._queue.put_nowait(job)
+            except queue.Full:
+                # stays pending in the manifest; the next restart (or a
+                # larger capacity) picks it up
+                log.message(f"WARNING: serve: {job.id} not replayed — "
+                            f"queue capacity {self.capacity} exhausted")
+                continue
+            self._jobs[job.id] = job
+            log.message(
+                f"serve: {job.id} "
+                + ("resuming from last checkpointed stage"
+                   if job.resumed else "re-enqueued after restart"))
+        if replay:
+            self._gauge_depth()
 
     # ---- admission ----
 
@@ -147,7 +189,11 @@ class Scheduler:
                     f"work queue is full ({self.capacity} jobs); "
                     "retry after a job completes") from None
             self._jobs[job_id] = job
-        self.manifest.pending(job_id)
+        # persist everything replay needs: a restarted daemon rebuilds the
+        # Job from the manifest entry alone
+        self.manifest.annotate(
+            job_id, spec=spec.to_dict(), out_dir=str(out_dir),
+            submitted_epoch=round(job.submitted_epoch, 3))
         metrics_registry.counter_inc(
             SUBMITTED_TOTAL, 1, help="jobs admitted into the work queue")
         self._gauge_depth()
@@ -243,7 +289,7 @@ class Scheduler:
                 with trace.span(f"job/{job.id}", cat="command",
                                 job=job.id, command=spec.command), \
                         obs_qc.scope(job.id):
-                    self._run_spec(spec, job.out_dir)
+                    self._run_spec(spec, job.out_dir, job_id=job.id)
             except (AutocyclerError, OSError) as e:
                 failure = e
             except Exception as e:  # noqa: BLE001 — a bug in one job's
@@ -295,27 +341,85 @@ class Scheduler:
             "autocycler_quarantined_items_total", 1,
             help="per-item failures quarantined instead of aborting")
 
-    def _run_spec(self, spec: JobSpec, out_dir: Path) -> None:
+    def _stage_skip(self, job_id: Optional[str], stage: str,
+                    outputs, cluster: Optional[str] = None) -> bool:
+        """True when ``stage`` may be skipped: the manifest has a verified
+        checkpoint (every recorded output re-hashes clean). The skip is
+        made visible in the run's ledger and log so replay is auditable."""
+        if job_id is None or not self.manifest.stage_complete(job_id, stage):
+            return False
+        ledger.record_stage(stage.split("/", 1)[0], outputs=outputs,
+                            cluster=cluster, skipped=True)
+        log.message(f"serve: {job_id} skipping {stage} "
+                    "(checkpoint verified)")
+        return True
+
+    def _stage_done(self, job_id: Optional[str], stage: str,
+                    outputs) -> None:
+        if job_id is not None:
+            self.manifest.stage_done(job_id, stage, outputs=outputs)
+
+    def _run_spec(self, spec: JobSpec, out_dir: Path,
+                  job_id: Optional[str] = None) -> None:
         """The job body: exactly the CLI code path, so outputs are
         byte-identical to `autocycler compress` / the per-isolate slice of
-        `autocycler batch` by construction."""
-        from ..commands.compress import compress
-        compress(spec.assemblies_dir, out_dir, spec.kmer, spec.max_contigs,
-                 threads=spec.threads)
+        `autocycler batch` by construction.
+
+        With a ``job_id``, every stage checkpoints into the serve manifest
+        after its artifacts flush, and a resumed job skips stages whose
+        recorded output hashes still verify — re-entering mid-isolate
+        instead of starting over. Stages re-run from disk state, so the
+        resumed run's outputs match a full rerun byte for byte."""
+        out_dir = Path(out_dir)
+        compress_out = [out_dir / "input_assemblies.gfa",
+                        out_dir / "input_assemblies.yaml"]
+        if not self._stage_skip(job_id, "compress", compress_out):
+            from ..commands.compress import compress
+            compress(spec.assemblies_dir, out_dir, spec.kmer,
+                     spec.max_contigs, threads=spec.threads)
+            self._stage_done(job_id, "compress", compress_out)
         if spec.command != "pipeline":
             return
-        from ..commands.cluster import cluster
-        cluster(out_dir, spec.cutoff, spec.min_assemblies, spec.max_contigs)
+        clustering_dir = out_dir / "clustering"
+        qc_pass = clustering_dir / "qc_pass"
+
+        def cluster_out():
+            return [clustering_dir / "pairwise_distances.phylip",
+                    clustering_dir / "clustering.newick",
+                    clustering_dir / "clustering.tsv",
+                    clustering_dir / "clustering.yaml"] \
+                + sorted(clustering_dir.glob("qc_*/cluster_*/1_untrimmed.gfa"))
+
+        if not self._stage_skip(job_id, "cluster", cluster_out()):
+            from ..commands.cluster import cluster
+            cluster(out_dir, spec.cutoff, spec.min_assemblies,
+                    spec.max_contigs)
+            self._stage_done(job_id, "cluster", cluster_out())
         from ..commands.combine import combine
         from ..commands.resolve import resolve
         from ..commands.trim import trim
-        qc_pass = Path(out_dir) / "clustering" / "qc_pass"
         cluster_dirs = sorted(d for d in qc_pass.iterdir() if d.is_dir()) \
             if qc_pass.is_dir() else []
         for cdir in cluster_dirs:
-            trimmed = trim(cdir, threads=spec.threads)
-            resolve(cdir, preloaded=trimmed)
+            trim_out = [cdir / "2_trimmed.gfa", cdir / "2_trimmed.yaml"]
+            resolve_out = [cdir / "3_bridged.gfa", cdir / "4_merged.gfa",
+                           cdir / "5_final.gfa"]
+            trimmed = None
+            if not self._stage_skip(job_id, f"trim/{cdir.name}", trim_out,
+                                    cluster=cdir.name):
+                trimmed = trim(cdir, threads=spec.threads)
+                self._stage_done(job_id, f"trim/{cdir.name}", trim_out)
+            if not self._stage_skip(job_id, f"resolve/{cdir.name}",
+                                    resolve_out, cluster=cdir.name):
+                # on resume trimmed is None and resolve re-parses
+                # 2_trimmed.gfa from disk — same bytes either way
+                resolve(cdir, preloaded=trimmed)
+                self._stage_done(job_id, f"resolve/{cdir.name}", resolve_out)
             del trimmed
+        combine_out = [out_dir / "consensus_assembly.gfa",
+                       out_dir / "consensus_assembly.fasta",
+                       out_dir / "consensus_assembly.yaml"]
         finals = sorted(qc_pass.glob("cluster_*/5_final.gfa"))
-        if finals:
+        if finals and not self._stage_skip(job_id, "combine", combine_out):
             combine(out_dir, finals)
+            self._stage_done(job_id, "combine", combine_out)

@@ -4,7 +4,9 @@ scheduler.
 Routes (all JSON unless noted):
 
 - ``POST /jobs``            submit a job spec -> 202 job record
-                            (400 invalid spec, 503 queue full)
+                            (400 invalid spec; 503 + ``Retry-After`` when
+                            the queue is full or burn-rate admission
+                            control is shedding)
 - ``GET  /jobs``            every job record this daemon has seen
 - ``GET  /jobs/<id>``       one job record (404 unknown)
 - ``GET  /jobs/<id>/trace`` raw ``trace.jsonl`` bytes from ``?offset=N``
@@ -42,13 +44,17 @@ from ..obs.timeseries import TimeseriesSampler, timeseries_enabled
 from ..utils import log
 from ..utils.resilience import InputError
 from .protocol import (DEFAULT_PORT, SERVE_INFO_JSON, parse_job_spec)
-from .scheduler import QueueFullError, Scheduler
+from .scheduler import SHED_TOTAL, QueueFullError, Scheduler
 
 # a sampler whose last tick is older than this many intervals is stale —
 # wedged or dead, either way the continuous telemetry has stopped
 SAMPLER_STALE_INTERVALS = 3.0
 
 REQUESTS_TOTAL = "autocycler_serve_requests_total"
+
+# Retry-After hint on 503 responses (shed or queue-full): long enough for
+# a few window samples to age out, short enough to keep clients live
+RETRY_AFTER_S = 15
 
 
 class _UnixHTTPServer(ThreadingHTTPServer):
@@ -80,9 +86,11 @@ class _Handler(BaseHTTPRequestHandler):
 
     # ---- plumbing ----
 
-    def _send_json(self, code: int, payload: dict, route: str) -> None:
+    def _send_json(self, code: int, payload: dict, route: str,
+                   headers: Optional[dict] = None) -> None:
         body = (json.dumps(payload, indent=2, sort_keys=True) + "\n").encode()
-        self._send_bytes(code, body, "application/json", route)
+        self._send_bytes(code, body, "application/json", route,
+                         headers=headers)
 
     def _send_bytes(self, code: int, body: bytes, ctype: str, route: str,
                     headers: Optional[dict] = None) -> None:
@@ -94,6 +102,8 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
+            for key, value in (headers or {}).items():
+                self.send_header(key, str(value))
             self.end_headers()
             self.wfile.write(body)
 
@@ -160,10 +170,32 @@ class _Handler(BaseHTTPRequestHandler):
                     "autocycler_serve_rejected_total", 1,
                     help="jobs rejected at admission", reason="bad_request")
                 return self._send_json(400, {"error": str(e)}, "/jobs")
+            # burn-rate admission control: when the SLO window burns error
+            # budget faster than AUTOCYCLER_SLO_SHED_BURN allows, shed the
+            # submission before it costs a queue slot — the window drains
+            # on its own, so Retry-After is an honest hint
+            slo_report = self.state.scheduler.slo.report()
+            if slo_report.get("shedding"):
+                metrics_registry.counter_inc(
+                    SHED_TOTAL, 1,
+                    help="submissions shed by burn-rate admission control")
+                metrics_registry.counter_inc(
+                    "autocycler_serve_rejected_total", 1,
+                    help="jobs rejected at admission", reason="shed")
+                return self._send_json(
+                    503,
+                    {"error": "shedding load: latency burn rate "
+                              f"{slo_report.get('burn_rate')} exceeds "
+                              f"threshold {slo_report.get('shed_burn')}",
+                     "burn_rate": slo_report.get("burn_rate"),
+                     "shed_burn": slo_report.get("shed_burn"),
+                     "retry_after_s": RETRY_AFTER_S},
+                    "/jobs", headers={"Retry-After": RETRY_AFTER_S})
             try:
                 job = self.state.scheduler.submit(spec)
             except QueueFullError as e:
-                return self._send_json(503, {"error": str(e)}, "/jobs")
+                return self._send_json(503, {"error": str(e)}, "/jobs",
+                                       headers={"Retry-After": RETRY_AFTER_S})
             return self._send_json(202, job.to_dict(), "/jobs")
         if parsed.path == "/shutdown":
             self._send_json(200, {"status": "shutting down"}, "/shutdown")
@@ -300,6 +332,8 @@ class ServeHandle:
         degraded = []
         if slo_report.get("violated"):
             degraded.append("slo")
+        if slo_report.get("shedding"):
+            degraded.append("shedding")
         if sampler.get("stale"):
             degraded.append("sampler")
         if degraded:

@@ -37,6 +37,7 @@ from ..utils.knobs import knob_float
 P50_ENV = "AUTOCYCLER_SLO_P50_S"
 P95_ENV = "AUTOCYCLER_SLO_P95_S"
 WINDOW_ENV = "AUTOCYCLER_SLO_WINDOW_S"
+SHED_BURN_ENV = "AUTOCYCLER_SLO_SHED_BURN"
 
 DEFAULT_WINDOW_S = 3600.0
 WINDOW_MAX_SAMPLES = 1024   # the hard size bound behind the time window
@@ -67,6 +68,14 @@ def objectives() -> Dict[str, Optional[float]]:
 
 def window_seconds() -> float:
     return max(1.0, float(knob_float(WINDOW_ENV)))
+
+
+def shed_burn_threshold() -> Optional[float]:
+    """The burn rate above which the daemon sheds new submissions
+    (admission control), or None when shedding is disabled. Re-read per
+    call like the objectives, so it is operator-tunable live."""
+    val = knob_float(SHED_BURN_ENV)
+    return val if (val is not None and val > 0) else None
 
 
 def _percentile(values: List[float], q: float) -> float:
@@ -187,4 +196,10 @@ class SloTracker:
                 violated = True
         out["burn_rate"] = burn
         out["violated"] = violated
+        shed_burn = shed_burn_threshold()
+        out["shed_burn"] = shed_burn
+        # shedding clears by itself as the window drains: pruned samples
+        # drop the burn rate back under the threshold
+        out["shedding"] = bool(shed_burn is not None and burn is not None
+                               and burn > shed_burn)
         return out

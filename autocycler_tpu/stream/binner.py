@@ -28,7 +28,7 @@ from typing import List
 import numpy as np
 
 from ..ops.sketch import _kmer_hashes, _window_minima
-from ..utils.resilience import fault_fire
+from ..utils.resilience import crash_armed, crash_point, fault_fire
 from .planner import StreamPlan
 from .spill import bin_filename, write_manifest
 
@@ -95,8 +95,18 @@ class StreamBinner:
         path = self.run_dir / bin_filename(b)
         if fault_fire("stream_write", path.name) is not None:
             raise OSError(f"fault injection: stream bin write failed: {path}")
+        payload = data.tobytes()
+        # torn-spill simulation: when the registered crash point is armed
+        # for this hit, flush only a partial record before dying (the
+        # crash_point call below). Recovery contract: the manifest was
+        # never sealed with this run's counts, and the dead run's spill dir
+        # is swept by the next prepare_stream_root
+        # (stream.spill.sweep_orphan_spills).
+        torn = crash_armed("mid-spill-write", path.name)
         with open(path, "ab") as f:
-            f.write(data.tobytes())
+            f.write(payload[: max(1, len(payload) // 2)] if torn
+                    else payload)
+        crash_point("mid-spill-write", path.name)
         self.counts[b] += len(data)
         self.spill_bytes += data.nbytes
         self._bufs[b] = []

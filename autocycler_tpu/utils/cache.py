@@ -119,10 +119,22 @@ def content_hash(raw: bytes) -> str:
 
 
 def _atomic_write(path: Path, payload: bytes) -> None:
+    # lazy import: resilience pulls in obs at module load
+    from .resilience import crash_armed, crash_point
     fd, tmp = tempfile.mkstemp(dir=str(path.parent),
-                               prefix=path.name + ".tmp")
+                               prefix=f"{path.name}.{os.getpid()}.",
+                               suffix=".tmp")
     try:
         with os.fdopen(fd, "wb") as f:
+            if crash_armed("mid-cache-store", path.name):
+                # torn-write simulation: flush half the payload, then die.
+                # Recovery contract: the torn tmp is pid-tagged, so the
+                # next open_cache sweeps it, and the entry itself was never
+                # renamed into place — a loader can only ever miss.
+                f.write(payload[: len(payload) // 2])
+                f.flush()
+                crash_point("mid-cache-store", path.name)
+                raise OSError("crash point mid-cache-store did not exit")
             f.write(payload)
         os.replace(tmp, path)
     except OSError:
@@ -131,6 +143,30 @@ def _atomic_write(path: Path, payload: bytes) -> None:
         except OSError:
             pass
         raise
+
+
+def _sweep_stale_tmps(cache_dir: Path) -> int:
+    """Remove torn ``<entry>.npz.<pid>.*.tmp`` leftovers whose writing
+    process is dead. Live pids are skipped so two daemons sharing
+    ``AUTOCYCLER_CACHE_DIR`` never delete each other's in-flight stores."""
+    from .resilience import _pid_alive
+    removed = 0
+    try:
+        candidates = list(cache_dir.glob("*.npz.*"))
+    except OSError:
+        return 0
+    for path in candidates:
+        if ".tmp" not in path.name:
+            continue
+        pid_tok = path.name.split(".npz.", 1)[1].split(".", 1)[0]
+        if pid_tok.isdigit() and _pid_alive(int(pid_tok)):
+            continue
+        try:
+            path.unlink()
+            removed += 1
+        except OSError:
+            continue
+    return removed
 
 
 class EncodeCache:
@@ -172,12 +208,19 @@ class EncodeCache:
         if max_bytes is None:
             return 0
         try:
-            entries = []
-            for path in self.dir.glob("*.npz"):
-                st = path.stat()
-                entries.append((st.st_mtime, st.st_size, path))
+            listing = list(self.dir.glob("*.npz"))
         except OSError:
             return 0
+        entries = []
+        for path in listing:
+            try:
+                st = path.stat()
+            except OSError:
+                # a concurrent evictor (another daemon sharing this cache
+                # dir) removed it between listing and stat — its bytes are
+                # already reclaimed, just drop it from our view
+                continue
+            entries.append((st.st_mtime, st.st_size, path))
         total = sum(size for _, size, _ in entries)
         if total <= max_bytes:
             return 0
@@ -189,6 +232,11 @@ class EncodeCache:
                 break
             try:
                 path.unlink()
+            except FileNotFoundError:
+                # raced with another evictor: the bytes are gone either
+                # way, so the budget accounting must still shrink
+                total -= size
+                continue
             except OSError:
                 continue
             total -= size
@@ -322,10 +370,14 @@ def open_cache(autocycler_dir) -> Optional[EncodeCache]:
         return None
     shared = shared_cache_dir()
     if shared is not None:
-        return EncodeCache(shared)
-    if autocycler_dir is None:
+        cache = EncodeCache(shared)
+    elif autocycler_dir is None:
         return None
-    return EncodeCache(Path(autocycler_dir) / ".cache")
+    else:
+        cache = EncodeCache(Path(autocycler_dir) / ".cache")
+    if cache.dir.is_dir():
+        _sweep_stale_tmps(cache.dir)
+    return cache
 
 
 def purge_cache(target) -> Tuple[int, int]:
@@ -341,7 +393,7 @@ def purge_cache(target) -> Tuple[int, int]:
     reclaimed = 0
     if not cache_dir.is_dir():
         return 0, 0
-    for pattern in ("*.npz", "*.npz.tmp*"):
+    for pattern in ("*.npz", "*.npz.tmp*", "*.npz.*.tmp"):
         for path in cache_dir.glob(pattern):
             try:
                 size = path.stat().st_size

@@ -1,0 +1,210 @@
+"""Deterministic crash-injection chaos harness.
+
+The recovery claims in docs/failure-modes.md are only worth what kills
+them: this driver runs a real `autocycler batch` job in a CHILD process
+with one registered crash point armed (``AUTOCYCLER_CRASH_POINTS``, see
+:mod:`utils.resilience`), asserts the child died with the distinctive
+:data:`resilience.CRASH_EXIT` status at that point, restarts it with
+``--resume`` and no crash armed, and then holds the recovered run to the
+same bar an uninterrupted run meets:
+
+- the resumed run completes (exit 0),
+- its final outputs are byte-identical to an uninterrupted oracle run,
+- no orphaned state survives — no ``*.tmp*`` spool files, no dead-run
+  ``.stream/run-*`` spill dirs anywhere under the output tree.
+
+`bench.py chaossmoke` cycles every registered crash point through this
+driver on a small synthetic isolate; tests/test_chaos.py runs the same
+cycle inside the suite under the ``chaos`` marker.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from .resilience import CRASH_EXIT, CRASH_POINTS
+
+# the files whose bytes define "the run": the compressed unitig graph and
+# the combined consensus outputs of every isolate
+FINAL_ARTIFACTS = ("input_assemblies.gfa", "consensus_assembly.gfa",
+                   "consensus_assembly.fasta")
+
+_CHAOS_CHILD = r"""
+import sys
+from autocycler_tpu.commands.batch import batch
+sys.exit(batch(sys.argv[1], sys.argv[2], k_size=int(sys.argv[3]),
+               resume=sys.argv[4] == "1", threads=1))
+"""
+
+
+def _child_env(repo_root: str, crash_points: Optional[str] = None) -> dict:
+    """A deterministic child environment: CPU jax, streaming spill forced
+    on (so the mid-spill-write point is actually exercised), warm-start
+    caches on (ditto mid-cache-store). The oracle runs with the SAME
+    environment minus the armed crash point — byte-identity must hold
+    across the crash, not across a mode switch."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    env.update({"JAX_PLATFORMS": "cpu",
+                "AUTOCYCLER_STREAM_KMERS": "on",
+                "AUTOCYCLER_ENCODE_CACHE": "1"})
+    env.pop("AUTOCYCLER_CRASH_POINTS", None)
+    env.pop("AUTOCYCLER_FAULTS", None)
+    if crash_points:
+        env["AUTOCYCLER_CRASH_POINTS"] = crash_points
+    return env
+
+
+def _run_batch(child_script: Path, asm_parent: Path, out_dir: Path,
+               kmer: int, resume: bool, env: dict,
+               timeout: float = 900.0) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, str(child_script), str(asm_parent), str(out_dir),
+         str(kmer), "1" if resume else "0"],
+        env=env, capture_output=True, text=True, timeout=timeout)
+
+
+def _file_sha(path: Path) -> Optional[str]:
+    try:
+        return hashlib.sha256(path.read_bytes()).hexdigest()
+    except OSError:
+        return None
+
+
+def artifact_digests(out_dir: Path) -> Dict[str, Optional[str]]:
+    """{relative artifact path: sha256} over every isolate's final files."""
+    out_dir = Path(out_dir)
+    digests: Dict[str, Optional[str]] = {}
+    for iso in sorted(d for d in out_dir.iterdir() if d.is_dir()) \
+            if out_dir.is_dir() else []:
+        if iso.name.startswith("."):
+            continue
+        for name in FINAL_ARTIFACTS:
+            digests[f"{iso.name}/{name}"] = _file_sha(iso / name)
+    return digests
+
+
+def scan_orphans(out_dir: Path) -> List[str]:
+    """Leftover crash debris under ``out_dir``: tmp spool files and
+    ``.stream/run-*`` spill dirs. Called after every child has exited, so
+    anything matching is an orphan by definition (``.bak`` manifest
+    fallbacks are expected state, not debris)."""
+    out_dir = Path(out_dir)
+    orphans: List[str] = []
+    if not out_dir.is_dir():
+        return orphans
+    for path in sorted(out_dir.rglob("*")):
+        name = path.name
+        if path.is_file() and ".tmp" in name:
+            orphans.append(str(path.relative_to(out_dir)))
+        elif path.is_dir() and name.startswith("run-") \
+                and path.parent.name == ".stream":
+            orphans.append(str(path.relative_to(out_dir)) + "/")
+    return orphans
+
+
+def chaos_cycle(asm_parent, work_dir, point: str, kmer: int = 31,
+                oracle: Optional[Dict[str, Optional[str]]] = None,
+                timeout: float = 900.0) -> dict:
+    """One kill/restart cycle: arm ``point``, run batch in a child until it
+    crashes there, restart with ``--resume`` and no crash armed, and
+    compare the recovered outputs against ``oracle`` (the digests of an
+    uninterrupted run; see :func:`artifact_digests`). Returns a verdict
+    dict — ``passed`` requires crash + recovery + byte-identity + a clean
+    orphan scan."""
+    if point not in CRASH_POINTS:
+        raise ValueError(f"unknown crash point {point!r} "
+                         f"(choose from {', '.join(CRASH_POINTS)})")
+    work_dir = Path(work_dir)
+    work_dir.mkdir(parents=True, exist_ok=True)
+    out_dir = work_dir / f"out-{point}"
+    child = work_dir / "chaos_child.py"
+    if not child.is_file():
+        child.write_text(_CHAOS_CHILD)
+    repo_root = str(Path(__file__).resolve().parents[2])
+
+    t0 = time.perf_counter()
+    crashed = _run_batch(child, Path(asm_parent), out_dir, kmer,
+                         resume=False,
+                         env=_child_env(repo_root, crash_points=point),
+                         timeout=timeout)
+    crash_ok = crashed.returncode == CRASH_EXIT
+    marker_ok = "autocycler crash injection" in (crashed.stderr or "")
+
+    resumed = _run_batch(child, Path(asm_parent), out_dir, kmer,
+                         resume=True, env=_child_env(repo_root),
+                         timeout=timeout)
+    recovered = resumed.returncode == 0
+
+    digests = artifact_digests(out_dir)
+    identical = oracle is not None and digests == oracle \
+        and all(v is not None for v in digests.values())
+    orphans = scan_orphans(out_dir)
+    verdict = {
+        "point": point,
+        "crashed": crash_ok,
+        "crash_rc": crashed.returncode,
+        "crash_marker": marker_ok,
+        "recovered": recovered,
+        "resume_rc": resumed.returncode,
+        "identical": bool(identical),
+        "orphans": orphans,
+        "wall_s": round(time.perf_counter() - t0, 2),
+        "passed": bool(crash_ok and marker_ok and recovered and identical
+                       and not orphans),
+    }
+    if not verdict["passed"]:
+        verdict["crash_stderr_tail"] = (crashed.stderr or "")[-2000:]
+        verdict["resume_stderr_tail"] = (resumed.stderr or "")[-2000:]
+    return verdict
+
+
+def run_chaos(asm_parent, work_dir, points=CRASH_POINTS, kmer: int = 31,
+              timeout: float = 900.0) -> dict:
+    """The full harness: one uninterrupted oracle run, then a
+    crash/restart cycle at every registered crash point, each recovered
+    run held byte-identical to the oracle. Returns the summary dict
+    `bench.py chaossmoke` writes as CHAOSSMOKE.json."""
+    work_dir = Path(work_dir)
+    work_dir.mkdir(parents=True, exist_ok=True)
+    child = work_dir / "chaos_child.py"
+    child.write_text(_CHAOS_CHILD)
+    repo_root = str(Path(__file__).resolve().parents[2])
+
+    t0 = time.perf_counter()
+    oracle_dir = work_dir / "out-oracle"
+    oracle_run = _run_batch(child, Path(asm_parent), oracle_dir, kmer,
+                            resume=False, env=_child_env(repo_root),
+                            timeout=timeout)
+    if oracle_run.returncode != 0:
+        raise RuntimeError(
+            "chaos oracle run failed "
+            f"rc={oracle_run.returncode}: {(oracle_run.stderr or '')[-2000:]}")
+    oracle = artifact_digests(oracle_dir)
+    if not oracle or any(v is None for v in oracle.values()):
+        raise RuntimeError(f"chaos oracle run produced incomplete "
+                           f"artifacts: {json.dumps(oracle)}")
+
+    cycles = [chaos_cycle(asm_parent, work_dir, point, kmer=kmer,
+                          oracle=oracle, timeout=timeout)
+              for point in points]
+    return {
+        "points": list(points),
+        "cycles": cycles,
+        "oracle_artifacts": len(oracle),
+        "wall_s": round(time.perf_counter() - t0, 2),
+        "passed": bool(cycles) and all(c["passed"] for c in cycles),
+    }
+
+
+def cleanup(work_dir) -> None:
+    shutil.rmtree(work_dir, ignore_errors=True)

@@ -355,6 +355,12 @@ KNOBS: Dict[str, Knob] = dict(
             0,
             "Retry count for failed helper subprocess invocations.",
         ),
+        _k(
+            "AUTOCYCLER_CRASH_POINTS",
+            "str",
+            None,
+            "Arm registered crash points for chaos testing: comma list of 'point[@n]' entries, crashing the process at the n-th hit of the point (default first).",
+        ),
         # --- serve / SLOs --------------------------------------------------
         _k(
             "AUTOCYCLER_SERVE",
@@ -379,6 +385,12 @@ KNOBS: Dict[str, Knob] = dict(
             "float",
             3600.0,
             "Sliding window in seconds for serve SLO burn-rate accounting.",
+        ),
+        _k(
+            "AUTOCYCLER_SLO_SHED_BURN",
+            "float",
+            None,
+            "Burn-rate threshold above which the serve daemon sheds new submissions with 503 + Retry-After; unset disables admission control.",
         ),
         # --- bench ---------------------------------------------------------
         _k(

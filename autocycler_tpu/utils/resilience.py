@@ -166,15 +166,31 @@ def collect_errors() -> ErrorCollector:
 #   native_build  native._build (rebuild fails)
 #   stream_write  stream.binner bin-file append, keyed by bin filename
 #   stream_read   stream.spill.read_bin_records, keyed by bin filename
+#
+# The registered CRASH POINTS are also fault sites (their hooks call
+# :func:`crash_point`); at a crash point the default mode is "crash"
+# (deterministic os._exit), which is how the chaos harness kills a run at
+# an exact instruction boundary:
+#   post-stage          a stage's artifacts are flushed but the manifest
+#                       terminal flag has NOT been flipped yet
+#   mid-spill-write     half a spill record written to a stream bin
+#   mid-cache-store     cache payload written to its tmp file, not renamed
+#   pre-artifact-rename manifest/ledger tmp written, os.replace pending
+CRASH_POINTS = ("post-stage", "mid-spill-write", "mid-cache-store",
+                "pre-artifact-rename")
 FAULT_SITES = ("subprocess", "fasta", "gfa", "native_load", "native_abi",
-               "native_build", "stream_write", "stream_read")
+               "native_build", "stream_write", "stream_read") + CRASH_POINTS
+
+# the distinctive status a crash-injected process dies with, so drivers
+# can tell an injected crash from a genuine failure
+CRASH_EXIT = 43
 
 
 @dataclass
 class FaultRule:
     """One injection rule: fire at `site` when `match` is a substring of the
-    hook's key, in `mode` ("fail" or "hang"), at most `times` times
-    (-1 = unlimited)."""
+    hook's key, in `mode` ("fail", "hang" or "crash"), at most `times`
+    times (-1 = unlimited)."""
     site: str
     match: str = ""
     mode: str = "fail"
@@ -196,7 +212,11 @@ class FaultPlan:
     def parse(cls, spec: str) -> "FaultPlan":
         """Parse the ``AUTOCYCLER_FAULTS`` spec: comma-separated rules of
         the form ``site[:match[:mode[:times]]]`` — e.g.
-        ``subprocess:flye:hang:1,fasta:iso_001,native_abi``."""
+        ``subprocess:flye:hang:1,fasta:iso_001,native_abi``. At a
+        registered crash point the default mode is ``crash``
+        (deterministic ``os._exit(CRASH_EXIT)`` when the rule fires), so
+        ``post-stage:::1`` kills the process at the first post-stage
+        boundary."""
         rules = []
         for part in spec.split(","):
             part = part.strip()
@@ -209,23 +229,31 @@ class FaultPlan:
                     f"unknown fault-injection site {site!r} in "
                     f"AUTOCYCLER_FAULTS (choose from {', '.join(FAULT_SITES)})")
             match = fields[1] if len(fields) > 1 else ""
-            mode = fields[2] if len(fields) > 2 and fields[2] else "fail"
-            if mode not in ("fail", "hang"):
+            default_mode = "crash" if site in CRASH_POINTS else "fail"
+            mode = fields[2] if len(fields) > 2 and fields[2] \
+                else default_mode
+            if mode not in ("fail", "hang", "crash"):
                 raise InputError(f"unknown fault mode {mode!r} "
-                                 "(choose 'fail' or 'hang')")
+                                 "(choose 'fail', 'hang' or 'crash')")
             times = int(fields[3]) if len(fields) > 3 and fields[3] else -1
             rules.append(FaultRule(site, match, mode, times))
         return cls(rules)
 
     def fire(self, site: str, key: str = "") -> Optional[FaultRule]:
+        rule = self.peek(site, key)
+        if rule is not None:
+            rule.fired += 1
+            metrics_registry.counter_inc(
+                FAULT_INJECTIONS_TOTAL, 1,
+                help="deterministic fault-injection rule firings",
+                site=site, mode=rule.mode)
+        return rule
+
+    def peek(self, site: str, key: str = "") -> Optional[FaultRule]:
+        """The rule :meth:`fire` would consume, without consuming it."""
         for rule in self.rules:
             if rule.site == site and not rule.exhausted() \
                     and rule.match in str(key):
-                rule.fired += 1
-                metrics_registry.counter_inc(
-                    FAULT_INJECTIONS_TOTAL, 1,
-                    help="deterministic fault-injection rule firings",
-                    site=site, mode=rule.mode)
                 return rule
         return None
 
@@ -243,20 +271,127 @@ def set_fault_plan(plan: Optional[FaultPlan]) -> None:
         _fault_plan = plan
 
 
+def _active_plan_locked() -> Optional[FaultPlan]:
+    """The plan in effect (explicit > env spec), cached. Call under
+    ``_fault_lock``."""
+    global _env_plan
+    if _fault_plan is not None:
+        return _fault_plan
+    spec = knob_str("AUTOCYCLER_FAULTS") or ""
+    if not spec:
+        _env_plan = None
+        return None
+    if _env_plan is None or _env_plan[0] != spec:
+        _env_plan = (spec, FaultPlan.parse(spec))
+    return _env_plan[1]
+
+
 def fault_fire(site: str, key: str = "") -> Optional[FaultRule]:
     """The hook the instrumented call sites invoke: returns the matching
-    rule (consuming one firing) or None. Cheap when no plan is active."""
-    global _env_plan
+    rule (consuming one firing) or None. Cheap when no plan is active.
+    A matched ``crash`` rule never returns — the process dies with
+    :data:`CRASH_EXIT` right here."""
     with _fault_lock:
-        if _fault_plan is not None:
-            return _fault_plan.fire(site, key)
-        spec = knob_str("AUTOCYCLER_FAULTS") or ""
-        if not spec:
-            _env_plan = None
-            return None
-        if _env_plan is None or _env_plan[0] != spec:
-            _env_plan = (spec, FaultPlan.parse(spec))
-        return _env_plan[1].fire(site, key)
+        plan = _active_plan_locked()
+        rule = plan.fire(site, key) if plan is not None else None
+    if rule is not None and rule.mode == "crash":
+        _crash_exit(site, key)
+    return rule
+
+
+# -- deterministic crash injection (the chaos harness's kill switch) --------
+
+# Patchable seam so tests can observe a would-be crash instead of dying.
+_exit = os._exit
+
+# Per-point hit counters for AUTOCYCLER_CRASH_POINTS "point@n" arming;
+# process-wide because a crash point is a process-lifetime event.
+_crash_hits: Dict[str, int] = {}
+_crash_spec_cache: Optional[Tuple[str, Dict[str, int]]] = None
+
+
+def _crash_exit(point: str, key: str = "") -> None:
+    suffix = f" ({key})" if key else ""
+    sys.stderr.write(f"autocycler crash injection: {point}{suffix}\n")
+    sys.stderr.flush()
+    _exit(CRASH_EXIT)
+
+
+def _parse_crash_points(spec: str) -> Dict[str, int]:
+    """``point[@n]`` comma list -> {point: 1-based hit index to crash at}."""
+    out: Dict[str, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, nth = part.partition("@")
+        if name not in CRASH_POINTS:
+            raise InputError(
+                f"unknown crash point {name!r} in AUTOCYCLER_CRASH_POINTS "
+                f"(choose from: {', '.join(CRASH_POINTS)})")
+        if nth:
+            try:
+                out[name] = max(1, int(nth))
+            except ValueError:
+                raise InputError(
+                    f"bad crash-point hit index {nth!r} for {name!r} "
+                    "(expected 'point' or 'point@N')")
+        else:
+            out[name] = 1
+    return out
+
+
+def _crash_due_locked(point: str, advance: bool) -> bool:
+    """Whether the next hit of ``point`` is armed via AUTOCYCLER_CRASH_POINTS.
+    Call under ``_fault_lock``; ``advance`` consumes one hit."""
+    global _crash_spec_cache
+    spec = knob_str("AUTOCYCLER_CRASH_POINTS") or ""
+    targets: Dict[str, int] = {}
+    if spec:
+        if _crash_spec_cache is None or _crash_spec_cache[0] != spec:
+            _crash_spec_cache = (spec, _parse_crash_points(spec))
+        targets = _crash_spec_cache[1]
+    hit = _crash_hits.get(point, 0) + 1
+    if advance:
+        _crash_hits[point] = hit
+    return targets.get(point) == hit
+
+
+def crash_armed(point: str, key: str = "") -> bool:
+    """True when :func:`crash_point` called now would kill the process,
+    WITHOUT consuming the hit. Call sites that simulate a torn write use
+    this to flush a partial payload before pulling the trigger."""
+    with _fault_lock:
+        if _crash_due_locked(point, advance=False):
+            return True
+        plan = _active_plan_locked()
+        rule = plan.peek(point, key) if plan is not None else None
+    return rule is not None and rule.mode == "crash"
+
+
+def crash_point(point: str, key: str = "") -> None:
+    """A registered crash point: deterministically ``os._exit(CRASH_EXIT)``
+    here when armed, else a no-op. Armed either by ``AUTOCYCLER_CRASH_POINTS``
+    (comma list of ``point[@n]`` — crash at the n-th hit of the point,
+    default the first) or by an ``AUTOCYCLER_FAULTS`` / :func:`set_fault_plan`
+    rule at this site (mode defaults to ``crash`` at crash-point sites).
+    Every call counts one hit for the ``@n`` bookkeeping."""
+    with _fault_lock:
+        due = _crash_due_locked(point, advance=True)
+    if due:
+        metrics_registry.counter_inc(
+            FAULT_INJECTIONS_TOTAL, 1,
+            help="deterministic fault-injection rule firings",
+            site=point, mode="crash")
+        _crash_exit(point, key)
+    fault_fire(point, key)
+
+
+def _reset_crash_hits_for_tests() -> None:
+    global _crash_spec_cache
+    with _fault_lock:
+        _crash_hits.clear()
+        _crash_spec_cache = None
 
 
 # ---------------------------------------------------------------------------
@@ -498,9 +633,57 @@ def _reset_degrades_for_tests() -> None:
 # ---------------------------------------------------------------------------
 
 
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except OSError:
+        return True  # EPERM: alive but not ours
+    return True
+
+
+def sweep_stale_tmps(path) -> int:
+    """Remove leftover ``<name>.<pid>.*.tmp`` siblings of ``path`` whose
+    writing process is dead. Tmp names are pid-tagged exactly so two live
+    daemons sharing a root never delete each other's in-flight writes."""
+    path = Path(path)
+    removed = 0
+    if not path.parent.is_dir():
+        return removed
+    for tmp in path.parent.glob(path.name + "*"):
+        name = tmp.name
+        if name == path.name or ".tmp" not in name or name.endswith(".bak"):
+            continue
+        pid_tok = name[len(path.name):].lstrip(".").split(".", 1)[0]
+        if pid_tok.isdigit() and _pid_alive(int(pid_tok)):
+            continue
+        with contextlib.suppress(OSError):
+            tmp.unlink()
+            removed += 1
+    return removed
+
+
+def read_manifest(path) -> dict:
+    """Never-raise reader for run/serve manifests. Parses ``path`` (falling
+    back to ``<path>.bak``) to the last good state; a torn tail, garbage
+    content, or a missing file yields an empty manifest, never an
+    exception — a crash mid-write must not brick the next start-up."""
+    path = Path(path)
+    for candidate in (path, path.with_name(path.name + ".bak")):
+        try:
+            data = json.loads(candidate.read_text())
+        except (OSError, ValueError):
+            continue
+        if isinstance(data, dict) and isinstance(data.get("items"), dict):
+            return data
+    return {"version": RunManifest.VERSION, "items": {}}
+
+
 class RunManifest:
     """A JSON manifest of per-item status for a resumable multi-item run
-    (`autocycler batch` writes ``batch_manifest.json``).
+    (`autocycler batch` writes ``batch_manifest.json``, the serve scheduler
+    ``serve_manifest.json``).
 
     Schema (version 1)::
 
@@ -508,11 +691,21 @@ class RunManifest:
          "items": {"<name>": {"status": "pending|running|failed|done",
                               "stage": "<last stage reached>" | null,
                               "error": "<message>" | null,
-                              "attempts": <int>}}}
+                              "attempts": <int>,
+                              # optional, present once a stage checkpoints:
+                              "stages": {"<stage>": {
+                                  "done": true,
+                                  "outputs": {"<path>": {"sha256", "bytes"}},
+                                  "ts_epoch": <float>}},
+                              # optional scheduler extras (job spec, ...)
+                              ...}}}
 
-    Every mutation rewrites the file atomically (tmp + rename), so a run
-    killed at any point leaves a loadable manifest; items still "running"
-    at load time are treated as interrupted and eligible for resume."""
+    Every mutation rewrites the file atomically (pid-tagged tmp + rename,
+    previous state kept as ``<name>.bak``), so a run killed at any point
+    leaves a loadable manifest; loading never raises (torn/garbage files
+    parse to the last good state via :func:`read_manifest`). Items still
+    "running" at load time are interrupted and eligible for resume; their
+    per-stage records say where to re-enter."""
 
     VERSION = 1
 
@@ -523,17 +716,8 @@ class RunManifest:
     @classmethod
     def load(cls, path) -> "RunManifest":
         manifest = cls(path)
-        path = Path(path)
-        if path.is_file():
-            try:
-                data = json.loads(path.read_text())
-            except (OSError, json.JSONDecodeError) as e:
-                raise InputError(f"unreadable run manifest {path}: {e}")
-            if data.get("version") != cls.VERSION:
-                raise InputError(
-                    f"run manifest {path} has unsupported version "
-                    f"{data.get('version')!r} (expected {cls.VERSION})")
-            manifest.items = data.get("items", {})
+        sweep_stale_tmps(Path(path))
+        manifest.items = read_manifest(path).get("items", {})
         return manifest
 
     def _entry(self, name: str) -> dict:
@@ -564,6 +748,57 @@ class RunManifest:
         self._entry(name)["stage"] = stage
         self.save()
 
+    def stage_done(self, name: str, stage: str, outputs=()) -> None:
+        """Checkpoint ``stage`` of item ``name`` as complete, recording the
+        content hash of each flushed output artifact. The registered
+        ``post-stage`` crash point sits between artifact flush and the
+        manifest flip: a crash there re-runs the stage on resume (idempotent
+        and byte-identical), never skips an unfinished one."""
+        from ..obs.ledger import artifact_hash  # lazy: obs imports ledger
+        recorded = {}
+        for path in outputs:
+            info = artifact_hash(Path(path))
+            if info is not None:
+                recorded[str(path)] = info
+        crash_point("post-stage", f"{name}/{stage}")
+        entry = self._entry(name)
+        entry["stage"] = stage
+        entry.setdefault("stages", {})[stage] = {
+            "done": True, "outputs": recorded, "ts_epoch": time.time()}
+        self.save()
+
+    def stage_complete(self, name: str, stage: str, verify: bool = True) -> bool:
+        """True when ``stage`` of ``name`` checkpointed AND (with ``verify``)
+        every recorded output still exists with its recorded hash — a
+        deleted or doctored artifact demotes the stage to not-done, so
+        resume re-runs rather than trusting a stale flag."""
+        from ..obs.ledger import artifact_hash
+        entry = self.items.get(name) or {}
+        rec = (entry.get("stages") or {}).get(stage) or {}
+        if not rec.get("done"):
+            return False
+        if not verify:
+            return True
+        for path, want in (rec.get("outputs") or {}).items():
+            have = artifact_hash(Path(path))
+            if have is None or have.get("sha256") != (want or {}).get("sha256"):
+                return False
+        return True
+
+    def stage_outputs(self, name: str, stage: str) -> Dict[str, dict]:
+        entry = self.items.get(name) or {}
+        rec = (entry.get("stages") or {}).get(stage) or {}
+        return dict(rec.get("outputs") or {})
+
+    def last_stage(self, name: str) -> Optional[str]:
+        entry = self.items.get(name) or {}
+        return entry.get("stage")
+
+    def annotate(self, name: str, **extra) -> None:
+        """Attach scheduler extras (job spec, out_dir, ...) to an entry."""
+        self._entry(name).update(extra)
+        self.save()
+
     def done(self, name: str) -> None:
         entry = self._entry(name)
         entry["status"] = "done"
@@ -589,10 +824,21 @@ class RunManifest:
         payload = json.dumps({"version": self.VERSION, "items": self.items},
                              indent=2, sort_keys=True)
         fd, tmp = tempfile.mkstemp(dir=self.path.parent,
-                                   prefix=self.path.name, suffix=".tmp")
+                                   prefix=f"{self.path.name}.{os.getpid()}.",
+                                   suffix=".tmp")
         try:
             with os.fdopen(fd, "w") as f:
                 f.write(payload + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            crash_point("pre-artifact-rename", str(self.path))
+            # keep the previous good state reachable: a reader that lands in
+            # the window between the two renames (or after a crash there)
+            # falls back to the .bak via read_manifest
+            if self.path.is_file():
+                with contextlib.suppress(OSError):
+                    os.replace(self.path,
+                               self.path.with_name(self.path.name + ".bak"))
             os.replace(tmp, self.path)
         except BaseException:
             with contextlib.suppress(OSError):

@@ -1138,6 +1138,77 @@ def streamsmoke_row(root=None) -> dict:
     return row
 
 
+CHAOSSMOKE_PATH = Path(__file__).resolve().parent / "CHAOSSMOKE.json"
+
+
+def bench_chaossmoke() -> None:
+    """`python bench.py chaossmoke`: the crash-injection chaos harness
+    (utils.chaos) on a small synthetic batch (2 isolates x 3 assemblies,
+    k=21). One uninterrupted oracle run, then for every registered crash
+    point: arm it, run `batch` in a child until it dies there (exit 43),
+    restart with --resume, and require byte-identical final outputs plus a
+    clean orphan scan (no *.tmp* files, no dead spill run dirs). Writes
+    CHAOSSMOKE.json (surfaced by `bench.py trend`); one JSON line on
+    stdout; exit 1 on fail."""
+    import shutil
+
+    tests_dir = str(Path(__file__).resolve().parent / "tests")
+    if tests_dir not in sys.path:
+        sys.path.insert(0, tests_dir)
+    from synthetic import make_isolate_dirs
+
+    from autocycler_tpu.utils import chaos
+
+    t0 = time.perf_counter()
+    tmp = Path(tempfile.mkdtemp(prefix="autocycler_chaossmoke_"))
+    parent = make_isolate_dirs(tmp / "isolates", 2, seed0=7,
+                               n_assemblies=3, chromosome_len=160,
+                               plasmid_len=70)
+    setup_s = time.perf_counter() - t0
+
+    summary = chaos.run_chaos(parent, tmp / "work", kmer=21)
+    artifact = {
+        "bench": "chaossmoke",
+        "passed": summary["passed"],
+        "points": summary["points"],
+        "cycles": summary["cycles"],
+        "oracle_artifacts": summary["oracle_artifacts"],
+        "setup_s": round(setup_s, 2),
+        "wall_s": summary["wall_s"],
+    }
+    CHAOSSMOKE_PATH.write_text(json.dumps(artifact, indent=2) + "\n")
+    print(json.dumps(artifact))
+    shutil.rmtree(tmp, ignore_errors=True)
+    if not artifact["passed"]:
+        sys.exit(1)
+
+
+def chaossmoke_row(root=None) -> dict:
+    """The latest chaossmoke artifact as one trend row; every field
+    optional (absent/invalid artifact → None-valued row, never a raise)."""
+    path = Path(root) / "CHAOSSMOKE.json" if root is not None \
+        else CHAOSSMOKE_PATH
+    row = {"present": False, "passed": None, "points": None,
+           "cycles_passed": None, "wall_s": None}
+    try:
+        data = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return row
+    if not isinstance(data, dict):
+        return row
+    cycles = data.get("cycles")
+    row.update({
+        "present": True,
+        "passed": data.get("passed"),
+        "points": len(data.get("points") or []),
+        "cycles_passed": sum(1 for c in cycles if isinstance(c, dict)
+                             and c.get("passed"))
+        if isinstance(cycles, list) else None,
+        "wall_s": data.get("wall_s"),
+    })
+    return row
+
+
 GUARD_BASELINE_PATH = Path(__file__).resolve().parent / "BENCH_GUARD.json"
 GUARD_TOLERANCE = 1.25
 
@@ -1579,9 +1650,19 @@ def bench_trend() -> None:
               f"GFA identical: {stream.get('identical_gfa')})  "
               f"(STREAMSMOKE.json)",
               file=sys.stderr)
+    chaos = chaossmoke_row()
+    if chaos.get("present"):
+        verdict = "ok" if chaos.get("passed") else "FAIL"
+        print("", file=sys.stderr)
+        print(f"chaossmoke: {verdict} "
+              f"{fmt(chaos.get('cycles_passed'))}/{fmt(chaos.get('points'))} "
+              f"crash points recovered byte-identically "
+              f"in {fmt(chaos.get('wall_s'), '.1f')}s  (CHAOSSMOKE.json)",
+              file=sys.stderr)
     print(json.dumps({"bench": "trend", "rounds": rows,
                       "multichip": mrows, "lintsmoke": lint,
-                      "sketchsmoke": sketch, "streamsmoke": stream}))
+                      "sketchsmoke": sketch, "streamsmoke": stream,
+                      "chaossmoke": chaos}))
 
 
 def main() -> None:
@@ -1625,6 +1706,8 @@ def main() -> None:
         bench_sketchsmoke()
     elif len(sys.argv) > 1 and sys.argv[1] == "streamsmoke":
         bench_streamsmoke()
+    elif len(sys.argv) > 1 and sys.argv[1] == "chaossmoke":
+        bench_chaossmoke()
     elif len(sys.argv) > 1 and sys.argv[1] == "guard":
         bench_guard(sys.argv[2:])
     elif len(sys.argv) > 1 and sys.argv[1] == "trend":

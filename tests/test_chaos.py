@@ -1,0 +1,60 @@
+"""The crash-injection chaos harness (utils.chaos) for real.
+
+One tiny two-isolate batch; for every registered crash point the driver
+kills a child `autocycler batch` run at that point (distinctive exit 43),
+restarts it with --resume, and requires the recovered outputs to be
+byte-identical to an uninterrupted oracle run with a clean orphan scan.
+This is the test behind the recovery table in docs/failure-modes.md;
+`bench.py chaossmoke` runs the same driver as a standalone artifact.
+"""
+
+import pytest
+
+from synthetic import make_isolate_dirs
+
+pytestmark = pytest.mark.chaos
+
+
+def test_every_crash_point_recovers_byte_identical(tmp_path):
+    from autocycler_tpu.utils import chaos
+    from autocycler_tpu.utils.resilience import CRASH_POINTS
+
+    parent = make_isolate_dirs(tmp_path / "isolates", 2, seed0=7,
+                               n_assemblies=3, chromosome_len=160,
+                               plasmid_len=70)
+    summary = chaos.run_chaos(parent, tmp_path / "work", kmer=21)
+    assert summary["points"] == list(CRASH_POINTS)
+    assert summary["oracle_artifacts"] == 6    # 2 isolates x 3 final files
+    for cycle in summary["cycles"]:
+        assert cycle["passed"], cycle
+        assert cycle["crash_rc"] == chaos.CRASH_EXIT
+        assert cycle["crash_marker"]           # stderr names the point
+        assert cycle["identical"]
+        assert cycle["orphans"] == []
+    assert summary["passed"]
+
+
+def test_unknown_crash_point_rejected(tmp_path):
+    from autocycler_tpu.utils import chaos
+
+    with pytest.raises(ValueError, match="unknown crash point"):
+        chaos.chaos_cycle(tmp_path, tmp_path / "w", "mid-everything")
+
+
+def test_orphan_scan_sees_tmp_debris_and_dead_spill_dirs(tmp_path):
+    from autocycler_tpu.utils.chaos import scan_orphans
+
+    out = tmp_path / "out"
+    (out / "iso_000").mkdir(parents=True)
+    assert scan_orphans(out) == []
+    # a torn atomic-write tmp, a dead spill run dir, and expected state
+    # that must NOT count (.bak fallback, ordinary artifacts)
+    (out / "iso_000" / "batch_manifest.json.1234.ab.tmp").write_text("{")
+    (out / "iso_000" / "batch_manifest.json.bak").write_text("{}")
+    (out / "iso_000" / "consensus_assembly.gfa").write_text("H\n")
+    run = out / "iso_000" / ".stream" / "run-99-dead"
+    run.mkdir(parents=True)
+    orphans = scan_orphans(out)
+    assert "iso_000/batch_manifest.json.1234.ab.tmp" in orphans
+    assert "iso_000/.stream/run-99-dead/" in orphans
+    assert len(orphans) == 2

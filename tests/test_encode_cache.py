@@ -234,3 +234,51 @@ def test_shared_cache_dir_override(tmp_path, monkeypatch):
         assert open_cache(tmp_path / "job1") is None
     finally:
         set_shared_cache_dir(None)
+
+
+@pytest.mark.perf
+def test_budget_eviction_tolerates_racing_evictor(tmp_path, monkeypatch):
+    """Two daemons sharing one cache dir both run the evictor. A file
+    vanishing between our listing and our unlink (the other evictor got
+    there first) must count as reclaimed bytes, not crash the sweep."""
+    from pathlib import Path
+
+    from autocycler_tpu.utils.cache import EncodeCache
+
+    cache = EncodeCache(tmp_path / ".cache")
+    old = _store_entry(cache, "a", 1_000)
+    mid = _store_entry(cache, "b", 2_000)
+    new = _store_entry(cache, "c", 3_000)
+    size = new.stat().st_size
+
+    real_unlink = Path.unlink
+
+    def racing_unlink(self, *args, **kwargs):
+        if self.name == old.name:
+            real_unlink(self)              # the "other evictor" wins...
+            raise FileNotFoundError(self)  # ...and ours sees it gone
+        return real_unlink(self, *args, **kwargs)
+
+    monkeypatch.setattr(Path, "unlink", racing_unlink)
+    # the raced entry's bytes still shrink the accounted total, so one
+    # real eviction (mid) suffices to fit the budget
+    assert cache.enforce_budget(max_bytes=size) == 1
+    assert not old.exists() and not mid.exists() and new.exists()
+
+
+def test_open_cache_sweeps_dead_writer_tmps(tmp_path):
+    """Pid-tagged store tmps from a dead writer are swept at open_cache;
+    a live writer's tmp (our own pid) survives the sweep."""
+    import os
+
+    from autocycler_tpu.utils.cache import open_cache
+
+    cache_dir = tmp_path / ".cache"
+    cache_dir.mkdir()
+    dead = cache_dir / "parse_ab.npz.999999999.x1y2.tmp"
+    dead.write_bytes(b"torn")
+    live = cache_dir / f"parse_cd.npz.{os.getpid()}.z9z9.tmp"
+    live.write_bytes(b"in flight")
+    assert open_cache(tmp_path) is not None
+    assert not dead.exists()
+    assert live.exists()

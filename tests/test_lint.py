@@ -141,6 +141,60 @@ def test_knobs_docs_round_trip(tmp_path):
     assert lint_source(tmp_path, "x = 1\n", docs=docs) == []
 
 
+# ---- faults family ----
+
+
+def _faults_docs(tmp_path, table_rows):
+    """A docs tree (cli.md + failure-modes.md) whose fault-site table
+    holds exactly ``table_rows``; returns the cli.md path for docs=."""
+    docs_dir = tmp_path / "docs"
+    docs_dir.mkdir(parents=True, exist_ok=True)
+    cli = docs_dir / "cli.md"
+    cli.write_text("<!-- knobs:begin -->\n" + knobs_markdown()
+                   + "<!-- knobs:end -->\n")
+    (docs_dir / "failure-modes.md").write_text(
+        "`stream_write` mentioned in prose must not count\n"
+        "<!-- faults:begin -->\n"
+        "| Site | Hook | Injected failure | Containment / recovery |\n"
+        "|---|---|---|---|\n"
+        + "".join(f"| `{site}` | h | f | r |\n" for site in table_rows)
+        + "<!-- faults:end -->\n")
+    return cli
+
+
+def test_faults_documented_both_directions(tmp_path):
+    from autocycler_tpu.utils.resilience import FAULT_SITES
+
+    rows = [s for s in FAULT_SITES if s != "post-stage"] + ["made-up-site"]
+    docs = _faults_docs(tmp_path, rows)
+    findings = lint_source(tmp_path, "x = 1\n", docs=docs)
+    faults = [f for f in findings if f.rule == "faults.documented"]
+    messages = " ".join(f.message for f in faults)
+    assert "post-stage" in messages and "no row" in messages
+    assert "made-up-site is not registered" in messages
+    # prose mentions outside a table row's first cell never count as rows
+    assert "stream_write" not in messages
+
+
+def test_faults_documented_markers_required(tmp_path):
+    docs = _faults_docs(tmp_path, [])
+    (docs.parent / "failure-modes.md").write_text("no markers\n")
+    findings = lint_source(tmp_path, "x = 1\n", docs=docs)
+    faults = [f for f in findings if f.rule == "faults.documented"]
+    assert len(faults) == 1 and "markers" in faults[0].message
+
+
+def test_faults_documented_round_trip(tmp_path):
+    """A table with exactly the registered sites lints clean; a missing
+    failure-modes.md means nothing to check (linting a non-repo target)."""
+    from autocycler_tpu.utils.resilience import FAULT_SITES
+
+    docs = _faults_docs(tmp_path, list(FAULT_SITES))
+    assert lint_source(tmp_path, "x = 1\n", docs=docs) == []
+    (docs.parent / "failure-modes.md").unlink()
+    assert lint_source(tmp_path, "x = 1\n", docs=docs) == []
+
+
 # ---- locks family ----
 
 # the pre-migration shape of utils/resilience.py's set_subprocess_policy:
@@ -543,4 +597,5 @@ def test_rule_ids_are_stable():
         "purity.impure-call",
         "readers.raise", "readers.unguarded-io",
         "metrics.name", "metrics.label", "metrics.span",
+        "faults.documented",
     }

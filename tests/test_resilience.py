@@ -240,14 +240,87 @@ def test_run_manifest_lifecycle_and_round_trip(tmp_path):
     assert m2.counts() == {"done": 1, "running": 1}
 
 
-def test_run_manifest_load_rejects_garbage_and_wrong_version(tmp_path):
+def test_run_manifest_load_never_raises_on_garbage(tmp_path):
+    # torn/garbage manifests parse to the last good state: a crash
+    # mid-write must not brick the next start-up
     bad = tmp_path / "m.json"
     bad.write_text("{not json")
+    assert rz.RunManifest.load(bad).items == {}
+    bad.write_text(json.dumps({"version": 1, "items": "not-a-dict"}))
+    assert rz.RunManifest.load(bad).items == {}
+
+
+def test_run_manifest_torn_write_falls_back_to_bak(tmp_path):
+    path = tmp_path / "m.json"
+    m = rz.RunManifest(path)
+    m.start("iso_000")
+    m.done("iso_000")            # save keeps the prior state as .bak
+    path.write_text('{"version": 1, "items": {"iso')  # simulated torn tail
+    recovered = rz.RunManifest.load(path)
+    assert recovered.status("iso_000") == "running"   # the pre-crash state
+
+
+def test_run_manifest_stage_records_checkpoint_and_verify(tmp_path):
+    art = tmp_path / "out.gfa"
+    art.write_text("S\t1\tACGT\n")
+    m = rz.RunManifest(tmp_path / "m.json")
+    m.start("iso_000")
+    assert not m.stage_complete("iso_000", "compress")
+    m.stage_done("iso_000", "compress", outputs=[art])
+    assert m.stage_complete("iso_000", "compress")
+    assert m.last_stage("iso_000") == "compress"
+    assert str(art) in m.stage_outputs("iso_000", "compress")
+
+    m2 = rz.RunManifest.load(tmp_path / "m.json")   # survives a reload
+    assert m2.stage_complete("iso_000", "compress")
+    art.write_text("S\t1\tTTTT\n")                  # doctored artifact
+    assert not m2.stage_complete("iso_000", "compress")
+    assert m2.stage_complete("iso_000", "compress", verify=False)
+    art.unlink()                                    # missing artifact
+    assert not m2.stage_complete("iso_000", "compress")
+
+
+def test_run_manifest_sweeps_dead_pid_tmps(tmp_path):
+    path = tmp_path / "m.json"
+    rz.RunManifest(path).save()
+    stale = tmp_path / "m.json.999999999.abc.tmp"
+    stale.write_text("{")
+    live = tmp_path / f"m.json.{os.getpid()}.abc.tmp"
+    live.write_text("{")
+    rz.RunManifest.load(path)
+    assert not stale.exists()     # dead writer's leftover swept
+    assert live.exists()          # a live writer's in-flight tmp kept
+
+
+def test_crash_point_fires_at_nth_hit(tmp_path, monkeypatch):
+    codes = []
+    monkeypatch.setattr(rz, "_exit", codes.append)
+    monkeypatch.setenv("AUTOCYCLER_CRASH_POINTS", "post-stage@2")
+    rz._reset_crash_hits_for_tests()
+    try:
+        rz.crash_point("post-stage", "a/compress")
+        assert codes == []
+        assert rz.crash_armed("post-stage")       # peek does not consume
+        rz.crash_point("post-stage", "a/cluster")
+        assert codes == [rz.CRASH_EXIT]
+    finally:
+        rz._reset_crash_hits_for_tests()
+
+
+def test_fault_plan_crash_mode_defaults_at_crash_sites(monkeypatch):
+    codes = []
+    monkeypatch.setattr(rz, "_exit", codes.append)
+    plan = rz.FaultPlan.parse("mid-cache-store:::1")
+    assert plan.rules[0].mode == "crash"
+    rz.set_fault_plan(plan)
+    assert rz.crash_armed("mid-cache-store")
+    rz.crash_point("mid-cache-store", "key")
+    assert codes == [rz.CRASH_EXIT]
+    assert not rz.crash_armed("mid-cache-store")  # single firing consumed
     with pytest.raises(rz.InputError):
-        rz.RunManifest.load(bad)
-    bad.write_text(json.dumps({"version": 99, "items": {}}))
+        rz.FaultPlan.parse("subprocess::bogus-mode")
     with pytest.raises(rz.InputError):
-        rz.RunManifest.load(bad)
+        rz._parse_crash_points("not-a-point")
 
 
 def test_run_manifest_missing_file_is_empty(tmp_path):

@@ -214,7 +214,7 @@ def test_queue_full_returns_503(tmp_path, capsys):
 
     gate = threading.Event()
     handle = ServeHandle(tmp_path / "serve", port=0, queue_size=1)
-    handle.scheduler._run_spec = lambda spec, out_dir: gate.wait(30)
+    handle.scheduler._run_spec = lambda spec, out_dir, **kw: gate.wait(30)
     handle.start()
     try:
         specs = {"assemblies_dir": str(tmp_path)}
@@ -322,3 +322,147 @@ def test_watch_follow_waits_for_run_dir(tmp_path, capsys):
     assert watch(missing, follow=False) == 1
     err = capsys.readouterr()
     assert "nothing to watch" in err.err
+
+
+def test_daemon_restart_replays_queue_and_resumes_running(tmp_path,
+                                                          monkeypatch,
+                                                          capsys):
+    """Crash-safe replay: a daemon dies with two jobs queued and one
+    running mid-pipeline. A new scheduler on the same root re-enqueues
+    everything in submission order, the interrupted job resumes from its
+    last checkpointed stage (compress is skipped, not re-run), and the
+    resumed outputs are byte-identical to an uninterrupted oracle run."""
+    from pathlib import Path
+
+    from autocycler_tpu.serve.protocol import parse_job_spec
+    from autocycler_tpu.serve.scheduler import Scheduler
+
+    make_assemblies(tmp_path, n_assemblies=4, chromosome_len=2000,
+                    plasmid_len=500)
+    asm = tmp_path / "assemblies"
+    root = tmp_path / "serve"
+    spec_pipe = parse_job_spec({"assemblies_dir": str(asm),
+                                "command": "pipeline", "kmer": 51})
+    spec_comp = parse_job_spec({"assemblies_dir": str(asm), "kmer": 51})
+
+    # daemon #1: worker never started; job 1 dies mid-pipeline (cluster
+    # stage raises after compress checkpointed), then the manifest entry
+    # is flipped back to running — exactly what a kill -9 mid-cluster
+    # leaves on disk
+    sched1 = Scheduler(root)
+    j1 = sched1.submit(spec_pipe)
+    j2 = sched1.submit(spec_comp)
+    j3 = sched1.submit(spec_comp)
+
+    def boom(*args, **kwargs):
+        raise RuntimeError("injected daemon death")
+
+    monkeypatch.setattr("autocycler_tpu.commands.cluster.cluster", boom)
+    sched1.execute(j1)
+    assert sched1.manifest.items[j1.id]["status"] == "failed"
+    assert sched1.manifest.stage_complete(j1.id, "compress")
+    sched1.manifest.start(j1.id)
+    monkeypatch.undo()
+
+    compress_gfa = Path(j1.out_dir) / "input_assemblies.gfa"
+    checkpoint_mtime = compress_gfa.stat().st_mtime_ns
+
+    # daemon #2 on the same root replays all three in submission order
+    sched2 = Scheduler(root)
+    err = capsys.readouterr().err
+    assert f"{j1.id} resuming from last checkpointed stage" in err
+    assert f"{j2.id} re-enqueued after restart" in err
+    replayed = {job.id: job for job in sched2.jobs()}
+    assert set(replayed) == {j1.id, j2.id, j3.id}
+    assert replayed[j1.id].resumed and not replayed[j2.id].resumed
+
+    sched2.start()
+    try:
+        assert _wait_until(lambda: all(
+            job.state == "done" for job in sched2.jobs()), timeout=240)
+    finally:
+        sched2.shutdown()
+    assert replayed[j1.id].finished_epoch \
+        <= replayed[j2.id].finished_epoch \
+        <= replayed[j3.id].finished_epoch
+
+    # the checkpointed stage was skipped, not re-run
+    assert compress_gfa.stat().st_mtime_ns == checkpoint_mtime
+
+    # byte-identity against an uninterrupted oracle run of the same spec
+    oracle = tmp_path / "oracle"
+    sched2._run_spec(spec_pipe, oracle)
+    for name in ("input_assemblies.gfa", "consensus_assembly.gfa",
+                 "consensus_assembly.fasta"):
+        assert (Path(j1.out_dir) / name).read_bytes() \
+            == (oracle / name).read_bytes(), name
+    capsys.readouterr()
+
+
+def _raw_request(endpoint, method, path, body=None):
+    """http.client request keeping the raw status + headers (request_json
+    hides headers, and the shed contract includes Retry-After)."""
+    import http.client
+    from urllib.parse import urlparse
+
+    u = urlparse(endpoint)
+    conn = http.client.HTTPConnection(u.hostname, u.port, timeout=30)
+    try:
+        payload = json.dumps(body).encode() if body is not None else None
+        conn.request(method, path, body=payload,
+                     headers={"Content-Type": "application/json"}
+                     if payload else {})
+        resp = conn.getresponse()
+        data = resp.read()
+        return resp.status, dict(resp.getheaders()), data
+    finally:
+        conn.close()
+
+
+def test_burn_rate_shedding_503_retry_after_and_recovery(serve_handle,
+                                                         tmp_path,
+                                                         monkeypatch,
+                                                         capsys):
+    """Admission control end to end: with the SLO window burning past
+    AUTOCYCLER_SLO_SHED_BURN, POST /jobs sheds with 503 + Retry-After
+    and the shed counter; /healthz degrades with reason "shedding";
+    relaxing the objective recovers admission without a restart."""
+    make_assemblies(tmp_path, n_assemblies=3, chromosome_len=2000,
+                    plasmid_len=500)
+    endpoint = serve_handle.endpoint
+    spec = {"assemblies_dir": str(tmp_path / "assemblies"), "kmer": 51}
+
+    # one real job seeds the latency window (no objective set yet, so its
+    # own admission cannot shed)
+    status, rec = _request(endpoint, "POST", "/jobs", body=spec)
+    assert status == 202
+    assert _wait_job(endpoint, rec["id"])["state"] == "done"
+
+    # an impossible objective makes that job a violation: burn 1/0.05=20
+    monkeypatch.setenv("AUTOCYCLER_SLO_P95_S", "0.0001")
+    monkeypatch.setenv("AUTOCYCLER_SLO_SHED_BURN", "1.0")
+
+    status, headers, data = _raw_request(endpoint, "POST", "/jobs",
+                                         body=spec)
+    shed = json.loads(data)
+    assert status == 503
+    assert headers.get("Retry-After") == "15"
+    assert "shedding load" in shed["error"]
+    assert shed["burn_rate"] > shed["shed_burn"] == 1.0
+    assert shed["retry_after_s"] == 15
+
+    status, health = _request(endpoint, "GET", "/healthz")
+    assert status == 200 and health["status"] == "degraded"
+    assert "shedding" in health["degraded"]
+    assert health["slo"]["shedding"] is True
+
+    status, _, metrics = _raw_request(endpoint, "GET", "/metrics")
+    assert status == 200
+    assert b"autocycler_serve_shed_total" in metrics
+
+    # relaxing the objective live re-admits without a restart
+    monkeypatch.delenv("AUTOCYCLER_SLO_P95_S")
+    status, rec = _request(endpoint, "POST", "/jobs", body=spec)
+    assert status == 202
+    assert _wait_job(endpoint, rec["id"])["state"] == "done"
+    capsys.readouterr()
