@@ -235,12 +235,15 @@ def render_top_frame(root) -> Optional[str]:
         spill = _gauge_series(entries, "autocycler_stream_spill_bytes")
         bin_deltas = _counter_delta_series(
             entries, "autocycler_stream_bins_total")
+        rle = _gauge_series(entries, "autocycler_stream_rle_ratio")
         if any(spill) or any(bin_deltas):
             bits = [f"disk {sparkline(spill)} now "
                     f"{obs_report._fmt_bytes(spill[-1] if spill else 0)} "
                     f"(peak {obs_report._fmt_bytes(max(spill) if spill else 0)})"]
             if any(bin_deltas):
                 bits.append(f"bins +{int(sum(bin_deltas))} in view")
+            if any(rle):
+                bits.append(f"rle {max(rle):.1f}x")
             lines.append("Spill        " + " · ".join(bits))
 
         summary = summarize_timeseries(entries) or {}

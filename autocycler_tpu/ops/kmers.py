@@ -1259,7 +1259,9 @@ def build_kmer_index(sequences, k: int, use_jax: UseJax = None,
 
     # ---- k-mer grouping ----
     # streamed path first when enabled: disk-spill bins bound the grouping
-    # working set; any spill failure degrades VISIBLY to the in-memory path
+    # working set; any spill failure — write faults, quarantined bins (torn
+    # tails, count mismatches, bad RLE runs, unsupported spill record
+    # formats), writer-lane errors — degrades VISIBLY to the in-memory path
     stats = None
     if stream_on:
         from ..stream import stream_group_windows_stats

@@ -10,23 +10,28 @@ of crashing. See :mod:`.driver` for the pipeline and
 """
 
 from .binner import StreamBinner
-from .driver import (BINS_TOTAL, QUARANTINED_BINS_TOTAL, SPILL_BYTES_GAUGE,
+from .driver import (BINS_TOTAL, QUARANTINED_BINS_TOTAL, RLE_RATIO_GAUGE,
                      stream_group_windows_stats)
 from .merge import merge_ranks
 from .planner import StreamPlan, plan_stream, resolve_stream_mode
 from .sorter import BinGroups, occ_byte_starts, sort_bin
-from .spill import (ORPHANS_SWEPT_TOTAL, purge_stream_spills,
-                    read_bin_records, set_stream_root, stream_root,
-                    sweep_orphan_spills)
+from .spill import (ORPHANS_SWEPT_TOTAL, SPILL_BYTES_GAUGE,
+                    SPILL_BYTES_TOTAL, decode_rle, encode_rle,
+                    purge_stream_spills, read_bin_records, set_stream_root,
+                    stream_root, sweep_orphan_spills)
 
 __all__ = [
     "BINS_TOTAL",
     "BinGroups",
     "ORPHANS_SWEPT_TOTAL",
     "QUARANTINED_BINS_TOTAL",
+    "RLE_RATIO_GAUGE",
     "SPILL_BYTES_GAUGE",
+    "SPILL_BYTES_TOTAL",
     "StreamBinner",
     "StreamPlan",
+    "decode_rle",
+    "encode_rle",
     "merge_ranks",
     "occ_byte_starts",
     "plan_stream",

@@ -15,6 +15,14 @@ write buffers touches few distinct bins per chunk. Buffers are bounded:
 ``plan.flush_records`` records per bin, appended to the bin file when full,
 so pass-1 host memory is O(chunk + buffers) however large the input is.
 
+Those same runs are why the format-2 spill is small: a flush encodes each
+maximal run of consecutive occurrence indices as one ``(start, len)`` RLE
+pair (KMC 2's super-k-mer compression, ~k:1 on real sequence). And when the
+plan is pipelined, appends ride an :class:`~autocycler_tpu.utils.pool.
+OrderedSubmitter` lane so routing/hashing the next chunk overlaps the disk
+write of the previous flush — per-bin append order is still exactly the
+synchronous order, so bin files are byte-identical either way.
+
 Dot-padded windows are binned like any others — '.' is symbol 0 of the
 5-symbol code space and part of window content, exactly as the in-memory
 grouping treats it.
@@ -28,9 +36,11 @@ from typing import List
 import numpy as np
 
 from ..ops.sketch import _kmer_hashes, _window_minima
+from ..utils.pool import OrderedSubmitter
 from ..utils.resilience import crash_armed, crash_point, fault_fire
 from .planner import StreamPlan
-from .spill import bin_filename, write_manifest
+from .spill import (RECORD_BYTES, bin_filename, count_spill_bytes,
+                    encode_rle, set_spill_gauge, write_manifest)
 
 
 class StreamBinner:
@@ -49,9 +59,15 @@ class StreamBinner:
         n = plan.n_bins
         self._bufs: List[List[np.ndarray]] = [[] for _ in range(n)]
         self._buffered = np.zeros(n, np.int64)
-        self.counts = np.zeros(n, np.int64)      # records per bin (total)
-        self.spill_bytes = 0
-        write_manifest(self.run_dir, self.k, self.sig_k, n)
+        self.counts = np.zeros(n, np.int64)      # WINDOW records per bin
+        self.spill_bytes = 0                     # on-disk bytes appended
+        self.disk_records = 0                    # on-disk records appended
+        # serial writer lane: appends stay in submission order while the
+        # caller routes the next chunk (no-op shape when depth <= 1)
+        self._writer = (OrderedSubmitter(1, plan.pipeline_depth)
+                        if plan.pipelined else None)
+        write_manifest(self.run_dir, self.k, self.sig_k, n,
+                       fmt=plan.record_format)
 
     # ---- pass-1 streaming ----
 
@@ -90,12 +106,25 @@ class StreamBinner:
     def _flush(self, b: int) -> None:
         if not self._bufs[b]:
             return
-        data = np.ascontiguousarray(
-            np.concatenate(self._bufs[b]).astype("<i8", copy=False))
+        occ = np.concatenate(self._bufs[b]).astype(np.int64, copy=False)
+        self._bufs[b] = []
+        self._buffered[b] = 0
+        self.counts[b] += len(occ)      # window count, format-independent
+        data = (encode_rle(occ) if self.plan.record_format == 2
+                else occ).astype("<i8", copy=False)
+        payload = np.ascontiguousarray(data).tobytes()
         path = self.run_dir / bin_filename(b)
+        if self._writer is not None:
+            self._writer.submit(self._append, path, payload)
+        else:
+            self._append(path, payload)
+
+    def _append(self, path: Path, payload: bytes) -> None:
+        """The disk half of a flush — runs on the writer lane when the plan
+        is pipelined (lane order = submission order, so per-bin appends land
+        exactly as the synchronous path would write them)."""
         if fault_fire("stream_write", path.name) is not None:
             raise OSError(f"fault injection: stream bin write failed: {path}")
-        payload = data.tobytes()
         # torn-spill simulation: when the registered crash point is armed
         # for this hit, flush only a partial record before dying (the
         # crash_point call below). Recovery contract: the manifest was
@@ -107,23 +136,41 @@ class StreamBinner:
             f.write(payload[: max(1, len(payload) // 2)] if torn
                     else payload)
         crash_point("mid-spill-write", path.name)
-        self.counts[b] += len(data)
-        self.spill_bytes += data.nbytes
-        self._bufs[b] = []
-        self._buffered[b] = 0
+        self.spill_bytes += len(payload)
+        self.disk_records += len(payload) // RECORD_BYTES \
+            // (2 if self.plan.record_format == 2 else 1)
+        set_spill_gauge(self.spill_bytes)
+        count_spill_bytes(len(payload))
 
     # ---- finalisation ----
 
+    def abort(self) -> None:
+        """Best-effort drain of the writer lane on the failure path, so the
+        caller can remove the run dir without racing in-flight appends."""
+        if self._writer is not None:
+            try:
+                self._writer.drain()
+            except Exception:
+                pass
+
     def close(self) -> dict:
-        """Flush every buffer and seal the manifest with per-bin record
-        counts (pass 2 cross-checks them). Returns the spill summary."""
+        """Flush every buffer, drain the writer lane, and seal the manifest
+        with per-bin WINDOW counts (pass 2 cross-checks them against the
+        expanded records). Returns the spill summary."""
         for b in range(self.plan.n_bins):
             self._flush(b)
+        if self._writer is not None:
+            self._writer.drain()
         nonempty = int(np.count_nonzero(self.counts))
+        windows = int(self.counts.sum())
         write_manifest(self.run_dir, self.k, self.sig_k, self.plan.n_bins,
                        counts=self.counts.tolist(),
-                       spill_bytes=self.spill_bytes)
+                       spill_bytes=self.spill_bytes,
+                       fmt=self.plan.record_format)
         return {"bins": nonempty, "n_bins": self.plan.n_bins,
-                "records": int(self.counts.sum()),
+                "records": windows,
                 "spill_bytes": int(self.spill_bytes),
+                "disk_records": int(self.disk_records),
+                "format": int(self.plan.record_format),
+                "raw_bytes": windows * RECORD_BYTES,
                 "sig_k": int(self.sig_k)}

@@ -6,50 +6,68 @@ dtypes, same lexicographic global ranks, same stable within-group
 occurrence order — built without ever holding the whole window sort in
 host memory:
 
-1. pass 1 (:class:`.binner.StreamBinner`) spills occurrence records into
-   minimizer-signature bins under the run's ``.stream`` dir;
+1. pass 1 (:class:`.binner.StreamBinner`) spills RLE occurrence records
+   into minimizer-signature bins under the run's ``.stream`` dir, with
+   disk appends overlapping the next chunk's routing on the pipelined
+   writer lane;
 2. pass 2 (:mod:`.sorter`) sorts each bin with the existing grouping
-   kernels; the bin reader's corruption verdicts quarantine bad bins
+   kernels — bin b+1's disk read is prefetched while bin b sorts, and
+   with multiple workers the per-bin sorts fan across the shared pool
+   (each sort single-threaded: bin-level parallelism replaces intra-bin).
+   The bin reader's corruption verdicts quarantine bad bins
    (:class:`~autocycler_tpu.utils.resilience.SpillError`) instead of
    crashing — the caller degrades to the in-memory oracle;
 3. the merge (:mod:`.merge`) ranks bin representatives globally, and the
-   stitch scatters per-bin results into the final M-sized arrays.
+   stitch scatters every bin's groups into the final M-sized arrays in
+   one concatenated pass.
+
+Determinism: bins are read, sorted and stitched in bin-index order, the
+writer lane preserves per-bin append order, and the stitch scatter writes
+each global position exactly once — the output is bit-identical whatever
+the pipeline depth or worker count.
 
 Spill posture is observable: ``autocycler_stream_spill_bytes`` (gauge,
-live during pass 1, zeroed when the run dir is removed),
-``autocycler_stream_bins_total`` (counter of bins written), quarantined-bin
-and orphan-sweep counters, a spill line in ``autocycler top``, and bin
-lineage (count, bytes, signature width) in the run ledger.
+live at every append during pass 1, zeroed when the run dir is removed),
+``autocycler_stream_spill_bytes_total`` (cumulative appended bytes),
+``autocycler_stream_rle_ratio`` (raw int64 bytes over format-2 bytes),
+``autocycler_stream_bins_total`` (counter of bins written),
+quarantined-bin and orphan-sweep counters, a spill line in
+``autocycler top``, and bin lineage (count, bytes, record format,
+signature width) in the run ledger.
 """
 
 from __future__ import annotations
 
 import shutil
 import tempfile
+from collections import deque
 from pathlib import Path
 from typing import Tuple
 
 import numpy as np
 
 from ..obs import ledger, metrics_registry
+from ..utils.pool import get_executor, prefetch_iter
 from ..utils.resilience import SpillError
 from ..utils.timing import substage
 from .binner import StreamBinner
 from .merge import merge_ranks
 from .planner import StreamPlan, plan_stream
 from .sorter import sort_bin
-from .spill import (bin_filename, new_run_dir, read_bin_records,
+from .spill import (SPILL_BYTES_GAUGE, bin_filename, new_run_dir,
+                    read_bin_records, read_manifest, set_spill_gauge,
                     stream_root)
 
-SPILL_BYTES_GAUGE = "autocycler_stream_spill_bytes"
 BINS_TOTAL = "autocycler_stream_bins_total"
 QUARANTINED_BINS_TOTAL = "autocycler_stream_quarantined_bins_total"
+RLE_RATIO_GAUGE = "autocycler_stream_rle_ratio"
+
+# kept for callers importing the gauge setter from its pre-RLE home
+_set_spill_gauge = set_spill_gauge
 
 
-def _set_spill_gauge(value: int) -> None:
-    metrics_registry.gauge_set(
-        SPILL_BYTES_GAUGE, float(value),
-        help="bytes currently spilled to .stream k-mer bins")
+def _zeros0() -> np.ndarray:
+    return np.zeros(0, np.int64)
 
 
 def stream_group_windows_stats(codes: np.ndarray, seq_len: np.ndarray,
@@ -64,10 +82,13 @@ def stream_group_windows_stats(codes: np.ndarray, seq_len: np.ndarray,
     every strand. Raises :class:`SpillError` (or OSError from the spill
     layer) on corruption/exhaustion; callers catch and fall back to the
     in-memory path."""
+    from ..ops.kmers import _effective_workers, _resolve_threads
+
     S = len(seq_len)
     M = int(2 * seq_len.sum())
+    workers = _effective_workers(_resolve_threads(threads))
     if plan is None:
-        plan = plan_stream(M, k)
+        plan = plan_stream(M, k, workers=workers)
     root = stream_root()
     temp_root = None
     if root is None:
@@ -77,8 +98,10 @@ def stream_group_windows_stats(codes: np.ndarray, seq_len: np.ndarray,
         root = temp_root
     root.mkdir(parents=True, exist_ok=True)
     run_dir = new_run_dir(root)
+    binner = None
     try:
-        # ---- pass 1: signature binning with bounded buffers ----
+        # ---- pass 1: signature binning with bounded buffers; appends of
+        # chunk N overlap routing of chunk N+1 on the writer lane ----
         with substage("stream-bin"):
             binner = StreamBinner(run_dir, plan, k)
             for i in range(S):
@@ -87,9 +110,13 @@ def stream_group_windows_stats(codes: np.ndarray, seq_len: np.ndarray,
                 base = int(occ_off[i])
                 binner.add_run(codes[fo:fo + L + k - 1], base)
                 binner.add_run(codes[ro:ro + L + k - 1], base + L)
-                _set_spill_gauge(binner.spill_bytes)
             summary = binner.close()
-        _set_spill_gauge(summary["spill_bytes"])
+        set_spill_gauge(summary["spill_bytes"])
+        rle_ratio = (summary["raw_bytes"] / summary["spill_bytes"]
+                     if summary["spill_bytes"] else 0.0)
+        metrics_registry.gauge_set(
+            RLE_RATIO_GAUGE, rle_ratio,
+            help="raw int64 spill bytes over on-disk (RLE) spill bytes")
         metrics_registry.counter_inc(
             BINS_TOTAL, summary["bins"],
             help="stream spill bins written by pass 1")
@@ -97,67 +124,120 @@ def stream_group_windows_stats(codes: np.ndarray, seq_len: np.ndarray,
                             n_bins=summary["n_bins"],
                             records=summary["records"],
                             spill_bytes=summary["spill_bytes"],
+                            disk_records=summary["disk_records"],
+                            record_format=summary["format"],
+                            rle_ratio=round(rle_ratio, 2),
+                            pipeline_depth=plan.pipeline_depth,
+                            workers=workers,
                             sig_k=summary["sig_k"],
                             mem_budget_mb=plan.mem_budget_bytes >> 20)
 
-        # ---- pass 2: per-bin sort/count with the existing kernels ----
+        # ---- pass 2: per-bin sort/count with the existing kernels; bin
+        # reads prefetched ahead of the sorts, sorts fanned across the
+        # pool in bin order ----
+        fmt = int((read_manifest(run_dir) or {}).get("format", 1))
+        todo = [b for b in range(plan.n_bins) if int(binner.counts[b])]
+
+        def _read(b):
+            occ, reason = read_bin_records(run_dir / bin_filename(b),
+                                           expected=int(binner.counts[b]),
+                                           fmt=fmt)
+            if occ is None:
+                metrics_registry.counter_inc(
+                    QUARANTINED_BINS_TOTAL, 1,
+                    help="stream bins quarantined as corrupt in pass 2")
+                raise SpillError(f"bin {b} quarantined: {reason}")
+            return occ
+
+        def _sort(occ, sort_threads):
+            return sort_bin(codes, occ, seq_len, fwd_byte_off, rev_byte_off,
+                            occ_off, k, use_jax=use_jax,
+                            threads=sort_threads)
+
         groups = []
         with substage("stream-sort"):
-            for b in range(plan.n_bins):
-                expected = int(binner.counts[b])
-                if expected == 0:
-                    continue
-                occ, reason = read_bin_records(run_dir / bin_filename(b),
-                                               expected=expected)
-                if occ is None:
-                    metrics_registry.counter_inc(
-                        QUARANTINED_BINS_TOTAL, 1,
-                        help="stream bins quarantined as corrupt in pass 2")
-                    raise SpillError(f"bin {b} quarantined: {reason}")
-                groups.append(sort_bin(codes, occ, seq_len, fwd_byte_off,
-                                       rev_byte_off, occ_off, k,
-                                       use_jax=use_jax, threads=threads))
+            depth_ahead = plan.pipeline_depth if plan.pipelined else 1
+            reads = prefetch_iter(_read, todo, workers + depth_ahead,
+                                  depth=depth_ahead)
+            if workers > 1 and len(todo) > 1:
+                # fan single-threaded sorts across the pool; at most
+                # `workers` bins in flight so W working sets share the
+                # pass-2 budget the planner divided by W. Results are
+                # collected oldest-first — bin order, deterministic.
+                pending = deque()
+                for occ in reads:
+                    while len(pending) >= workers:
+                        groups.append(pending.popleft().result())
+                    pending.append(get_executor(workers + depth_ahead)
+                                   .submit(_sort, occ, 1))
+                while pending:
+                    groups.append(pending.popleft().result())
+            else:
+                for occ in reads:
+                    groups.append(_sort(occ, threads))
 
         # ---- merge: bin-local ranks -> global lexicographic ranks ----
         with substage("stream-merge"):
             rep_starts = np.concatenate([g.rep_start for g in groups]) \
-                if groups else np.zeros(0, np.int64)
-            grank = merge_ranks(codes, rep_starts, k, plan.merge_parts)
+                if groups else _zeros0()
+            grank = merge_ranks(codes, rep_starts, k, plan.merge_parts,
+                                workers=workers)
 
-        # ---- stitch: scatter per-bin groups into the M-sized outputs ----
+        # ---- stitch: concatenated scatters into the M-sized outputs,
+        # chunked over whole bins so the pos/occ transients stay a
+        # budget-bounded slice of M instead of all of it ----
         with substage("stream-stitch"):
             U = len(rep_starts)
             depth = np.empty(U, np.int64)
             first_occ = np.empty(U, np.int64)
-            off = 0
-            for g in groups:
-                u = len(g.depth)
-                gr = grank[off:off + u]
-                depth[gr] = g.depth
-                first_occ[gr] = g.first_occ
-                off += u
+            u0 = 0
+            for g in groups:            # U-scale pass: rank-scatter stats
+                r = grank[u0:u0 + len(g.depth)]
+                depth[r] = g.depth
+                first_occ[r] = g.first_occ
+                u0 += len(g.depth)
             group_start = np.zeros(U + 1, np.int64)
             np.cumsum(depth, out=group_start[1:])
             gid = np.empty(M, np.int64)
             order = np.empty(M, np.int64)
-            off = 0
-            for g in groups:
-                u = len(g.depth)
-                gr = grank[off:off + u]
-                occ_count = len(g.occ_sorted)
-                # element j of the bin's grouped occurrences sits at global
-                # position group_start[rank of its group] + its within-group
-                # offset (local position minus its group's local start)
-                local_start = np.zeros(u, np.int64)
-                np.cumsum(g.depth[:-1], out=local_start[1:])
-                pos = (np.repeat(group_start[gr] - local_start, g.depth)
-                       + np.arange(occ_count, dtype=np.int64))
-                order[pos] = g.occ_sorted
-                gid[g.occ_sorted] = np.repeat(gr, g.depth)
-                off += u
+            # transient cost per chunk is ~3 int64 arrays over its
+            # windows (occ, pos, repeat temp); cap so that stays a
+            # small fraction of the stream budget
+            cap = max(1 << 20, plan.mem_budget_bytes // (24 * 8))
+            i, u0 = 0, 0
+            while i < len(groups):
+                j, wins, nu = i, 0, 0
+                while j < len(groups) and (
+                        j == i or wins + len(groups[j].occ_sorted) <= cap):
+                    wins += len(groups[j].occ_sorted)
+                    nu += len(groups[j].depth)
+                    j += 1
+                occ_c = np.concatenate(
+                    [groups[t].occ_sorted for t in range(i, j)])
+                dep_c = np.concatenate(
+                    [groups[t].depth for t in range(i, j)])
+                for t in range(i, j):   # bins are consumed: free now
+                    groups[t] = None
+                r = grank[u0:u0 + nu]
+                concat_start = np.zeros(nu + 1, np.int64)
+                np.cumsum(dep_c, out=concat_start[1:])
+                # element w of the chunk's occurrences belongs to
+                # chunk-order group u = searchsorted(w); its global
+                # position is group_start[r[u]] + (w - concat_start[u]),
+                # realised as one repeat + one arange over the chunk
+                pos = (np.repeat(group_start[r] - concat_start[:-1], dep_c)
+                       + np.arange(wins, dtype=np.int64))
+                order[pos] = occ_c
+                del pos
+                gid[occ_c] = np.repeat(r, dep_c)
+                del occ_c
+                i, u0 = j, u0 + nu
+            groups.clear()
         return gid, order, depth, first_occ
     finally:
+        if binner is not None:
+            binner.abort()      # never leave lane appends racing the rmtree
         shutil.rmtree(run_dir, ignore_errors=True)
         if temp_root is not None:
             shutil.rmtree(temp_root, ignore_errors=True)
-        _set_spill_gauge(0)
+        set_spill_gauge(0)

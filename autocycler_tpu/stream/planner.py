@@ -19,7 +19,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-from ..utils.knobs import knob_int, knob_str
+from ..utils.knobs import knob_bool, knob_int, knob_str
 
 # pass-2 per-record working set of the host grouping kernels: the byte
 # starts (8) + order/gid outputs (16) + the packed lexsort keys
@@ -47,30 +47,44 @@ class StreamPlan:
     merge_parts: int       # radix chunks for the global rank merge
     mem_budget_bytes: int  # the budget the sizes were derived from
     est_windows: int       # window count the plan was sized for
+    record_format: int = 2     # spill record format: 2 = RLE runs, 1 = raw
+    pipeline_depth: int = 2    # outstanding appends / prefetched bin reads
 
     @property
     def buffer_bytes(self) -> int:
         """Worst-case bytes held across all bin write buffers."""
         return self.n_bins * self.flush_records * _RECORD_BYTES
 
+    @property
+    def pipelined(self) -> bool:
+        """Whether pass-1 appends and pass-2 reads overlap compute."""
+        return self.pipeline_depth > 1
+
 
 def _clamp(value: int, lo: int, hi: int) -> int:
     return max(lo, min(hi, int(value)))
 
 
-def plan_stream(total_windows: int, k: int) -> StreamPlan:
+def plan_stream(total_windows: int, k: int, workers: int = 1) -> StreamPlan:
     """Size bins/chunks/buffers for ``total_windows`` windows of length ``k``
-    under the ``AUTOCYCLER_STREAM_MEM_MB`` budget. Explicit
-    ``AUTOCYCLER_STREAM_BINS`` / ``AUTOCYCLER_STREAM_CHUNK`` values override
-    the derived sizes (tests force multi-bin/multi-chunk paths on tiny
-    inputs this way)."""
+    under the ``AUTOCYCLER_STREAM_MEM_MB`` budget. ``workers`` is the pass-2
+    sort fan-out: with W concurrent per-bin sorts the per-bin budget shrinks
+    W-fold (so W bins' working sets together still fit), which grows the bin
+    count to compensate. Explicit ``AUTOCYCLER_STREAM_BINS`` /
+    ``AUTOCYCLER_STREAM_CHUNK`` / ``AUTOCYCLER_STREAM_FLUSH`` values
+    override the derived sizes (tests force multi-bin/multi-chunk paths on
+    tiny inputs this way); ``AUTOCYCLER_STREAM_PIPELINE`` sets how many disk
+    appends / prefetched bin reads may be in flight (<=1 = synchronous) and
+    ``AUTOCYCLER_STREAM_RLE`` picks the spill record format."""
     total_windows = max(1, int(total_windows))
+    workers = max(1, int(workers))
     mem_mb = max(64, int(knob_int("AUTOCYCLER_STREAM_MEM_MB")))
     budget = mem_mb << 20
 
-    # pass 2 gets half the budget: records per bin so one bin sorts in-budget
+    # pass 2 gets half the budget, split across the concurrent bin sorts:
+    # records per bin so `workers` bins sort in-budget together
     sort_bytes = _sort_bytes_per_record(k)
-    target_bin_records = max(1, (budget // 2) // sort_bytes)
+    target_bin_records = max(1, (budget // 2) // (sort_bytes * workers))
     n_bins = _clamp(-(-total_windows // target_bin_records), 8, 1024)
     bins_override = int(knob_int("AUTOCYCLER_STREAM_BINS"))
     if bins_override > 0:
@@ -84,15 +98,21 @@ def plan_stream(total_windows: int, k: int) -> StreamPlan:
 
     # bounded write buffers get another eighth, split evenly across bins
     flush = _clamp((budget // 8) // (n_bins * _RECORD_BYTES), 256, 1 << 20)
+    flush_override = int(knob_int("AUTOCYCLER_STREAM_FLUSH"))
+    if flush_override > 0:
+        flush = _clamp(flush_override, 1, 1 << 22)
 
     # the merge ranks at most one rep per window; chunk it like pass 2
     merge_parts = _clamp(-(-total_windows * sort_bytes // (budget // 2)),
                          16, 4096)
 
     sig_k = _clamp(int(knob_int("AUTOCYCLER_STREAM_SIG_K")), 4, min(k, 27))
+    fmt = 2 if knob_bool("AUTOCYCLER_STREAM_RLE") else 1
+    depth = _clamp(int(knob_int("AUTOCYCLER_STREAM_PIPELINE")), 1, 64)
     return StreamPlan(n_bins=n_bins, chunk_windows=chunk, flush_records=flush,
                       sig_k=sig_k, merge_parts=merge_parts,
-                      mem_budget_bytes=budget, est_windows=total_windows)
+                      mem_budget_bytes=budget, est_windows=total_windows,
+                      record_format=fmt, pipeline_depth=depth)
 
 
 _MODE_OFF = ("off", "0", "no", "false")

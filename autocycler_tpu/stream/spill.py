@@ -4,9 +4,10 @@ Layout under ``<autocycler_dir>/.stream/``::
 
     .stream/
       run-<pid>-<token>/
-        manifest.json        {"version": 1, "pid": ..., "k": ..., "sig_k":
-                              ..., "n_bins": ..., "counts": [...], ...}
-        bin-0000.u64         little-endian int64 occurrence indices
+        manifest.json        {"version": 1, "format": 2, "pid": ...,
+                              "k": ..., "sig_k": ..., "n_bins": ...,
+                              "counts": [...], ...}
+        bin-0000.u64         spill records (format 1 or 2, see below)
         bin-0001.u64
         ...
 
@@ -15,11 +16,23 @@ A live run owns exactly one run dir and removes it when grouping finishes
 orphan sweep on the next compress startup removes every run dir whose
 recorded pid is no longer alive (and any dir without a readable manifest).
 
-Bin files are raw little-endian int64 records. The reader is never-raise:
-torn tails (size not a whole record multiple), count mismatches against the
-manifest, non-ascending records and unreadable files all come back as a
-``(None, reason)`` verdict for the caller to quarantine — a corrupt spill
-must degrade the run to the in-memory oracle, not crash it.
+Two record formats, versioned by the manifest's ``format`` field (absent =
+format 1, so pre-RLE run dirs stay readable):
+
+- **format 1**: one little-endian int64 occurrence index per window.
+- **format 2** (super-k-mer RLE, KMC 2 arXiv:1407.1507): consecutive
+  windows almost always share a minimizer, so a maximal run of consecutive
+  occurrence indices landing in the same bin is one ``(start_occ, run_len)``
+  pair of little-endian int64s. The manifest's per-bin ``counts`` stay
+  WINDOW counts in both formats — pass 2 cross-checks the expanded record
+  count, so torn or truncated runs are caught either way.
+
+The reader is never-raise: torn tails (size not a whole record multiple —
+for format 2 a mid-record tear lands inside a run), count mismatches
+against the manifest, non-positive run lengths, non-ascending records,
+unsupported formats and unreadable files all come back as a ``(None,
+reason)`` verdict for the caller to quarantine — a corrupt spill must
+degrade the run to the in-memory oracle, not crash it.
 """
 
 from __future__ import annotations
@@ -41,8 +54,25 @@ MANIFEST_NAME = "manifest.json"
 MANIFEST_VERSION = 1
 RECORD_DTYPE = "<i8"
 RECORD_BYTES = 8
+RLE_RECORD_BYTES = 16          # format 2: (start_occ, run_len) int64 pair
+SUPPORTED_FORMATS = (1, 2)
 
 ORPHANS_SWEPT_TOTAL = "autocycler_stream_orphans_swept_total"
+SPILL_BYTES_GAUGE = "autocycler_stream_spill_bytes"
+SPILL_BYTES_TOTAL = "autocycler_stream_spill_bytes_total"
+
+
+def set_spill_gauge(value: int) -> None:
+    metrics_registry.gauge_set(
+        SPILL_BYTES_GAUGE, float(value),
+        help="bytes currently spilled to .stream k-mer bins")
+
+
+def count_spill_bytes(n: int) -> None:
+    if n > 0:
+        metrics_registry.counter_inc(
+            SPILL_BYTES_TOTAL, int(n),
+            help="cumulative bytes appended to .stream k-mer bins")
 
 _root_lock = threading.Lock()
 _stream_root: Optional[Path] = None
@@ -73,8 +103,9 @@ def new_run_dir(root: Path) -> Path:
 
 def write_manifest(run_dir: Path, k: int, sig_k: int, n_bins: int,
                    counts: Optional[List[int]] = None,
-                   spill_bytes: int = 0) -> None:
-    payload = {"version": MANIFEST_VERSION, "pid": os.getpid(), "k": int(k),
+                   spill_bytes: int = 0, fmt: int = 1) -> None:
+    payload = {"version": MANIFEST_VERSION, "format": int(fmt),
+               "pid": os.getpid(), "k": int(k),
                "sig_k": int(sig_k), "n_bins": int(n_bins),
                "spill_bytes": int(spill_bytes),
                "counts": [int(c) for c in counts] if counts is not None
@@ -93,28 +124,85 @@ def read_manifest(run_dir) -> Optional[dict]:
     return data if isinstance(data, dict) else None
 
 
-def read_bin_records(path, expected: Optional[int] = None
+def encode_rle(occ: np.ndarray) -> np.ndarray:
+    """Format-2 encoder: a strictly-ascending occurrence array becomes
+    interleaved ``(start_occ, run_len)`` int64 pairs, one pair per maximal
+    run of consecutive indices. Pure array passes, no Python loop."""
+    occ = np.asarray(occ, dtype=np.int64)
+    if len(occ) == 0:
+        return np.zeros(0, np.int64)
+    breaks = np.flatnonzero(np.diff(occ) != 1) + 1
+    bounds = np.concatenate(([0], breaks, [len(occ)]))
+    out = np.empty(2 * (len(bounds) - 1), np.int64)
+    out[0::2] = occ[bounds[:-1]]
+    out[1::2] = np.diff(bounds)
+    return out
+
+
+def decode_rle(pairs: np.ndarray
+               ) -> Tuple[Optional[np.ndarray], Optional[str]]:
+    """Expand interleaved ``(start_occ, run_len)`` pairs back to the
+    occurrence array, validating the format-2 invariants: every run length
+    positive, starts non-negative, and runs non-overlapping in ascending
+    order (``next_start >= prev_start + prev_len``). Adjacent-but-mergeable
+    runs are legal — flush and chunk boundaries split maximal runs."""
+    pairs = np.asarray(pairs, dtype=np.int64)
+    starts = pairs[0::2]
+    lens = pairs[1::2]
+    if len(starts) == 0:
+        return np.zeros(0, np.int64), None
+    if np.any(lens < 1):
+        return None, "RLE record has a non-positive run length"
+    if starts[0] < 0:
+        return None, "RLE record has a negative start occurrence"
+    if np.any(starts[1:] < starts[:-1] + lens[:-1]):
+        return None, "RLE runs overlap or are not ascending"
+    total = int(lens.sum())
+    ends = np.cumsum(lens)
+    # occ[j] = start of its run + offset within the run
+    occ = np.repeat(starts - (ends - lens), lens) \
+        + np.arange(total, dtype=np.int64)
+    return occ, None
+
+
+def read_bin_records(path, expected: Optional[int] = None, fmt: int = 1
                      ) -> Tuple[Optional[np.ndarray], Optional[str]]:
     """Load one bin file's occurrence records: ``(records, None)`` on
     success, ``(None, reason)`` on any corruption — never raises.
 
-    Validity means: readable, a whole number of records, the manifest's
-    record count when given, and strictly ascending occurrence indices
-    (pass 1 appends each occurrence exactly once in ascending order, so
-    anything else is a torn or mangled file)."""
+    ``fmt`` is the record format the sealed manifest declared (1 = one
+    int64 per window, 2 = RLE pairs, expanded here). Validity means: a
+    supported format, readable, a whole number of records, the manifest's
+    WINDOW count when given (format 2 checks the expanded length, so a
+    truncated run shows up as a count mismatch), and strictly ascending
+    occurrence indices (pass 1 appends each occurrence exactly once in
+    ascending order, so anything else is a torn or mangled file)."""
     if fault_fire("stream_read", os.path.basename(str(path))) is not None:
         return None, "fault injection: forced corrupt bin read"
+    if fault_fire("stream_format", os.path.basename(str(path))) is not None:
+        fmt = -1        # simulate a manifest sealed by a newer writer
+    if int(fmt) not in SUPPORTED_FORMATS:
+        return None, (f"unsupported spill record format {int(fmt)} (this "
+                      f"reader supports {SUPPORTED_FORMATS})")
+    record_bytes = RLE_RECORD_BYTES if int(fmt) == 2 else RECORD_BYTES
     try:
         data = Path(path).read_bytes()
     except OSError as e:
         return None, f"unreadable bin file: {e}"
-    if len(data) % RECORD_BYTES:
+    if len(data) % record_bytes:
         return None, (f"torn bin file: {len(data)} bytes is not a whole "
-                      f"multiple of the {RECORD_BYTES}-byte record")
-    occ = np.frombuffer(data, dtype=RECORD_DTYPE).astype(np.int64)
+                      f"multiple of the {record_bytes}-byte format-"
+                      f"{int(fmt)} record")
+    raw = np.frombuffer(data, dtype=RECORD_DTYPE).astype(np.int64)
+    if int(fmt) == 2:
+        occ, reason = decode_rle(raw)
+        if occ is None:
+            return None, reason
+    else:
+        occ = raw
     if expected is not None and len(occ) != int(expected):
-        return None, (f"bin holds {len(occ)} records but the manifest "
-                      f"recorded {int(expected)}")
+        return None, (f"bin holds {len(occ)} window records but the "
+                      f"manifest recorded {int(expected)}")
     if len(occ) and (occ[0] < 0 or np.any(np.diff(occ) <= 0)):
         return None, "bin records are not strictly ascending"
     return occ, None

@@ -298,6 +298,24 @@ KNOBS: Dict[str, Knob] = dict(
             11,
             "Minimizer-signature m-mer length for bin assignment (clamped to k and 27).",
         ),
+        _k(
+            "AUTOCYCLER_STREAM_RLE",
+            "bool",
+            True,
+            "Super-k-mer run-length-encoded spill records (format 2); off writes one record per window (format 1) for A/B comparison.",
+        ),
+        _k(
+            "AUTOCYCLER_STREAM_PIPELINE",
+            "int",
+            2,
+            "Streamed-grouping pipeline depth: outstanding pass-1 disk appends and prefetched pass-2 bin reads; <=1 runs the passes synchronously.",
+        ),
+        _k(
+            "AUTOCYCLER_STREAM_FLUSH",
+            "int",
+            0,
+            "Override the planned per-bin records buffered before a spill append; <=0 lets the planner size buffers from the memory budget.",
+        ),
         # --- caches --------------------------------------------------------
         _k(
             "AUTOCYCLER_COMPILE_CACHE",

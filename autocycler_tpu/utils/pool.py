@@ -17,7 +17,8 @@ always reassemble outputs in input order, and the parallel reductions
 from __future__ import annotations
 
 import threading
-from typing import Callable, Iterable, List, Optional, Sequence
+from collections import deque
+from typing import Callable, Iterable, Iterator, List, Optional, Sequence
 
 import numpy as np
 
@@ -55,6 +56,73 @@ def get_executor(workers: int):
             if old is not None:
                 old.shutdown(wait=False)
         return _executor
+
+
+class OrderedSubmitter:
+    """A depth-bounded serial lane on the shared executor: jobs run strictly
+    in submission order (each submitted thunk waits on its predecessor's
+    future first), with at most ``depth`` futures outstanding — ``submit``
+    blocks on the oldest when the lane is full. This is the Gerbil-style
+    writer lane: pass-1 compute for chunk N+1 overlaps the ordered disk
+    append of chunk N while per-file append order stays exactly the
+    synchronous order. ``drain`` re-raises the first job exception."""
+
+    def __init__(self, workers: int, depth: int = 2):
+        self._workers = max(1, int(workers))
+        self._depth = max(1, int(depth))
+        self._pending: deque = deque()
+        self._prev = None
+
+    def submit(self, fn: Callable, *args) -> None:
+        prev = self._prev
+
+        def job():
+            if prev is not None:
+                prev.result()       # enforce order; propagate prior failure
+            return fn(*args)
+
+        while len(self._pending) >= self._depth:
+            self._pending.popleft().result()
+        _count_tasks(1, "ordered")
+        # fetch the executor per submit: growth replaces the instance, and a
+        # cached reference would raise "cannot schedule new futures"
+        fut = get_executor(self._workers).submit(job)
+        self._prev = fut
+        self._pending.append(fut)
+
+    def drain(self) -> None:
+        """Wait for every submitted job; raises the first job exception."""
+        try:
+            while self._pending:
+                self._pending.popleft().result()
+        finally:
+            self._pending.clear()
+            self._prev = None
+
+
+def prefetch_iter(fn: Callable, items: Sequence, workers: int,
+                  depth: int = 2) -> Iterator:
+    """Yield ``fn(item)`` for each item in order, keeping up to ``depth``
+    calls in flight ahead of the consumer on the shared executor — the
+    pass-2 read-ahead shape (bin b+1's disk read overlaps bin b's sort).
+    ``depth <= 1`` degrades to a plain serial generator."""
+    items = list(items)
+    if depth <= 1 or len(items) <= 1:
+        for x in items:
+            yield fn(x)
+        return
+    _count_tasks(len(items), "prefetch")
+    pending: deque = deque()
+    i = 0
+    try:
+        while pending or i < len(items):
+            while i < len(items) and len(pending) < depth:
+                pending.append(get_executor(workers).submit(fn, items[i]))
+                i += 1
+            yield pending.popleft().result()
+    finally:
+        for fut in pending:
+            fut.cancel()
 
 
 def pool_map(fn: Callable, items: Iterable, workers: int) -> List:

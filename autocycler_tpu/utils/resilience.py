@@ -179,7 +179,8 @@ def collect_errors() -> ErrorCollector:
 CRASH_POINTS = ("post-stage", "mid-spill-write", "mid-cache-store",
                 "pre-artifact-rename")
 FAULT_SITES = ("subprocess", "fasta", "gfa", "native_load", "native_abi",
-               "native_build", "stream_write", "stream_read") + CRASH_POINTS
+               "native_build", "stream_write", "stream_read",
+               "stream_format") + CRASH_POINTS
 
 # the distinctive status a crash-injected process dies with, so drivers
 # can tell an injected crash from a genuine failure

@@ -29,7 +29,8 @@ K = 15
 STREAM_KNOBS = ("AUTOCYCLER_STREAM_KMERS", "AUTOCYCLER_STREAM_MEM_MB",
                 "AUTOCYCLER_STREAM_AUTO_WINDOWS", "AUTOCYCLER_STREAM_BINS",
                 "AUTOCYCLER_STREAM_CHUNK", "AUTOCYCLER_STREAM_SIG_K",
-                "AUTOCYCLER_FAULTS")
+                "AUTOCYCLER_STREAM_RLE", "AUTOCYCLER_STREAM_PIPELINE",
+                "AUTOCYCLER_STREAM_FLUSH", "AUTOCYCLER_FAULTS")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,359 @@
+"""Pipelined stream grouping: super-k-mer RLE spill roundtrips and
+verdicts, v1 backward-read, overlap-mode parity with the oracle, the
+ordered writer lane / prefetch primitives, the flush-cadence spill gauge
+and the schema-tolerant streamsmoke trend row."""
+
+import json
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from test_stream import (_adversarial_seqs, _layout, _objects, _random_seqs,
+                         K)
+
+from autocycler_tpu.models.sequence import Sequence  # noqa: F401 (fixtures)
+from autocycler_tpu.ops.kmers import build_kmer_index, group_windows_stats
+from autocycler_tpu.stream import (StreamBinner, decode_rle, encode_rle,
+                                   plan_stream, read_bin_records,
+                                   set_stream_root,
+                                   stream_group_windows_stats)
+from autocycler_tpu.stream.spill import (RECORD_BYTES, RLE_RECORD_BYTES,
+                                         bin_filename, read_manifest)
+from autocycler_tpu.utils import resilience as rz
+from autocycler_tpu.utils.pool import OrderedSubmitter, prefetch_iter
+
+pytestmark = pytest.mark.stream
+
+
+@pytest.fixture(autouse=True)
+def _clean_stream_state(monkeypatch):
+    # reuse test_stream's knob list so new knobs stay covered in one place
+    from test_stream import STREAM_KNOBS
+    for name in STREAM_KNOBS:
+        monkeypatch.delenv(name, raising=False)
+    set_stream_root(None)
+    rz.set_fault_plan(None)
+    rz._reset_degrades_for_tests()
+    yield
+    set_stream_root(None)
+    rz.set_fault_plan(None)
+    rz._reset_degrades_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# RLE codec
+# ---------------------------------------------------------------------------
+
+def _roundtrip(occ):
+    occ = np.asarray(occ, dtype=np.int64)
+    pairs = encode_rle(occ)
+    assert len(pairs) % 2 == 0
+    back, reason = decode_rle(pairs)
+    assert reason is None
+    assert np.array_equal(back, occ)
+    return pairs
+
+
+def test_rle_roundtrip_fuzz():
+    rng = np.random.default_rng(42)
+    for _ in range(50):
+        n = int(rng.integers(0, 2000))
+        # random mix of consecutive runs and gaps: cumulative sum of steps
+        # drawn from {1 (continue run), 2..50 (break run)}
+        steps = rng.choice([1, 1, 1, 2, 7, 50], size=n)
+        occ = np.cumsum(steps).astype(np.int64)
+        _roundtrip(occ)
+
+
+def test_rle_adversarial_shapes():
+    # every window its own run: encoding is 2x the raw size (worst case)
+    singles = np.arange(0, 1000, 2, dtype=np.int64)
+    pairs = _roundtrip(singles)
+    assert len(pairs) == 2 * len(singles)
+    assert np.all(pairs[1::2] == 1)
+    # one maximal run: encoding collapses to a single pair
+    consecutive = np.arange(17, 17 + 5000, dtype=np.int64)
+    pairs = _roundtrip(consecutive)
+    assert np.array_equal(pairs, [17, 5000])
+    # empty
+    assert len(_roundtrip(np.zeros(0, np.int64))) == 0
+    # adjacent-but-mergeable runs are legal input to the decoder (flush
+    # boundaries split maximal runs): [5,3] then [8,2] expands cleanly
+    back, reason = decode_rle(np.array([5, 3, 8, 2], np.int64))
+    assert reason is None and np.array_equal(back, [5, 6, 7, 8, 9])
+
+
+def test_rle_decode_verdicts():
+    bad_len, reason = decode_rle(np.array([0, 5, 10, 0], np.int64))
+    assert bad_len is None and "run length" in reason
+    neg, reason = decode_rle(np.array([-3, 2], np.int64))
+    assert neg is None and "negative start" in reason
+    overlap, reason = decode_rle(np.array([0, 5, 3, 2], np.int64))
+    assert overlap is None and "overlap" in reason
+
+
+# ---------------------------------------------------------------------------
+# the never-raise reader on format-2 files
+# ---------------------------------------------------------------------------
+
+def test_read_bin_records_v2(tmp_path):
+    occ = np.concatenate([np.arange(10, 40), np.arange(100, 103),
+                          np.array([500])]).astype(np.int64)
+    pairs = encode_rle(occ)
+    good = tmp_path / "good.u64"
+    good.write_bytes(pairs.astype("<i8").tobytes())
+    got, reason = read_bin_records(good, expected=len(occ), fmt=2)
+    assert reason is None and np.array_equal(got, occ)
+
+    # mid-record tear: cut inside a (start, len) pair
+    torn = tmp_path / "torn.u64"
+    torn.write_bytes(pairs.astype("<i8").tobytes()[:-RECORD_BYTES])
+    got, reason = read_bin_records(torn, fmt=2)
+    assert got is None and "torn" in reason and str(RLE_RECORD_BYTES) in reason
+
+    # whole-pair truncation shows up as a window-count mismatch
+    short = tmp_path / "short.u64"
+    short.write_bytes(pairs.astype("<i8").tobytes()[:-RLE_RECORD_BYTES])
+    got, reason = read_bin_records(short, expected=len(occ), fmt=2)
+    assert got is None and "manifest" in reason
+
+    # a bad run inside an otherwise aligned file
+    bad = tmp_path / "bad.u64"
+    bad.write_bytes(np.array([0, 5, 3, 2], "<i8").tobytes())
+    got, reason = read_bin_records(bad, fmt=2)
+    assert got is None and "overlap" in reason
+
+    # unsupported format verdict (a manifest sealed by a newer writer)
+    got, reason = read_bin_records(good, fmt=7)
+    assert got is None and "unsupported" in reason
+
+
+@pytest.mark.faultinject
+def test_stream_format_fault_quarantines_and_degrades(monkeypatch, tmp_path):
+    set_stream_root(tmp_path / ".stream")
+    seqs = _random_seqs(seed=8)
+    monkeypatch.setenv("AUTOCYCLER_STREAM_KMERS", "off")
+    idx_mem = build_kmer_index(_objects(seqs), K, use_jax=False,
+                               use_fused=False)
+    monkeypatch.setenv("AUTOCYCLER_STREAM_KMERS", "on")
+    monkeypatch.setenv("AUTOCYCLER_STREAM_BINS", "5")
+    monkeypatch.setenv("AUTOCYCLER_FAULTS", "stream_format::fail:1")
+    idx_st = build_kmer_index(_objects(seqs), K, use_jax=False,
+                              use_fused=False)
+    events = rz.degrade_events("stream-kmers")
+    assert events and events[0]["to"] == "in-memory"
+    assert "SpillError" in events[0]["reason"]
+    assert "format" in events[0]["reason"]
+    assert np.array_equal(idx_mem.occ_kid, idx_st.occ_kid)
+    assert not list((tmp_path / ".stream").glob("run-*"))
+
+
+# ---------------------------------------------------------------------------
+# v1 backward-read and format selection
+# ---------------------------------------------------------------------------
+
+def test_rle_off_writes_format1(monkeypatch, tmp_path):
+    monkeypatch.setenv("AUTOCYCLER_STREAM_RLE", "0")
+    assert plan_stream(1000, K).record_format == 1
+    monkeypatch.delenv("AUTOCYCLER_STREAM_RLE")
+    assert plan_stream(1000, K).record_format == 2
+
+
+def test_v1_manifest_backward_read(tmp_path):
+    # a pre-RLE run dir: raw int64 records and a manifest with NO format
+    # key — the reader must default to format 1 and expand nothing
+    run = tmp_path / "run-1-aaaa"
+    run.mkdir()
+    occ = np.array([0, 1, 2, 9, 10, 40], np.int64)
+    (run / bin_filename(0)).write_bytes(occ.astype("<i8").tobytes())
+    (run / "manifest.json").write_text(json.dumps(
+        {"version": 1, "pid": 1, "k": K, "sig_k": 7, "n_bins": 1,
+         "counts": [len(occ)], "spill_bytes": occ.nbytes}))
+    manifest = read_manifest(run)
+    fmt = int(manifest.get("format", 1))
+    assert fmt == 1
+    got, reason = read_bin_records(run / bin_filename(0),
+                                   expected=len(occ), fmt=fmt)
+    assert reason is None and np.array_equal(got, occ)
+
+
+def test_stats_parity_v1_format(monkeypatch, tmp_path):
+    # the A/B escape hatch: format-1 synchronous spill, bit-identical too
+    set_stream_root(tmp_path / ".stream")
+    codes, seq_len, fwd_off, rev_off, occ_off, starts = _layout(
+        _random_seqs(seed=13))
+    oracle = group_windows_stats(codes, starts, K, False, 1)
+    monkeypatch.setenv("AUTOCYCLER_STREAM_RLE", "0")
+    monkeypatch.setenv("AUTOCYCLER_STREAM_PIPELINE", "1")
+    monkeypatch.setenv("AUTOCYCLER_STREAM_BINS", "7")
+    monkeypatch.setenv("AUTOCYCLER_STREAM_CHUNK", "101")
+    streamed = stream_group_windows_stats(codes, seq_len, fwd_off, rev_off,
+                                          occ_off, K, use_jax=False,
+                                          threads=1)
+    for name, a, b in zip(("gid", "order", "depth", "first_occ"),
+                          oracle, streamed):
+        assert np.array_equal(a, b), name
+
+
+# ---------------------------------------------------------------------------
+# overlap-mode parity: deep pipeline, pooled sorts, tiny bins/chunks/flush
+# ---------------------------------------------------------------------------
+
+def _assert_overlap_parity(seqs, monkeypatch, threads):
+    codes, seq_len, fwd_off, rev_off, occ_off, starts = _layout(seqs)
+    oracle = group_windows_stats(codes, starts, K, False, 1)
+    monkeypatch.setenv("AUTOCYCLER_STREAM_BINS", "13")
+    monkeypatch.setenv("AUTOCYCLER_STREAM_CHUNK", "97")
+    monkeypatch.setenv("AUTOCYCLER_STREAM_FLUSH", "17")
+    monkeypatch.setenv("AUTOCYCLER_STREAM_PIPELINE", "3")
+    # pooled sorts need the executor clamp lifted on single-core CI
+    monkeypatch.setenv("AUTOCYCLER_GROUPING_EXECUTOR", "pool")
+    streamed = stream_group_windows_stats(codes, seq_len, fwd_off, rev_off,
+                                          occ_off, K, use_jax=False,
+                                          threads=threads)
+    for name, a, b in zip(("gid", "order", "depth", "first_occ"),
+                          oracle, streamed):
+        assert np.array_equal(a, b), name
+        assert a.dtype == b.dtype == np.int64, name
+
+
+def test_overlap_parity_random(monkeypatch, tmp_path):
+    set_stream_root(tmp_path / ".stream")
+    _assert_overlap_parity(_random_seqs(seed=21), monkeypatch, threads=3)
+
+
+def test_overlap_parity_adversarial(monkeypatch, tmp_path):
+    set_stream_root(tmp_path / ".stream")
+    _assert_overlap_parity(_adversarial_seqs(), monkeypatch, threads=3)
+
+
+def test_overlap_parity_single_thread(monkeypatch, tmp_path):
+    # depth > 1 with one worker: write lane + read prefetch still engage
+    set_stream_root(tmp_path / ".stream")
+    _assert_overlap_parity(_random_seqs(seed=22, lengths=(300, 211, 75)),
+                           monkeypatch, threads=1)
+
+
+# ---------------------------------------------------------------------------
+# pool primitives
+# ---------------------------------------------------------------------------
+
+def test_ordered_submitter_preserves_order_and_bounds_depth():
+    lane = OrderedSubmitter(1, depth=2)
+    got = []
+    lock = threading.Lock()
+
+    def job(i):
+        time.sleep(0.002 if i % 3 == 0 else 0)   # jitter the fast ones
+        with lock:
+            got.append(i)
+
+    for i in range(40):
+        lane.submit(job, i)
+        assert len(lane._pending) <= 2
+    lane.drain()
+    assert got == list(range(40))
+
+
+def test_ordered_submitter_propagates_first_error():
+    lane = OrderedSubmitter(1, depth=4)
+
+    def boom():
+        raise OSError("disk gone")
+
+    lane.submit(boom)
+    lane.submit(lambda: None)       # chained: sees predecessor's failure
+    with pytest.raises(OSError, match="disk gone"):
+        lane.drain()
+    # a drained lane is reusable
+    lane.submit(lambda: None)
+    lane.drain()
+
+
+def test_prefetch_iter_orders_and_degrades_serial():
+    items = list(range(25))
+    assert list(prefetch_iter(lambda x: x * x, items, 3, depth=3)) == \
+        [x * x for x in items]
+    # depth<=1 is the plain serial path
+    assert list(prefetch_iter(lambda x: x + 1, items, 3, depth=1)) == \
+        [x + 1 for x in items]
+
+    def maybe_boom(x):
+        if x == 7:
+            raise ValueError("seven")
+        return x
+
+    with pytest.raises(ValueError, match="seven"):
+        list(prefetch_iter(maybe_boom, items, 3, depth=4))
+
+
+# ---------------------------------------------------------------------------
+# spill gauge cadence + trend row tolerance
+# ---------------------------------------------------------------------------
+
+def test_spill_gauge_updates_per_flush(monkeypatch, tmp_path):
+    from autocycler_tpu.obs import metrics_registry
+    from autocycler_tpu.stream import SPILL_BYTES_GAUGE, SPILL_BYTES_TOTAL
+
+    def gauge():
+        vals = metrics_registry.snapshot().get(
+            SPILL_BYTES_GAUGE, {}).get("values", [])
+        return vals[0]["value"] if vals else 0.0
+
+    monkeypatch.setenv("AUTOCYCLER_STREAM_BINS", "2")
+    monkeypatch.setenv("AUTOCYCLER_STREAM_FLUSH", "8")
+    monkeypatch.setenv("AUTOCYCLER_STREAM_PIPELINE", "1")  # synchronous
+    plan = plan_stream(10_000, K)
+    run = tmp_path / "run-1-bbbb"
+    run.mkdir()
+    binner = StreamBinner(run, plan, K)
+    rng = np.random.default_rng(3)
+    codes = rng.integers(1, 5, size=600).astype(np.uint8)
+    seen = []
+    # one long strand in several add_run chunks: the gauge must move DURING
+    # pass 1 (per flush), not only at close
+    for lo in range(0, 500, 100):
+        binner.add_run(codes[lo:lo + 100 + K - 1], lo)
+        seen.append(gauge())
+    summary = binner.close()
+    assert summary["spill_bytes"] > 0
+    assert any(v > 0 for v in seen[:-1]), \
+        "gauge never moved before the final flush"
+    assert gauge() == summary["spill_bytes"]
+    # cumulative counter matches the gauge at close (single run)
+    vals = metrics_registry.snapshot().get(
+        SPILL_BYTES_TOTAL, {}).get("values", [])
+    assert vals and vals[0]["value"] >= summary["spill_bytes"]
+    # RLE actually compressed: consecutive occurrence indices dominate
+    assert summary["spill_bytes"] < summary["raw_bytes"]
+    assert summary["format"] == 2
+    assert summary["disk_records"] * RLE_RECORD_BYTES == \
+        summary["spill_bytes"]
+
+
+def test_streamsmoke_row_tolerates_old_and_new_schema(tmp_path):
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    import bench
+
+    # pre-RLE artifact: new fields absent -> None, no raise
+    (tmp_path / "STREAMSMOKE.json").write_text(json.dumps(
+        {"bench": "streamsmoke", "passed": True, "identical_gfa": True,
+         "budget_mb": 768, "stream_delta_mb": 100.0,
+         "inmem_delta_mb": 900.0, "rss_reduction": 9.0}))
+    row = bench.streamsmoke_row(tmp_path)
+    assert row["present"] and row["passed"]
+    assert row["rle_ratio"] is None
+    assert row["wall_speedup_vs_v1"] is None
+
+    # new artifact: the new fields surface
+    (tmp_path / "STREAMSMOKE.json").write_text(json.dumps(
+        {"passed": True, "rle_ratio": 8.2, "wall_speedup_vs_v1": 1.4,
+         "stream_wall_s": 30.5}))
+    row = bench.streamsmoke_row(tmp_path)
+    assert row["rle_ratio"] == 8.2
+    assert row["wall_speedup_vs_v1"] == 1.4
+    assert row["stream_wall_s"] == 30.5
