@@ -202,6 +202,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--queue-size", dest="queue_size", type=int, default=16,
                    help="bounded work queue capacity; submissions past it "
                         "get HTTP 503 (default 16)")
+    p.add_argument("--workers", type=int, default=None,
+                   help="worker threads executing jobs concurrently "
+                        "(default AUTOCYCLER_SERVE_WORKERS or "
+                        "min(4, cpu//2); 1 reproduces the single-worker "
+                        "daemon bit for bit)")
 
     p = sub.add_parser("submit",
                        help="submit one isolate job to a running "
@@ -353,6 +358,7 @@ def dispatch(args) -> int:
     elif args.command == "serve":
         from .serve.server import serve
         return serve(args.serve_dir, host=args.host, port=args.port,
+                     workers=args.workers,
                      socket_path=args.socket_path,
                      queue_size=args.queue_size)
     elif args.command == "submit":

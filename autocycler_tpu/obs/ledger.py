@@ -37,7 +37,9 @@ LEDGER_JSON = "ledger.json"
 SCHEMA = 1
 
 _lock = threading.Lock()
-_inputs: Dict[str, dict] = {}
+# input table keyed (isolate scope, path): concurrent serve jobs hashing
+# the same input files never clobber each other's lineage rows
+_inputs: Dict[tuple, dict] = {}
 _stages: List[dict] = []
 
 
@@ -45,6 +47,26 @@ def reset() -> None:
     with _lock:
         _inputs.clear()
         _stages.clear()
+
+
+def _in_scope(iso, scope_name: str) -> bool:
+    return iso == scope_name or (isinstance(iso, str)
+                                 and iso.startswith(scope_name + "/"))
+
+
+def drain_scope(scope_name: str) -> int:
+    """Drop every input/stage entry tagged with ``scope_name`` (the serve
+    daemon drains each job after its ledger is written, keeping the
+    process-wide tables bounded). Returns the count removed."""
+    with _lock:
+        doomed = [k for k in _inputs if _in_scope(k[0], scope_name)]
+        for k in doomed:
+            del _inputs[k]
+        keep = [s for s in _stages if not _in_scope(s.get("isolate"),
+                                                    scope_name)]
+        removed = len(doomed) + (len(_stages) - len(keep))
+        _stages[:] = keep
+    return removed
 
 
 def artifact_hash(path) -> Optional[dict]:
@@ -83,7 +105,7 @@ def record_inputs(paths) -> None:
         if iso:
             digest = dict(digest, isolate=iso)
         with _lock:
-            _inputs[key] = digest
+            _inputs[(iso, key)] = digest
 
 
 def record_stage(stage: str, inputs=(), outputs=(),
@@ -121,11 +143,18 @@ def record_stage(stage: str, inputs=(), outputs=(),
 
 def _env_knobs() -> dict:
     """The effective environment this run saw: the platform pin plus every
-    AUTOCYCLER knob (same filter as the sentinel's environment snapshot)."""
-    return {k: os.environ[k] for k in sorted(os.environ)
-            if k == "JAX_PLATFORMS" or k.startswith("AUTOCYCLER_")
-            or k in ("XLA_FLAGS", "LIBTPU_INIT_ARGS", "TPU_NAME",
-                     "PJRT_DEVICE", "TPU_LIBRARY_PATH")}
+    AUTOCYCLER knob (same filter as the sentinel's environment snapshot).
+    Secret-bearing knobs (``*TOKEN*``, ``*SECRET*``) are redacted — a
+    ledger is an artifact clients download, never a credential store."""
+    out = {}
+    for k in sorted(os.environ):
+        if not (k == "JAX_PLATFORMS" or k.startswith("AUTOCYCLER_")
+                or k in ("XLA_FLAGS", "LIBTPU_INIT_ARGS", "TPU_NAME",
+                         "PJRT_DEVICE", "TPU_LIBRARY_PATH")):
+            continue
+        out[k] = "<redacted>" if ("TOKEN" in k or "SECRET" in k) \
+            else os.environ[k]
+    return out
 
 
 def _versions() -> dict:
@@ -186,10 +215,16 @@ def _cache_lineage() -> dict:
     return lineage
 
 
-def build_ledger(command: Optional[str] = None) -> dict:
+def build_ledger(command: Optional[str] = None,
+                 scope: Optional[str] = None) -> dict:
+    """The full ledger payload. With ``scope``, only inputs and stage
+    entries tagged with that isolate scope are included — each concurrent
+    serve job's ledger carries exactly its own lineage."""
     with _lock:
-        inputs = dict(_inputs)
-        stages = [dict(s) for s in _stages]
+        inputs = {key[1]: dict(digest) for key, digest in _inputs.items()
+                  if scope is None or _in_scope(key[0], scope)}
+        stages = [dict(s) for s in _stages
+                  if scope is None or _in_scope(s.get("isolate"), scope)]
     ledger = {
         "schema": SCHEMA,
         "created_epoch": round(time.time(), 3),
@@ -204,14 +239,15 @@ def build_ledger(command: Optional[str] = None) -> dict:
     return ledger
 
 
-def write_ledger(run_dir, command: Optional[str] = None) -> Optional[Path]:
+def write_ledger(run_dir, command: Optional[str] = None,
+                 scope: Optional[str] = None) -> Optional[Path]:
     """Write ``ledger.json`` atomically (tempfile + rename — a reader or a
     crash never sees a torn ledger). Returns the path, or None when there
-    is nothing to record or the write failed."""
-    with _lock:
-        if not _inputs and not _stages:
-            return None
-    payload = build_ledger(command)
+    is nothing to record or the write failed. ``scope`` filters to one
+    isolate scope's entries (see :func:`build_ledger`)."""
+    payload = build_ledger(command, scope=scope)
+    if not payload["inputs"] and not payload["stages"]:
+        return None
     path = Path(run_dir) / LEDGER_JSON
     try:
         path.parent.mkdir(parents=True, exist_ok=True)

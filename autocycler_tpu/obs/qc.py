@@ -76,6 +76,23 @@ def reset() -> None:
         _entries.clear()
 
 
+def _in_scope(entry: dict, scope_name: str) -> bool:
+    iso = entry.get("isolate")
+    return iso == scope_name or (isinstance(iso, str)
+                                 and iso.startswith(scope_name + "/"))
+
+
+def drain_scope(scope_name: str) -> int:
+    """Drop every journal entry tagged with ``scope_name`` (a serve daemon
+    drains each job's entries after writing its report, so the process-wide
+    journal stays bounded over thousands of jobs). Returns the count."""
+    with _lock:
+        keep = [e for e in _entries if not _in_scope(e, scope_name)]
+        dropped = len(_entries) - len(keep)
+        _entries[:] = keep
+    return dropped
+
+
 def record(stage: str, cluster: Optional[str] = None, **metrics) -> dict:
     """Journal one stage's QC metrics; returns the journal entry.
 
@@ -129,15 +146,18 @@ def entries() -> List[dict]:
         return [dict(e) for e in _entries]
 
 
-def summary() -> dict:
+def summary(journal: Optional[List[dict]] = None) -> dict:
     """Aggregate the journal per stage: numeric metrics sum across entries
     (one compress entry stays itself; per-cluster trim entries add up),
     booleans AND together, and an ``entries`` count records how many calls
-    contributed. Isolate-scoped entries aggregate under ``isolates``."""
+    contributed. Isolate-scoped entries aggregate under ``isolates``.
+    Pass ``journal`` to aggregate a pre-filtered entry list (the scoped
+    serve reports) instead of the live journal."""
     out: dict = {}
     iso_out: Dict[str, dict] = {}
-    with _lock:
-        journal = list(_entries)
+    if journal is None:
+        with _lock:
+            journal = list(_entries)
     for entry in journal:
         target = out
         if entry.get("isolate"):
@@ -154,16 +174,21 @@ def summary() -> dict:
     return out
 
 
-def write_qc_report(run_dir) -> Optional[Path]:
+def write_qc_report(run_dir, scope: Optional[str] = None) -> Optional[Path]:
     """Write ``qc_report.json`` (journal + summary) atomically into the run
     directory; returns the path (None on failure or empty journal —
-    telemetry never fails the pipeline)."""
+    telemetry never fails the pipeline). With ``scope``, only entries
+    tagged with that isolate scope are written — how concurrent serve jobs
+    each get a report of exactly their own entries from the shared
+    journal."""
     with _lock:
-        if not _entries:
-            return None
-        payload = {"schema": 1, "created_epoch": round(time.time(), 3),
-                   "entries": [dict(e) for e in _entries]}
-    payload["summary"] = summary()
+        selected = [dict(e) for e in _entries
+                    if scope is None or _in_scope(e, scope)]
+    if not selected:
+        return None
+    payload = {"schema": 1, "created_epoch": round(time.time(), 3),
+               "entries": selected}
+    payload["summary"] = summary(selected)
     path = Path(run_dir) / QC_REPORT_JSON
     try:
         path.parent.mkdir(parents=True, exist_ok=True)

@@ -66,7 +66,9 @@ def environment_snapshot() -> dict:
     accelerator device files. Pure inspection — never imports jax, never
     initialises a backend (``autocycler doctor`` must be safe to run on a
     wedged host)."""
-    env_vars = {k: os.environ[k] for k in sorted(os.environ)
+    env_vars = {k: ("<redacted>" if ("TOKEN" in k or "SECRET" in k)
+                    else os.environ[k])
+                for k in sorted(os.environ)
                 if k == "JAX_PLATFORMS" or k.startswith("AUTOCYCLER_")
                 or k in ("XLA_FLAGS", "LIBTPU_INIT_ARGS", "TPU_NAME",
                          "PJRT_DEVICE", "TPU_LIBRARY_PATH")}

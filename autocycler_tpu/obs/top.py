@@ -182,6 +182,18 @@ def render_top_frame(root) -> Optional[str]:
                 line += f" (max {int(max(depth))})"
             lines.append(line)
 
+        # worker-pool width and live busy count: the serve block of the
+        # latest tick when present, serve.json as the cross-version
+        # fallback (older daemons record neither — line is omitted)
+        workers = (serve_last or {}).get("workers") \
+            or (info or {}).get("workers")
+        if workers:
+            busy = (serve_last or {}).get("busy_workers")
+            wline = f"Workers      {workers}"
+            if isinstance(busy, int):
+                wline += f"  ({busy} busy)"
+            lines.append(wline)
+
         jobs_deltas = _counter_delta_series(
             entries, "autocycler_serve_jobs_total")
         if any(jobs_deltas):

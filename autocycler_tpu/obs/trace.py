@@ -15,6 +15,18 @@ command, stage, substage and device-dispatch granularity — to
 Parent/child nesting is tracked per thread (a span opened inside a pool
 worker roots its own lane, exactly how the Chrome viewer renders it).
 
+Two kinds of run can be active at once:
+
+- the process-wide run (``start_run``/``finish_run``) — the CLI path;
+  at most one exists, and a second ``start_run`` is an error;
+- *scoped* runs (``open_run``/``bind_run``/``close_run``) — the serve
+  scheduler's path: each daemon job opens its own run and binds it to the
+  thread(s) executing that job, so N concurrent jobs stream N disjoint
+  ``trace.jsonl`` files from one process. A thread with no bound run falls
+  back to the process-wide run, then — when exactly one scoped run is open
+  — to that run, so single-worker daemons keep attributing pool-thread
+  spans exactly as the global-run implementation did.
+
 The disabled path is deliberately free: with no active run, :func:`span`
 returns a shared no-op context manager — no I/O, no per-call state, O(1)
 allocation — so tracing can stay compiled into every hot path
@@ -50,7 +62,7 @@ _local = threading.local()
 
 class _Run:
     __slots__ = ("dir", "file", "t0_perf", "t0_epoch", "name", "spans",
-                 "next_id", "dropped", "tids")
+                 "next_id", "dropped", "tids", "lock", "closed")
 
     def __init__(self, trace_dir: Path, name: str):
         self.dir = trace_dir
@@ -62,17 +74,64 @@ class _Run:
         self.next_id = 1
         self.dropped = 0
         self.tids = {}          # thread ident -> small stable lane number
+        self.lock = threading.Lock()   # per-run: id allocation + file writes
+        self.closed = False
 
 
 _run: Optional[_Run] = None
+_scoped_runs: List[_Run] = []
+
+
+def _active_run() -> Optional[_Run]:
+    """The run the current thread should record into: its bound scoped run,
+    else the process-wide run, else — when exactly one scoped run is open —
+    that run (so unbound pool threads under a single-worker daemon attribute
+    spans exactly as they did with the global run)."""
+    run = getattr(_local, "run", None)
+    if run is not None and not run.closed:
+        return run
+    run = _run
+    if run is not None:
+        return run
+    scoped = _scoped_runs
+    if len(scoped) == 1:
+        return scoped[0]
+    return None
+
+
+class bind_run:
+    """Bind a scoped run to the current thread for the duration of the
+    ``with`` block: every :func:`span`, :func:`tracing_active` check and
+    ledger/QC hook on this thread resolves to ``run``. Nestable; restores
+    the previous binding on exit."""
+
+    def __init__(self, run: _Run):
+        self.run = run
+
+    def __enter__(self):
+        self._prev = getattr(_local, "run", None)
+        _local.run = self.run
+        return self.run
+
+    def __exit__(self, *exc):
+        _local.run = self._prev
+        return False
+
+
+def current_run() -> Optional[_Run]:
+    """The run the calling thread would record into (see
+    :func:`_active_run`) — what pool helpers capture to propagate trace
+    context into worker threads."""
+    return _active_run()
 
 
 def tracing_active() -> bool:
-    return _run is not None
+    return _active_run() is not None
 
 
 def trace_dir() -> Optional[Path]:
-    return _run.dir if _run is not None else None
+    run = _active_run()
+    return run.dir if run is not None else None
 
 
 class _NoopSpan:
@@ -90,15 +149,21 @@ class _NoopSpan:
 NOOP_SPAN = _NoopSpan()
 
 
-def _stack() -> list:
-    stack = getattr(_local, "stack", None)
+def _stack(run: _Run) -> list:
+    """The per-thread span stack of ``run``: nesting is tracked per
+    (thread, run) so concurrent scoped runs never parent across runs."""
+    stacks = getattr(_local, "stacks", None)
+    if stacks is None:
+        stacks = _local.stacks = {}
+    stack = stacks.get(id(run))
     if stack is None:
-        stack = _local.stack = []
+        stack = stacks[id(run)] = []
     return stack
 
 
 class _Span:
-    __slots__ = ("name", "cat", "attrs", "id", "parent", "t0_perf", "ts")
+    __slots__ = ("name", "cat", "attrs", "id", "parent", "t0_perf", "ts",
+                 "run")
 
     def __init__(self, name: str, cat: str, attrs: dict):
         self.name = name
@@ -106,13 +171,15 @@ class _Span:
         self.attrs = attrs
 
     def __enter__(self):
-        run = _run
-        if run is None:          # run finished between span() and __enter__
+        run = _active_run()
+        if run is None or run.closed:   # run finished before __enter__
             self.id = None
+            self.run = None
             return self
-        stack = _stack()
+        self.run = run
+        stack = _stack(run)
         self.parent = stack[-1].id if stack else None
-        with _lock:
+        with run.lock:
             self.id = run.next_id
             run.next_id += 1
         self.t0_perf = time.perf_counter()
@@ -124,12 +191,13 @@ class _Span:
         if self.id is None:
             return False
         dur = time.perf_counter() - self.t0_perf
-        stack = _stack()
+        run = self.run
+        stacks = getattr(_local, "stacks", None)
+        stack = stacks.get(id(run)) if stacks else None
         if stack and stack[-1] is self:
             stack.pop()
-        run = _run
-        if run is None:
-            return False
+            if not stack:
+                del stacks[id(run)]
         record = {"type": "span", "name": self.name, "cat": self.cat,
                   "id": self.id, "parent": self.parent,
                   "ts": round(self.ts, 6), "dur": round(dur, 6)}
@@ -145,8 +213,8 @@ class _Span:
             if mem:
                 record["mem"] = mem
         ident = threading.get_ident()
-        with _lock:
-            if _run is not run:
+        with run.lock:
+            if run.closed:
                 return False
             record["tid"] = run.tids.setdefault(ident, len(run.tids))
             if len(run.spans) < MAX_SPANS_IN_MEMORY:
@@ -174,34 +242,53 @@ def span(name: str, cat: str = "stage", **attrs):
     allocation). With a run active it records start offset, duration,
     parent span (per-thread nesting), category and ``attrs`` into the run's
     span stream."""
-    if _run is None:
+    if _active_run() is None:
         return NOOP_SPAN
     return _Span(name, cat, attrs)
 
 
 def current_span() -> Optional[_Span]:
-    stack = getattr(_local, "stack", None)
+    run = _active_run()
+    if run is None:
+        return None
+    stacks = getattr(_local, "stacks", None)
+    stack = stacks.get(id(run)) if stacks else None
     return stack[-1] if stack else None
 
 
-def start_run(trace_dir, name: str = "run") -> Path:
-    """Begin recording a run into ``trace_dir`` (created if needed).
-    Returns the directory. A second start while a run is active is an
-    error — finish the first (the CLI owns the run lifecycle)."""
-    global _run
+def _create_run(trace_dir, name: str) -> _Run:
     trace_dir = Path(trace_dir)
     trace_dir.mkdir(parents=True, exist_ok=True)
+    run = _Run(trace_dir, name)
+    header = {"type": "run", "name": name, "t0_epoch": run.t0_epoch,
+              "pid": os.getpid(), "argv": list(sys.argv)}
+    run.file.write(json.dumps(header) + "\n")
+    run.file.flush()
+    return run
+
+
+def start_run(trace_dir, name: str = "run") -> Path:
+    """Begin recording the process-wide run into ``trace_dir`` (created if
+    needed). Returns the directory. A second start while a run is active is
+    an error — finish the first (the CLI owns the run lifecycle)."""
+    global _run
     with _lock:
         if _run is not None:
             raise RuntimeError(
                 f"a trace run is already active in {_run.dir}")
-        run = _Run(trace_dir, name)
-        header = {"type": "run", "name": name, "t0_epoch": run.t0_epoch,
-                  "pid": os.getpid(), "argv": list(sys.argv)}
-        run.file.write(json.dumps(header) + "\n")
-        run.file.flush()
-        _run = run
-    return trace_dir
+        _run = _create_run(trace_dir, name)
+        return _run.dir
+
+
+def open_run(trace_dir, name: str = "run") -> _Run:
+    """Open a *scoped* run: records like the process-wide run but does not
+    claim the process-wide slot, so any number can be open concurrently
+    (one per serve job). Threads record into it via :class:`bind_run`;
+    finish it with :func:`close_run`."""
+    run = _create_run(trace_dir, name)
+    with _lock:
+        _scoped_runs.append(run)
+    return run
 
 
 def maybe_start_run(name: str = "run") -> bool:
@@ -221,17 +308,10 @@ def maybe_start_run(name: str = "run") -> bool:
         return False
 
 
-def finish_run() -> Optional[Path]:
-    """Close the active run: write the finish record, the Chrome trace and
-    the metrics snapshot (JSON + Prometheus). Returns the run directory
-    (None when no run was active). Never raises on I/O problems — telemetry
-    must not fail the pipeline."""
-    global _run
-    with _lock:
-        run = _run
-        _run = None
-    if run is None:
-        return None
+def _finalize(run: _Run) -> Path:
+    """Write the finish record, the Chrome trace and the metrics snapshot
+    (JSON + Prometheus) for a run already removed from the active slots.
+    Never raises on I/O problems — telemetry must not fail the pipeline."""
     wall = time.perf_counter() - run.t0_perf
     footer = {"type": "finish", "wall": round(wall, 6),
               "spans": len(run.spans) + run.dropped, "dropped": run.dropped,
@@ -252,6 +332,34 @@ def finish_run() -> Optional[Path]:
     except OSError:
         pass
     return run.dir
+
+
+def finish_run() -> Optional[Path]:
+    """Close the process-wide run. Returns the run directory (None when no
+    run was active)."""
+    global _run
+    with _lock:
+        run = _run
+        _run = None
+    if run is None:
+        return None
+    with run.lock:
+        run.closed = True
+    return _finalize(run)
+
+
+def close_run(run: _Run) -> Optional[Path]:
+    """Close a scoped run opened with :func:`open_run`. Returns its
+    directory (None when already closed). In-flight spans of other threads
+    observe ``closed`` under the run lock and drop their records."""
+    with _lock:
+        if run in _scoped_runs:
+            _scoped_runs.remove(run)
+    with run.lock:
+        if run.closed:
+            return None
+        run.closed = True
+    return _finalize(run)
 
 
 def write_metrics_file(path) -> None:
@@ -282,12 +390,15 @@ def _write_chrome_trace(path: Path, spans: List[dict], name: str) -> None:
 
 
 def _abort_run_for_tests() -> None:
-    """Drop any active run without writing artifacts (test isolation)."""
+    """Drop any active run (global and scoped) without writing artifacts
+    (test isolation)."""
     global _run
     with _lock:
-        run = _run
+        runs = ([_run] if _run is not None else []) + list(_scoped_runs)
         _run = None
-    if run is not None:
+        _scoped_runs.clear()
+    for run in runs:
+        run.closed = True
         try:
             run.file.close()
         except OSError:

@@ -83,6 +83,13 @@ def request_json(endpoint: str, method: str, path: str,
     try:
         payload = json.dumps(body).encode() if body is not None else None
         headers = {"Content-Type": "application/json"} if payload else {}
+        # shared-secret auth rides automatically when the client's
+        # environment carries the daemon's token knob; the value is sent
+        # on the wire only, never logged
+        from ..utils.knobs import knob_str
+        token = knob_str("AUTOCYCLER_SERVE_TOKEN") or None
+        if token:
+            headers["Authorization"] = f"Bearer {token}"
         try:
             conn.request(method, path, body=payload, headers=headers)
             resp = conn.getresponse()

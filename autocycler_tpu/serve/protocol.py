@@ -35,6 +35,9 @@ JOB_STATES = ("queued", "running", "done", "failed")
 # mirroring one isolate of `autocycler batch`.
 JOB_COMMANDS = ("compress", "pipeline")
 
+# fan-out bound for one batch submission (POST /jobs with a "batch" array)
+BATCH_MAX = 64
+
 
 @dataclass
 class JobSpec:
@@ -113,3 +116,39 @@ def parse_job_spec(data) -> JobSpec:
                    out_dir=out_dir, kmer=kmer, max_contigs=max_contigs,
                    threads=threads, cutoff=float(cutoff),
                    min_assemblies=min_assemblies)
+
+
+def is_batch_spec(data) -> bool:
+    """True when a POST /jobs body is a multi-isolate batch submission."""
+    return isinstance(data, dict) and "batch" in data
+
+
+def parse_batch_spec(data) -> list:
+    """Validate a batch body into a list of :class:`JobSpec`.
+
+    The body carries a ``"batch"`` array of per-isolate spec objects;
+    every other top-level field is a shared default merged under each
+    child (a child's own field wins). The whole batch validates or the
+    whole batch is rejected — partial admission would leave a client
+    guessing which isolates were accepted."""
+    if not isinstance(data, dict):
+        raise InputError("batch spec must be a JSON object")
+    items = data.get("batch")
+    if not isinstance(items, list) or not items:
+        raise InputError("'batch' must be a non-empty JSON array of "
+                         "job specs")
+    if len(items) > BATCH_MAX:
+        raise InputError(f"batch fan-out is capped at {BATCH_MAX} jobs "
+                         f"(got {len(items)})")
+    shared = {k: v for k, v in data.items() if k != "batch"}
+    specs = []
+    for i, item in enumerate(items):
+        if not isinstance(item, dict):
+            raise InputError(f"batch item {i} must be a JSON object")
+        merged = dict(shared)
+        merged.update(item)
+        try:
+            specs.append(parse_job_spec(merged))
+        except InputError as e:
+            raise InputError(f"batch item {i}: {e}") from None
+    return specs

@@ -1,7 +1,9 @@
 """Bounded work queue with per-job fault isolation for `autocycler serve`.
 
 One scheduler owns the daemon's job table, a bounded FIFO queue and a
-worker thread. Each job runs the same code path the CLI runs — compress
+pool of worker threads (``AUTOCYCLER_SERVE_WORKERS``, default
+``min(4, cpu//2)``; ``1`` reproduces the original single-worker daemon
+bit for bit). Each job runs the same code path the CLI runs — compress
 (optionally through the full cluster/trim/resolve/combine pipeline) — but
 inside a quarantine: an :class:`AutocyclerError` or OSError marks the job
 failed in the job table and the ``serve_manifest.json`` run manifest
@@ -12,20 +14,29 @@ Each job owns a run directory (``<root>/jobs/<id>/``) receiving the
 standard per-run artifacts — ``trace.jsonl``, ``qc_report.json``,
 ``ledger.json`` — exactly what ``AUTOCYCLER_TRACE_DIR`` produces for a CLI
 run, so `autocycler watch` and `autocycler report` work unchanged on a
-daemon job. The span tracer, QC journal and ledger are process-wide
-one-run-at-a-time machinery, so job execution holds the scheduler's run
-lock: jobs are admitted concurrently (the bounded queue) but execute
-serially, which is also what the device and the shared worker pool want.
+daemon job. Concurrent jobs stay disjoint because each opens its own
+*scoped* trace run (:func:`obs.trace.open_run` bound to the executing
+thread and propagated into pool tasks), tags QC/ledger entries with its
+job id as the isolate scope, and writes scope-filtered reports at the
+end. Device dispatches serialize through the process-wide device token
+(:func:`utils.timing.enable_device_token`): one job on-chip at a time
+while other jobs' host stages — load, parse, encode — overlap freely.
 
 The warm wins come for free from sharing the process: the JIT caches, the
 resolved device probe, the shared ``utils.pool`` executor and — because the
 daemon points ``utils.cache`` at one shared directory — the parse and
-end-repair caches all persist across jobs.
+end-repair caches all persist across jobs and across workers.
+
+Batch fan-out: one ``POST /jobs`` body with a ``"batch"`` array admits N
+child jobs under one parent id; ``GET /jobs/<parent>`` aggregates child
+states (the admission path fleet batch rides later).
 """
 
 from __future__ import annotations
 
+import contextlib
 import gc
+import os
 import queue
 import threading
 import time
@@ -50,6 +61,27 @@ REJECTED_TOTAL = "autocycler_serve_rejected_total"
 SHED_TOTAL = "autocycler_serve_shed_total"
 QUEUE_DEPTH = "autocycler_serve_queue_depth"
 JOB_SECONDS = "autocycler_serve_job_seconds"
+WORKERS_GAUGE = "autocycler_serve_workers"
+BUSY_GAUGE = "autocycler_serve_busy_workers"
+WORKER_BUSY_GAUGE = "autocycler_serve_worker_busy"
+
+
+def default_workers() -> int:
+    """The scheduler pool width: ``AUTOCYCLER_SERVE_WORKERS`` when set
+    (floor 1), else ``min(4, cpu//2)`` with floor 1 — conservative because
+    every job already fans its own stages across the shared pool."""
+    from ..utils.knobs import knob_int
+    configured = knob_int("AUTOCYCLER_SERVE_WORKERS")
+    if configured is not None:
+        return max(1, int(configured))
+    return max(1, min(4, (os.cpu_count() or 2) // 2))
+
+
+def _id_num(name: str) -> int:
+    try:
+        return int(name.rsplit("-", 1)[1])
+    except (IndexError, ValueError):
+        return 0
 
 
 class QueueFullError(AutocyclerError):
@@ -69,6 +101,7 @@ class Job:
         self.state = "queued"
         self.error: Optional[str] = None
         self.resumed = False              # replayed after a daemon restart
+        self.parent: Optional[str] = None  # batch parent id, when fanned out
         self.submitted_epoch = time.time()
         self.started_epoch: Optional[float] = None
         self.finished_epoch: Optional[float] = None
@@ -83,6 +116,7 @@ class Job:
             "run_dir": str(self.run_dir),
             "out_dir": str(self.out_dir),
             "error": self.error,
+            "parent": self.parent,
             "submitted_epoch": round(self.submitted_epoch, 3),
             "started_epoch": round(self.started_epoch, 3)
             if self.started_epoch else None,
@@ -96,37 +130,64 @@ class Job:
 
 
 class Scheduler:
-    """The daemon's job table + bounded queue + worker thread."""
+    """The daemon's job table + bounded queue + worker pool."""
 
-    def __init__(self, root, capacity: int = 16):
+    # lint: locks.guarded-fields — mutations of these instance fields must
+    # sit under `with self._lock:` (analysis.rules.locks enforces it)
+    _GUARDED_BY = {"_lock": ("_jobs", "_parents", "_busy", "_next_id")}
+
+    def __init__(self, root, capacity: int = 16,
+                 workers: Optional[int] = None):
         self.root = Path(root)
         self.root.mkdir(parents=True, exist_ok=True)
         self.capacity = max(1, int(capacity))
+        self.workers = max(1, int(workers)) if workers is not None \
+            else default_workers()
         self._queue: "queue.Queue[Job]" = queue.Queue(maxsize=self.capacity)
         self._jobs: Dict[str, Job] = {}
+        self._parents: Dict[str, dict] = {}   # batch id -> children meta
+        self._busy: Dict[str, str] = {}       # worker name -> job id
         self._lock = threading.Lock()
-        self._run_lock = threading.Lock()   # serializes trace/QC/ledger runs
+        # legacy whole-job serialization: held across execute() only in
+        # single-worker mode, preserving the original daemon's semantics
+        # bit for bit (and keeping SLO reads provably disjoint from it)
+        self._run_lock = threading.Lock()
         self._next_id = 1
         self._stop = threading.Event()
-        self._worker: Optional[threading.Thread] = None
-        # latency SLO tracking: its own lock, disjoint from _run_lock by
-        # construction (the sampler and /healthz read it mid-job)
+        self._threads: List[threading.Thread] = []
+        # latency SLO tracking: its own lock, disjoint from _run_lock and
+        # _lock by construction (the sampler and /healthz read it mid-job)
         self.slo = SloTracker()
+        self.slo.set_capacity(self.workers)
+        # multi-worker mode serializes on-chip work through the device
+        # token; single-worker leaves it off — zero-cost, bit-for-bit
+        from ..utils.timing import enable_device_token
+        enable_device_token(self.workers > 1)
+        metrics_registry.gauge_set(
+            WORKERS_GAUGE, self.workers,
+            help="serve scheduler worker pool width")
+        metrics_registry.gauge_set(
+            BUSY_GAUGE, 0, help="serve workers currently executing a job")
         self.manifest = RunManifest.load(self.root / MANIFEST_NAME)
         # crash-safe replay: a previous daemon's unfinished jobs come back.
-        # Jobs still "pending" re-enqueue in submission order; jobs caught
-        # "running" resume from their last checkpointed stage when the
-        # worker picks them up (docs/failure-modes.md "daemon restart").
+        # Jobs still "pending" re-enqueue, and EVERY job caught "running"
+        # (a multi-worker daemon dies with up to N of them) resumes from
+        # its last checkpointed stage when a worker picks it up
+        # (docs/failure-modes.md "daemon restart").
         replay: List[Job] = []
-        for name in sorted(self.manifest.items):   # ids sort = submit order
-            entry = self.manifest.items[name]
+        for name, entry in list(self.manifest.items.items()):
             # resume the id sequence past every recorded job so a restarted
             # daemon never reuses (and silently overwrites) a prior job id
-            try:
-                self._next_id = max(self._next_id,
-                                    int(name.rsplit("-", 1)[1]) + 1)
-            except (IndexError, ValueError):
-                pass
+            self._next_id = max(self._next_id, _id_num(name) + 1)
+            if entry.get("kind") == "batch":
+                # parents are aggregation records, never enqueued; rebuild
+                # the fan-out map so GET /jobs/<parent> keeps answering
+                kids = [k for k in (entry.get("children") or [])
+                        if isinstance(k, str)]
+                self._parents[name] = {
+                    "children": kids,
+                    "submitted_epoch": entry.get("submitted_epoch")}
+                continue
             status = entry.get("status")
             if status not in ("pending", "running"):
                 continue
@@ -146,10 +207,17 @@ class Scheduler:
             out_dir = Path(entry.get("out_dir") or (run_dir / "out"))
             job = Job(name, spec, run_dir, out_dir)
             job.resumed = status == "running"
+            parent = entry.get("parent")
+            if isinstance(parent, str):
+                job.parent = parent
             submitted = entry.get("submitted_epoch")
             if isinstance(submitted, (int, float)):
                 job.submitted_epoch = float(submitted)
             replay.append(job)
+        # re-enqueue in true submission order: the persisted submit
+        # timestamp, tie-broken by the numeric id — NOT the lexicographic
+        # id sort, which misorders once ids outgrow their zero padding
+        replay.sort(key=lambda j: (j.submitted_epoch, _id_num(j.id)))
         for job in replay:
             try:
                 self._queue.put_nowait(job)
@@ -173,31 +241,76 @@ class Scheduler:
         """Admit one job into the bounded queue; raises
         :class:`QueueFullError` at capacity (never blocks the caller)."""
         with self._lock:
-            job_id = f"job-{self._next_id:06d}"
-            self._next_id += 1
-            run_dir = self.root / "jobs" / job_id
-            out_dir = Path(spec.out_dir) if spec.out_dir \
-                else run_dir / "out"
-            job = Job(job_id, spec, run_dir, out_dir)
-            try:
-                self._queue.put_nowait(job)
-            except queue.Full:
-                metrics_registry.counter_inc(
-                    REJECTED_TOTAL, 1, help="jobs rejected at admission",
-                    reason="queue_full")
-                raise QueueFullError(
-                    f"work queue is full ({self.capacity} jobs); "
-                    "retry after a job completes") from None
-            self._jobs[job_id] = job
+            job = self._admit_locked(spec)
         # persist everything replay needs: a restarted daemon rebuilds the
         # Job from the manifest entry alone
         self.manifest.annotate(
-            job_id, spec=spec.to_dict(), out_dir=str(out_dir),
+            job.id, spec=spec.to_dict(), out_dir=str(job.out_dir),
             submitted_epoch=round(job.submitted_epoch, 3))
         metrics_registry.counter_inc(
             SUBMITTED_TOTAL, 1, help="jobs admitted into the work queue")
         self._gauge_depth()
         return job
+
+    def _admit_locked(self, spec: JobSpec,
+                      parent: Optional[str] = None) -> Job:
+        """Create + enqueue one job. Caller holds ``self._lock``."""
+        job_id = f"job-{self._next_id:06d}"
+        self._next_id += 1
+        run_dir = self.root / "jobs" / job_id
+        out_dir = Path(spec.out_dir) if spec.out_dir else run_dir / "out"
+        job = Job(job_id, spec, run_dir, out_dir)
+        job.parent = parent
+        try:
+            self._queue.put_nowait(job)
+        except queue.Full:
+            metrics_registry.counter_inc(
+                REJECTED_TOTAL, 1, help="jobs rejected at admission",
+                reason="queue_full")
+            raise QueueFullError(
+                f"work queue is full ({self.capacity} jobs); "
+                "retry after a job completes") from None
+        self._jobs[job_id] = job
+        return job
+
+    def submit_batch(self, specs: List[JobSpec]) -> dict:
+        """Fan a multi-isolate batch out into child jobs under one parent
+        id. All-or-nothing: when fewer than ``len(specs)`` queue slots are
+        free the whole batch is rejected (503), so a client never has to
+        reconstruct which half of its fleet was admitted."""
+        specs = list(specs)
+        with self._lock:
+            free = self.capacity - self._queue.qsize()
+            if len(specs) > free:
+                metrics_registry.counter_inc(
+                    REJECTED_TOTAL, len(specs),
+                    help="jobs rejected at admission", reason="queue_full")
+                raise QueueFullError(
+                    f"batch of {len(specs)} exceeds the {free} free queue "
+                    f"slot(s) (capacity {self.capacity}); retry after jobs "
+                    "complete")
+            parent_id = f"batch-{self._next_id:06d}"
+            self._next_id += 1
+            children = [self._admit_locked(spec, parent=parent_id)
+                        for spec in specs]
+            self._parents[parent_id] = {
+                "children": [j.id for j in children],
+                "submitted_epoch": round(time.time(), 3)}
+        for job in children:
+            self.manifest.annotate(
+                job.id, spec=job.spec.to_dict(), out_dir=str(job.out_dir),
+                submitted_epoch=round(job.submitted_epoch, 3),
+                parent=parent_id)
+        self.manifest.annotate(
+            parent_id, kind="batch", children=[j.id for j in children],
+            submitted_epoch=self._parents[parent_id]["submitted_epoch"])
+        metrics_registry.counter_inc(
+            SUBMITTED_TOTAL, len(children),
+            help="jobs admitted into the work queue")
+        self._gauge_depth()
+        record = self.batch_record(parent_id)
+        assert record is not None
+        return record
 
     def job(self, job_id: str) -> Optional[Job]:
         with self._lock:
@@ -206,6 +319,53 @@ class Scheduler:
     def jobs(self) -> List[Job]:
         with self._lock:
             return list(self._jobs.values())
+
+    def batch_record(self, parent_id: str) -> Optional[dict]:
+        """The aggregated record of one batch: child job records plus the
+        derived parent state (queued -> running -> done | failed) and the
+        summed queue wait — what ``GET /jobs/<parent>`` serves."""
+        with self._lock:
+            meta = self._parents.get(parent_id)
+            if meta is None:
+                return None
+            children = [self._jobs[c] for c in meta["children"]
+                        if c in self._jobs]
+            missing = len(meta["children"]) - len(children)
+            records = [j.to_dict() for j in children]
+        states = [r["state"] for r in records]
+        if states and all(s == "queued" for s in states):
+            state = "queued"
+        elif any(s in ("queued", "running") for s in states):
+            state = "running"
+        elif any(s == "failed" for s in states):
+            state = "failed"
+        else:
+            state = "done"
+        waits = [r["queue_wait_s"] for r in records
+                 if r["queue_wait_s"] is not None]
+        finished = [r["finished_epoch"] for r in records]
+        started = [r["started_epoch"] for r in records if r["started_epoch"]]
+        wall = None
+        if started and all(f is not None for f in finished):
+            wall = round(max(finished) - min(started), 3)
+        return {
+            "id": parent_id,
+            "kind": "batch",
+            "state": state,
+            "jobs": len(records),
+            "children": records,
+            "children_missing": missing,
+            "states": {s: states.count(s) for s in sorted(set(states))},
+            "agg_queue_wait_s": round(sum(waits), 3) if waits else None,
+            "wall_s": wall,
+            "submitted_epoch": meta.get("submitted_epoch"),
+        }
+
+    def batches(self) -> List[dict]:
+        with self._lock:
+            parent_ids = sorted(self._parents, key=_id_num)
+        records = [self.batch_record(p) for p in parent_ids]
+        return [r for r in records if r is not None]
 
     def counts(self) -> Dict[str, int]:
         out: Dict[str, int] = {}
@@ -218,38 +378,63 @@ class Scheduler:
             QUEUE_DEPTH, self._queue.qsize(),
             help="jobs waiting in the serve work queue")
 
-    # ---- worker ----
+    # ---- worker pool ----
 
     def start(self) -> None:
-        if self._worker is not None:
+        if self._threads:
             return
-        self._worker = threading.Thread(
-            target=self._worker_loop, name="autocycler-serve-worker",
-            daemon=True)
-        self._worker.start()
+        for i in range(self.workers):
+            thread = threading.Thread(
+                target=self._worker_loop, args=(f"worker-{i}",),
+                name=f"autocycler-serve-worker-{i}", daemon=True)
+            self._threads.append(thread)
+            thread.start()
 
     def shutdown(self, wait: bool = True, timeout: float = 30.0) -> None:
-        """Stop the worker after its current job; queued jobs stay recorded
-        as pending in the manifest (a restarted daemon reports them)."""
+        """Stop the workers after their current jobs; queued jobs stay
+        recorded as pending in the manifest (a restarted daemon replays
+        them)."""
         self._stop.set()
-        worker, self._worker = self._worker, None
-        if worker is not None and wait:
-            worker.join(timeout=timeout)
+        threads, self._threads = self._threads, []
+        if wait:
+            deadline = time.monotonic() + timeout
+            for thread in threads:
+                thread.join(timeout=max(0.0, deadline - time.monotonic()))
+
+    def busy_count(self) -> int:
+        with self._lock:
+            return len(self._busy)
 
     def idle(self) -> bool:
-        """True when the queue is drained and no job is running."""
-        return self._queue.empty() and not self._run_lock.locked()
+        """True when the queue is drained and no worker is executing."""
+        return self._queue.empty() and self.busy_count() == 0
 
-    def _worker_loop(self) -> None:
+    def _set_busy(self, worker: str, job_id: Optional[str]) -> None:
+        with self._lock:
+            if job_id is None:
+                self._busy.pop(worker, None)
+            else:
+                self._busy[worker] = job_id
+            busy = len(self._busy)
+        metrics_registry.gauge_set(
+            BUSY_GAUGE, busy, help="serve workers currently executing a job")
+        metrics_registry.gauge_set(
+            WORKER_BUSY_GAUGE, 0 if job_id is None else 1,
+            help="per-worker busy flag (1 = executing a job)",
+            worker=worker)
+
+    def _worker_loop(self, worker: str) -> None:
         while not self._stop.is_set():
             try:
                 job = self._queue.get(timeout=0.2)
             except queue.Empty:
                 continue
             self._gauge_depth()
+            self._set_busy(worker, job.id)
             try:
                 self.execute(job)
             finally:
+                self._set_busy(worker, None)
                 self._queue.task_done()
 
     # ---- execution ----
@@ -257,38 +442,45 @@ class Scheduler:
     def execute(self, job: Job) -> None:
         """Run one job under quarantine, with its own trace/QC/ledger run.
 
-        Holding the run lock across the job keeps the process-wide run
-        machinery (one active trace run, the QC journal, the ledger tables)
-        exclusive to this job; the QC scope additionally labels every
-        gauge/journal entry with the job id so nothing cross-contaminates
-        the cumulative registry the /metrics endpoint exports."""
+        Every job opens a *scoped* trace run bound to the executing thread
+        (and propagated into pool tasks), and tags its QC journal and
+        ledger entries with the job id as the isolate scope — so N
+        concurrent jobs stream N disjoint trace.jsonl files and each
+        run directory's qc_report/ledger carries exactly that job's
+        entries. In single-worker mode the legacy run lock is additionally
+        held across the job, preserving the original daemon's execution
+        semantics bit for bit."""
         spec = job.spec
-        with self._run_lock:
-            job.state = "running"
-            job.started_epoch = time.time()
-            job.queue_wait_s = max(0.0,
-                                   job.started_epoch - job.submitted_epoch)
+        gate = self._run_lock if self.workers == 1 \
+            else contextlib.nullcontext()
+        with gate:
+            with self._lock:
+                job.state = "running"
+                job.started_epoch = time.time()
+                job.queue_wait_s = max(
+                    0.0, job.started_epoch - job.submitted_epoch)
             self.manifest.start(job.id)
             log.message(f"serve: {job.id} started "
                         f"({spec.command} {spec.assemblies_dir})")
             t0 = time.perf_counter()
-            owns_run = False
+            run = None
             try:
-                trace.start_run(job.run_dir, name=f"serve-{spec.command}")
-                owns_run = True
-            except (RuntimeError, OSError):
-                # a CLI-owned run is somehow active or the dir is
-                # unwritable — run the job untraced rather than refuse it
+                run = trace.open_run(job.run_dir,
+                                     name=f"serve-{spec.command}")
+            except OSError:
+                # unwritable run dir — run the job untraced rather than
+                # refuse it
                 pass
-            if owns_run:
-                obs_qc.reset()
-                ledger.reset()
             failure: Optional[BaseException] = None
             unexpected = False
             try:
-                with trace.span(f"job/{job.id}", cat="command",
-                                job=job.id, command=spec.command), \
-                        obs_qc.scope(job.id):
+                with contextlib.ExitStack() as ctx:
+                    if run is not None:
+                        ctx.enter_context(trace.bind_run(run))
+                    ctx.enter_context(
+                        trace.span(f"job/{job.id}", cat="command",
+                                   job=job.id, command=spec.command))
+                    ctx.enter_context(obs_qc.scope(job.id))
                     self._run_spec(spec, job.out_dir, job_id=job.id)
             except (AutocyclerError, OSError) as e:
                 failure = e
@@ -298,27 +490,32 @@ class Scheduler:
                 failure, unexpected = e, True
             finally:
                 job.wall_s = time.perf_counter() - t0
-                if owns_run:
-                    run_dir = trace.finish_run()
+                if run is not None:
+                    run_dir = trace.close_run(run)
                     if run_dir:
-                        obs_qc.write_qc_report(run_dir)
+                        obs_qc.write_qc_report(run_dir, scope=job.id)
                         ledger.write_ledger(
-                            run_dir, command=f"serve/{spec.command}")
+                            run_dir, command=f"serve/{spec.command}",
+                            scope=job.id)
+                # the job's journal/ledger entries are flushed into its run
+                # dir; drain them so a long-lived daemon's shared tables
+                # stay bounded
+                obs_qc.drain_scope(job.id)
+                ledger.drain_scope(job.id)
                 # job graphs are reference-cyclic; a long-lived daemon must
                 # reclaim them eagerly or RSS grows by one graph per job
                 gc.collect()
-                # the terminal state flips only AFTER the run artifacts are
-                # flushed: a client that polls /jobs/<id> to done may read
-                # ledger.json immediately
+                # the terminal state flips only AFTER the run artifacts,
+                # metrics and SLO window are flushed: a client that polls
+                # /jobs/<id> to a terminal state may immediately read
+                # ledger.json or scrape /metrics and must find this job
+                # already accounted for
                 job.finished_epoch = time.time()
-                if failure is None:
-                    job.state = "done"
-                    self.manifest.done(job.id)
-                else:
-                    self._quarantine(job, failure, unexpected=unexpected)
+                final_state = "done" if failure is None else "failed"
                 metrics_registry.counter_inc(
-                    JOBS_TOTAL, 1, help="jobs completed by the serve worker",
-                    state=job.state, command=spec.command)
+                    JOBS_TOTAL, 1,
+                    help="jobs completed by the serve worker",
+                    state=final_state, command=spec.command)
                 metrics_registry.observe(
                     JOB_SECONDS, job.wall_s,
                     help="per-job wall seconds",
@@ -326,20 +523,30 @@ class Scheduler:
                 self.slo.record(job.queue_wait_s or 0.0, job.wall_s,
                                 finished_epoch=job.finished_epoch,
                                 command=spec.command)
+                if failure is None:
+                    self.manifest.done(job.id)
+                    with self._lock:
+                        job.state = "done"
+                else:
+                    self._quarantine(job, failure, unexpected=unexpected)
                 log.message(f"serve: {job.id} {job.state} "
                             f"({job.wall_s:.2f}s)")
 
     def _quarantine(self, job: Job, error: BaseException,
                     unexpected: bool = False) -> None:
-        job.state = "failed"
         prefix = "unexpected error: " if unexpected else ""
-        job.error = f"{prefix}{type(error).__name__}: {error}" if unexpected \
+        message = f"{prefix}{type(error).__name__}: {error}" if unexpected \
             else str(error)
-        self.manifest.fail(job.id, job.error)
-        log.message(f"WARNING: serve: {job.id} quarantined — {job.error}")
+        # counter before the state flip, for the same poll-then-scrape
+        # ordering contract as execute()'s terminal accounting
         metrics_registry.counter_inc(
             "autocycler_quarantined_items_total", 1,
             help="per-item failures quarantined instead of aborting")
+        self.manifest.fail(job.id, message)
+        with self._lock:
+            job.state = "failed"
+            job.error = message
+        log.message(f"WARNING: serve: {job.id} quarantined — {message}")
 
     def _stage_skip(self, job_id: Optional[str], stage: str,
                     outputs, cluster: Optional[str] = None) -> bool:

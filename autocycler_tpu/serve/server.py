@@ -18,16 +18,26 @@ Routes (all JSON unless noted):
 - ``GET  /metrics``         live Prometheus text exposition of the
                             process-wide metrics registry
 - ``GET  /healthz``         daemon liveness + queue/job counts + probe
-- ``POST /shutdown``        graceful stop (finish the current job, exit)
+- ``POST /shutdown``        graceful stop (finish running jobs, exit)
+
+``POST /jobs`` also accepts a batch body (``{"batch": [...]}``): the
+daemon fans it into child jobs under one parent id and ``GET
+/jobs/<parent>`` aggregates the children.
 
 The daemon binds TCP loopback by default (``--host``/``--port``) or a Unix
 domain socket (``--socket``), and writes ``serve.json`` into its root so
 `autocycler submit --dir <root>` discovers the endpoint without flags.
+Binding beyond loopback requires a shared secret
+(``AUTOCYCLER_SERVE_TOKEN``); when a token is configured every request
+must carry it (``Authorization: Bearer <token>`` or
+``X-Autocycler-Token``) or it is refused with 401. The token value is
+never logged and never written into ``serve.json``.
 """
 
 from __future__ import annotations
 
 import contextlib
+import hmac
 import json
 import os
 import socket
@@ -42,8 +52,10 @@ from .. import __version__
 from ..obs import metrics_registry
 from ..obs.timeseries import TimeseriesSampler, timeseries_enabled
 from ..utils import log
+from ..utils.knobs import knob_str
 from ..utils.resilience import InputError
-from .protocol import (DEFAULT_PORT, SERVE_INFO_JSON, parse_job_spec)
+from .protocol import (DEFAULT_PORT, SERVE_INFO_JSON, is_batch_spec,
+                       parse_batch_spec, parse_job_spec)
 from .scheduler import SHED_TOTAL, QueueFullError, Scheduler
 
 # a sampler whose last tick is older than this many intervals is stale —
@@ -55,6 +67,18 @@ REQUESTS_TOTAL = "autocycler_serve_requests_total"
 # Retry-After hint on 503 responses (shed or queue-full): long enough for
 # a few window samples to age out, short enough to keep clients live
 RETRY_AFTER_S = 15
+
+TOKEN_ENV = "AUTOCYCLER_SERVE_TOKEN"
+
+# hosts a daemon may bind WITHOUT a shared-secret token; anything else is
+# reachable from off-box and refuses to start unauthenticated
+_LOOPBACK_HOSTS = ("localhost", "::1")
+
+
+def _is_loopback(host: Optional[str]) -> bool:
+    if host is None:            # unix socket: filesystem permissions apply
+        return True
+    return host in _LOOPBACK_HOSTS or host.startswith("127.")
 
 
 class _UnixHTTPServer(ThreadingHTTPServer):
@@ -115,9 +139,30 @@ class _Handler(BaseHTTPRequestHandler):
         except (json.JSONDecodeError, UnicodeDecodeError) as e:
             raise InputError(f"request body is not valid JSON: {e}")
 
+    def _authorized(self) -> bool:
+        """Shared-secret check. With no token configured every request
+        passes (loopback-only daemons); with one configured EVERY request
+        must present it. Comparison is constant-time and the token value
+        never reaches a log line or an error body."""
+        token = self.state.token
+        if not token:
+            return True
+        supplied = self.headers.get("X-Autocycler-Token") or ""
+        auth = self.headers.get("Authorization") or ""
+        if not supplied and auth.startswith("Bearer "):
+            supplied = auth[len("Bearer "):].strip()
+        if hmac.compare_digest(supplied.encode(), token.encode()):
+            return True
+        self._send_json(
+            401, {"error": "missing or invalid serve token"}, "unauthorized",
+            headers={"WWW-Authenticate": "Bearer"})
+        return False
+
     # ---- routes ----
 
     def do_GET(self):  # noqa: N802 — stdlib casing
+        if not self._authorized():
+            return
         parsed = urlparse(self.path)
         parts = [p for p in parsed.path.split("/") if p]
         if parsed.path == "/healthz":
@@ -129,9 +174,16 @@ class _Handler(BaseHTTPRequestHandler):
         if parts and parts[0] == "jobs":
             if len(parts) == 1:
                 jobs = [j.to_dict() for j in self.state.scheduler.jobs()]
-                return self._send_json(200, {"jobs": jobs}, "/jobs")
+                return self._send_json(
+                    200,
+                    {"jobs": jobs, "batches": self.state.scheduler.batches()},
+                    "/jobs")
             job = self.state.scheduler.job(parts[1])
             if job is None:
+                # batch parents live beside jobs in the same id namespace
+                batch = self.state.scheduler.batch_record(parts[1])
+                if batch is not None and len(parts) == 2:
+                    return self._send_json(200, batch, "/jobs/<id>")
                 return self._send_json(
                     404, {"error": f"unknown job {parts[1]!r}"}, "/jobs/<id>")
             if len(parts) == 2:
@@ -161,10 +213,15 @@ class _Handler(BaseHTTPRequestHandler):
         )
 
     def do_POST(self):  # noqa: N802
+        if not self._authorized():
+            return
         parsed = urlparse(self.path)
         if parsed.path == "/jobs":
             try:
-                spec = parse_job_spec(self._read_json())
+                body = self._read_json()
+                batch = is_batch_spec(body)
+                specs = parse_batch_spec(body) if batch \
+                    else [parse_job_spec(body)]
             except InputError as e:
                 metrics_registry.counter_inc(
                     "autocycler_serve_rejected_total", 1,
@@ -177,10 +234,10 @@ class _Handler(BaseHTTPRequestHandler):
             slo_report = self.state.scheduler.slo.report()
             if slo_report.get("shedding"):
                 metrics_registry.counter_inc(
-                    SHED_TOTAL, 1,
+                    SHED_TOTAL, len(specs),
                     help="submissions shed by burn-rate admission control")
                 metrics_registry.counter_inc(
-                    "autocycler_serve_rejected_total", 1,
+                    "autocycler_serve_rejected_total", len(specs),
                     help="jobs rejected at admission", reason="shed")
                 return self._send_json(
                     503,
@@ -192,11 +249,14 @@ class _Handler(BaseHTTPRequestHandler):
                      "retry_after_s": RETRY_AFTER_S},
                     "/jobs", headers={"Retry-After": RETRY_AFTER_S})
             try:
-                job = self.state.scheduler.submit(spec)
+                if batch:
+                    record = self.state.scheduler.submit_batch(specs)
+                else:
+                    record = self.state.scheduler.submit(specs[0]).to_dict()
             except QueueFullError as e:
                 return self._send_json(503, {"error": str(e)}, "/jobs",
                                        headers={"Retry-After": RETRY_AFTER_S})
-            return self._send_json(202, job.to_dict(), "/jobs")
+            return self._send_json(202, record, "/jobs")
         if parsed.path == "/shutdown":
             self._send_json(200, {"status": "shutting down"}, "/shutdown")
             self.state.request_shutdown()
@@ -213,11 +273,21 @@ class ServeHandle:
 
     def __init__(self, root, host: str = "127.0.0.1",
                  port: int = DEFAULT_PORT, socket_path=None,
-                 queue_size: int = 16):
+                 queue_size: int = 16, workers: Optional[int] = None):
         self.root = Path(root)
         self.root.mkdir(parents=True, exist_ok=True)
         self.t0 = time.time()
-        self.scheduler = Scheduler(self.root, capacity=queue_size)
+        # shared-secret auth: read once at startup so a daemon's policy is
+        # stable for its lifetime. Held in memory only — never logged,
+        # never echoed into serve.json or an error body.
+        self.token = knob_str(TOKEN_ENV) or None
+        if not socket_path and not _is_loopback(host) and not self.token:
+            raise InputError(
+                f"refusing to bind {host!r} (reachable beyond loopback) "
+                f"without {TOKEN_ENV} set — configure a shared-secret "
+                "token or bind loopback")
+        self.scheduler = Scheduler(self.root, capacity=queue_size,
+                                   workers=workers)
         self.socket_path = str(socket_path) if socket_path else None
         if self.socket_path:
             self.server = _UnixHTTPServer(self.socket_path, _Handler)
@@ -243,7 +313,9 @@ class ServeHandle:
     def _sampler_extra(self) -> dict:
         return {"serve": {"queue_depth": self.scheduler._queue.qsize(),
                           "jobs": self.scheduler.counts(),
-                          "idle": self.scheduler.idle()},
+                          "idle": self.scheduler.idle(),
+                          "workers": self.scheduler.workers,
+                          "busy_workers": self.scheduler.busy_count()},
                 "slo": self.scheduler.slo.report()}
 
     # ---- lifecycle ----
@@ -287,6 +359,8 @@ class ServeHandle:
                 "host": self.host, "port": self.port,
                 "socket": self.socket_path,
                 "started_epoch": round(self.t0, 3),
+                "workers": self.scheduler.workers,
+                "auth": "token" if self.token else "none",
                 "version": __version__}
         path = self.root / SERVE_INFO_JSON
         tmp = path.with_suffix(".json.tmp")
@@ -304,6 +378,7 @@ class ServeHandle:
         from ..ops.distance import probe_overlap_report
         now = time.time()
         slo_report = self.scheduler.slo.report()
+        busy = self.scheduler.busy_count()
         health = {
             "status": "ok",
             "version": __version__,
@@ -313,6 +388,9 @@ class ServeHandle:
             "queue_depth": self.scheduler._queue.qsize(),
             "jobs": self.scheduler.counts(),
             "idle": self.scheduler.idle(),
+            "workers": self.scheduler.workers,
+            "busy_workers": busy,
+            "utilization": round(busy / self.scheduler.workers, 4),
             "last_job_finished_epoch": slo_report.get("last_finished_epoch"),
             "slo": slo_report,
         }
@@ -346,7 +424,8 @@ class ServeHandle:
 
 
 def serve(serve_dir, host: str = "127.0.0.1", port: int = DEFAULT_PORT,
-          socket_path=None, queue_size: int = 16) -> int:
+          socket_path=None, queue_size: int = 16,
+          workers: Optional[int] = None) -> int:
     """CLI entry for `autocycler serve`: warm the process once, then block
     serving jobs until SIGINT/SIGTERM or POST /shutdown."""
     root = Path(serve_dir)
@@ -365,7 +444,8 @@ def serve(serve_dir, host: str = "127.0.0.1", port: int = DEFAULT_PORT,
     start_background_probe()
 
     handle = ServeHandle(root, host=host, port=port,
-                         socket_path=socket_path, queue_size=queue_size)
+                         socket_path=socket_path, queue_size=queue_size,
+                         workers=workers)
     handle.start()
 
     import signal
@@ -384,6 +464,8 @@ def serve(serve_dir, host: str = "127.0.0.1", port: int = DEFAULT_PORT,
     log.message(f"listening on {handle.endpoint}")
     log.message(f"serve root:   {root}")
     log.message(f"work queue:   {queue_size} job(s)")
+    log.message(f"workers:      {handle.scheduler.workers} "
+                f"(auth: {'token' if handle.token else 'none'})")
     if handle.sampler is not None:
         log.message(f"telemetry:    {handle.sampler.path} "
                     f"(every {handle.sampler.interval:g}s; "
@@ -395,6 +477,6 @@ def serve(serve_dir, host: str = "127.0.0.1", port: int = DEFAULT_PORT,
         handle.wait()
     except KeyboardInterrupt:
         pass
-    log.message("serve: shutting down (finishing the current job)")
+    log.message("serve: shutting down (finishing running jobs)")
     handle.stop()
     return 0

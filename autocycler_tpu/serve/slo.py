@@ -105,6 +105,14 @@ class SloTracker:
         # (finished_epoch, queue_wait_s, exec_s, total_s)
         self._window: deque = deque(maxlen=WINDOW_MAX_SAMPLES)
         self.last_finished_epoch: Optional[float] = None
+        # service capacity = scheduler worker count: a pool of N workers
+        # burns error budget N× faster at the same queue pressure, so the
+        # shed trigger scales with it (set_capacity; default 1 keeps the
+        # single-worker thresholds bit-for-bit)
+        self._capacity = 1
+
+    def set_capacity(self, workers: int) -> None:
+        self._capacity = max(1, int(workers))
 
     # -- write path (scheduler) --
 
@@ -175,12 +183,14 @@ class SloTracker:
             "window_jobs": len(window),
             "last_finished_epoch": self.last_finished_epoch,
         }
+        out["workers"] = self._capacity
         if window:
             totals = [t for (_, _, _, t) in window]
+            waits = [w for (_, w, _, _) in window]
             out["p50_s"] = round(_percentile(totals, 0.50), 6)
             out["p95_s"] = round(_percentile(totals, 0.95), 6)
-            out["queue_wait_p50_s"] = round(
-                _percentile([w for (_, w, _, _) in window], 0.50), 6)
+            out["queue_wait_p50_s"] = round(_percentile(waits, 0.50), 6)
+            out["queue_wait_p95_s"] = round(_percentile(waits, 0.95), 6)
             out["exec_p50_s"] = round(
                 _percentile([e for (_, _, e, _) in window], 0.50), 6)
         burn = None
@@ -198,8 +208,16 @@ class SloTracker:
         out["violated"] = violated
         shed_burn = shed_burn_threshold()
         out["shed_burn"] = shed_burn
+        # capacity-aware admission control: N workers drain the same queue
+        # N× faster, so the effective shed trigger is the configured
+        # threshold × capacity (capacity 1 → exactly the old behavior).
+        # shed_burn stays the raw knob value; the effective value rides
+        # alongside so /healthz shows both.
+        effective = shed_burn * self._capacity if shed_burn is not None \
+            else None
+        out["shed_burn_effective"] = effective
         # shedding clears by itself as the window drains: pruned samples
         # drop the burn rate back under the threshold
-        out["shedding"] = bool(shed_burn is not None and burn is not None
-                               and burn > shed_burn)
+        out["shedding"] = bool(effective is not None and burn is not None
+                               and burn > effective)
         return out

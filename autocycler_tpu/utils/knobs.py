@@ -387,6 +387,18 @@ KNOBS: Dict[str, Knob] = dict(
             "Default serve endpoint for `autocycler submit` (host:port or unix:/path).",
         ),
         _k(
+            "AUTOCYCLER_SERVE_WORKERS",
+            "int",
+            None,
+            "Worker threads in the serve scheduler pool; default min(4, cpu//2), floor 1. 1 reproduces the single-worker daemon bit for bit.",
+        ),
+        _k(
+            "AUTOCYCLER_SERVE_TOKEN",
+            "str",
+            None,
+            "Shared-secret bearer token for the serve daemon; required on every request when binding beyond loopback. Never logged and redacted from ledgers/snapshots.",
+        ),
+        _k(
             "AUTOCYCLER_SLO_P50_S",
             "float",
             None,

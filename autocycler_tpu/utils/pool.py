@@ -37,6 +37,35 @@ def _count_tasks(n: int, kind: str) -> None:
         kind=kind)
 
 
+def _context_wrapper() -> Optional[Callable]:
+    """Capture the submitting thread's observability context — its bound
+    trace run and QC isolate scope — as a ``wrap(fn)`` decorator replayed
+    inside pool threads, so spans/QC/ledger entries recorded by pooled work
+    attribute to the job that submitted it (essential once the serve
+    scheduler runs N jobs concurrently on the one shared executor).
+    Returns None when there is nothing to propagate (the common CLI fast
+    path: zero per-task overhead)."""
+    from ..obs import qc, trace
+    run = trace.current_run()
+    scope_name = qc.current_scope()
+    if run is None and scope_name is None:
+        return None
+
+    def wrap(fn: Callable) -> Callable:
+        def call(*args, **kwargs):
+            if run is not None and scope_name is not None:
+                with trace.bind_run(run), qc.scope(scope_name):
+                    return fn(*args, **kwargs)
+            if run is not None:
+                with trace.bind_run(run):
+                    return fn(*args, **kwargs)
+            with qc.scope(scope_name):
+                return fn(*args, **kwargs)
+        return call
+
+    return wrap
+
+
 def get_executor(workers: int):
     """The shared ``ThreadPoolExecutor``, grown to at least ``workers``
     threads. Never shut down mid-process (threads are daemonic on 3.9+ exit
@@ -75,6 +104,9 @@ class OrderedSubmitter:
 
     def submit(self, fn: Callable, *args) -> None:
         prev = self._prev
+        wrap = _context_wrapper()
+        if wrap is not None:
+            fn = wrap(fn)
 
         def job():
             if prev is not None:
@@ -111,6 +143,9 @@ def prefetch_iter(fn: Callable, items: Sequence, workers: int,
         for x in items:
             yield fn(x)
         return
+    wrap = _context_wrapper()
+    if wrap is not None:
+        fn = wrap(fn)
     _count_tasks(len(items), "prefetch")
     pending: deque = deque()
     i = 0
@@ -131,6 +166,9 @@ def pool_map(fn: Callable, items: Iterable, workers: int) -> List:
     items = list(items)
     if workers <= 1 or len(items) <= 1:
         return [fn(x) for x in items]
+    wrap = _context_wrapper()
+    if wrap is not None:
+        fn = wrap(fn)
     _count_tasks(len(items), "map")
     return list(get_executor(workers).map(fn, items))
 
@@ -161,6 +199,9 @@ def parallel_gather(src: np.ndarray, idx: np.ndarray, workers: int,
         lo, hi = bounds
         np.take(src, idx[lo:hi], out=out[lo:hi])
 
+    wrap = _context_wrapper()
+    if wrap is not None:
+        one = wrap(one)
     _count_tasks(len(jobs), "gather")
     list(get_executor(workers).map(one, jobs))
     return out
@@ -175,8 +216,11 @@ def parallel_bincount(arr: np.ndarray, minlength: int,
     if workers <= 1 or len(jobs) <= 1:
         return np.bincount(arr, minlength=minlength)
     _count_tasks(len(jobs), "bincount")
-    parts = get_executor(workers).map(
-        lambda b: np.bincount(arr[b[0]:b[1]], minlength=minlength), jobs)
+    part = lambda b: np.bincount(arr[b[0]:b[1]], minlength=minlength)  # noqa: E731
+    wrap = _context_wrapper()
+    if wrap is not None:
+        part = wrap(part)
+    parts = get_executor(workers).map(part, jobs)
     total = np.zeros(minlength, np.int64)
     for p in parts:
         total[:len(p)] += p

@@ -713,6 +713,11 @@ class RunManifest:
     def __init__(self, path):
         self.path = Path(path)
         self.items: Dict[str, dict] = {}
+        # N serve workers checkpoint concurrently into one manifest; the
+        # RLock makes every mutate-then-save atomic against the others
+        # (save() serializes the items dict, so an unlocked concurrent
+        # update would tear the JSON mid-dump)
+        self._mu = threading.RLock()
 
     @classmethod
     def load(cls, path) -> "RunManifest":
@@ -727,27 +732,32 @@ class RunManifest:
                    "attempts": 0})
 
     def status(self, name: str) -> Optional[str]:
-        entry = self.items.get(name)
-        return entry["status"] if entry else None
+        with self._mu:
+            entry = self.items.get(name)
+            return entry["status"] if entry else None
 
     def attempts(self, name: str) -> int:
-        entry = self.items.get(name)
-        return entry["attempts"] if entry else 0
+        with self._mu:
+            entry = self.items.get(name)
+            return entry["attempts"] if entry else 0
 
     def pending(self, name: str) -> None:
-        self._entry(name)
-        self.save()
+        with self._mu:
+            self._entry(name)
+            self.save()
 
     def start(self, name: str) -> None:
-        entry = self._entry(name)
-        entry["status"] = "running"
-        entry["attempts"] += 1
-        entry["error"] = None
-        self.save()
+        with self._mu:
+            entry = self._entry(name)
+            entry["status"] = "running"
+            entry["attempts"] += 1
+            entry["error"] = None
+            self.save()
 
     def advance(self, name: str, stage: str) -> None:
-        self._entry(name)["stage"] = stage
-        self.save()
+        with self._mu:
+            self._entry(name)["stage"] = stage
+            self.save()
 
     def stage_done(self, name: str, stage: str, outputs=()) -> None:
         """Checkpoint ``stage`` of item ``name`` as complete, recording the
@@ -756,17 +766,20 @@ class RunManifest:
         manifest flip: a crash there re-runs the stage on resume (idempotent
         and byte-identical), never skips an unfinished one."""
         from ..obs.ledger import artifact_hash  # lazy: obs imports ledger
+        # hash outside the lock: output hashing is real I/O, and other
+        # workers' checkpoints must not stall behind it
         recorded = {}
         for path in outputs:
             info = artifact_hash(Path(path))
             if info is not None:
                 recorded[str(path)] = info
         crash_point("post-stage", f"{name}/{stage}")
-        entry = self._entry(name)
-        entry["stage"] = stage
-        entry.setdefault("stages", {})[stage] = {
-            "done": True, "outputs": recorded, "ts_epoch": time.time()}
-        self.save()
+        with self._mu:
+            entry = self._entry(name)
+            entry["stage"] = stage
+            entry.setdefault("stages", {})[stage] = {
+                "done": True, "outputs": recorded, "ts_epoch": time.time()}
+            self.save()
 
     def stage_complete(self, name: str, stage: str, verify: bool = True) -> bool:
         """True when ``stage`` of ``name`` checkpointed AND (with ``verify``)
@@ -774,74 +787,86 @@ class RunManifest:
         deleted or doctored artifact demotes the stage to not-done, so
         resume re-runs rather than trusting a stale flag."""
         from ..obs.ledger import artifact_hash
-        entry = self.items.get(name) or {}
-        rec = (entry.get("stages") or {}).get(stage) or {}
+        with self._mu:
+            entry = self.items.get(name) or {}
+            rec = dict((entry.get("stages") or {}).get(stage) or {})
+            outputs = dict(rec.get("outputs") or {})
         if not rec.get("done"):
             return False
         if not verify:
             return True
-        for path, want in (rec.get("outputs") or {}).items():
+        for path, want in outputs.items():
             have = artifact_hash(Path(path))
             if have is None or have.get("sha256") != (want or {}).get("sha256"):
                 return False
         return True
 
     def stage_outputs(self, name: str, stage: str) -> Dict[str, dict]:
-        entry = self.items.get(name) or {}
-        rec = (entry.get("stages") or {}).get(stage) or {}
-        return dict(rec.get("outputs") or {})
+        with self._mu:
+            entry = self.items.get(name) or {}
+            rec = (entry.get("stages") or {}).get(stage) or {}
+            return dict(rec.get("outputs") or {})
 
     def last_stage(self, name: str) -> Optional[str]:
-        entry = self.items.get(name) or {}
-        return entry.get("stage")
+        with self._mu:
+            entry = self.items.get(name) or {}
+            return entry.get("stage")
 
     def annotate(self, name: str, **extra) -> None:
         """Attach scheduler extras (job spec, out_dir, ...) to an entry."""
-        self._entry(name).update(extra)
-        self.save()
+        with self._mu:
+            self._entry(name).update(extra)
+            self.save()
 
     def done(self, name: str) -> None:
-        entry = self._entry(name)
-        entry["status"] = "done"
-        entry["error"] = None
-        self.save()
+        with self._mu:
+            entry = self._entry(name)
+            entry["status"] = "done"
+            entry["error"] = None
+            self.save()
 
     def fail(self, name: str, error: str, stage: Optional[str] = None) -> None:
-        entry = self._entry(name)
-        entry["status"] = "failed"
-        entry["error"] = str(error)
-        if stage is not None:
-            entry["stage"] = stage
-        self.save()
+        with self._mu:
+            entry = self._entry(name)
+            entry["status"] = "failed"
+            entry["error"] = str(error)
+            if stage is not None:
+                entry["stage"] = stage
+            self.save()
 
     def counts(self) -> Dict[str, int]:
-        out: Dict[str, int] = {}
-        for entry in self.items.values():
-            out[entry["status"]] = out.get(entry["status"], 0) + 1
-        return out
+        with self._mu:
+            out: Dict[str, int] = {}
+            for entry in self.items.values():
+                out[entry["status"]] = out.get(entry["status"], 0) + 1
+            return out
 
     def save(self) -> None:
         self.path.parent.mkdir(parents=True, exist_ok=True)
-        payload = json.dumps({"version": self.VERSION, "items": self.items},
-                             indent=2, sort_keys=True)
-        fd, tmp = tempfile.mkstemp(dir=self.path.parent,
-                                   prefix=f"{self.path.name}.{os.getpid()}.",
-                                   suffix=".tmp")
-        try:
-            with os.fdopen(fd, "w") as f:
-                f.write(payload + "\n")
-                f.flush()
-                os.fsync(f.fileno())
-            crash_point("pre-artifact-rename", str(self.path))
-            # keep the previous good state reachable: a reader that lands in
-            # the window between the two renames (or after a crash there)
-            # falls back to the .bak via read_manifest
-            if self.path.is_file():
+        with self._mu:
+            payload = json.dumps({"version": self.VERSION,
+                                  "items": self.items},
+                                 indent=2, sort_keys=True)
+            fd, tmp = tempfile.mkstemp(
+                dir=self.path.parent,
+                prefix=f"{self.path.name}.{os.getpid()}.",
+                suffix=".tmp")
+            try:
+                with os.fdopen(fd, "w") as f:
+                    f.write(payload + "\n")
+                    f.flush()
+                    os.fsync(f.fileno())
+                crash_point("pre-artifact-rename", str(self.path))
+                # keep the previous good state reachable: a reader that
+                # lands in the window between the two renames (or after a
+                # crash there) falls back to the .bak via read_manifest
+                if self.path.is_file():
+                    with contextlib.suppress(OSError):
+                        os.replace(
+                            self.path,
+                            self.path.with_name(self.path.name + ".bak"))
+                os.replace(tmp, self.path)
+            except BaseException:
                 with contextlib.suppress(OSError):
-                    os.replace(self.path,
-                               self.path.with_name(self.path.name + ".bak"))
-            os.replace(tmp, self.path)
-        except BaseException:
-            with contextlib.suppress(OSError):
-                os.remove(tmp)
-            raise
+                    os.remove(tmp)
+                raise

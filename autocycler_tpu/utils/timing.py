@@ -39,6 +39,46 @@ DEVICE_KERNEL_BYTES = "autocycler_device_kernel_bytes_total"
 STAGE_SECONDS = "autocycler_stage_seconds_total"
 STAGE_LATENCY_HIST = "autocycler_stage_latency_seconds"
 SUBSTAGE_SECONDS = "autocycler_substage_seconds_total"
+DEVICE_TOKEN_WAIT = "autocycler_serve_device_token_wait_seconds_total"
+
+# the device token: when enabled (the multi-worker serve scheduler turns
+# it on), every device_dispatch serializes through this process-wide RLock
+# — one job on-chip at a time while other jobs' host stages overlap
+# freely. Disabled (the default, and workers=1) it costs nothing, keeping
+# single-worker daemons and CLI runs bit-for-bit identical to before.
+_token_lock = threading.RLock()
+_token_enabled = False
+
+
+def enable_device_token(enabled: bool) -> None:
+    """Turn device-dispatch serialization on/off (serve scheduler only)."""
+    global _token_enabled
+    with _token_lock:
+        _token_enabled = bool(enabled)
+
+
+def device_token_enabled() -> bool:
+    return _token_enabled
+
+
+@contextlib.contextmanager
+def _device_token(kernel: str):
+    """Hold the device token across one dispatch, counting the wait into
+    :data:`DEVICE_TOKEN_WAIT` (per kernel) so concurrency-aware SLO and
+    bench artifacts can see on-chip contention."""
+    if not _token_enabled:
+        yield
+        return
+    t0 = time.perf_counter()
+    _token_lock.acquire()
+    try:
+        metrics_registry.counter_inc(
+            DEVICE_TOKEN_WAIT, time.perf_counter() - t0,
+            help="seconds device dispatches waited for the serve device "
+                 "token", kernel=kernel)
+        yield
+    finally:
+        _token_lock.release()
 
 _last_lock = threading.Lock()
 _device_failure_last = ""
@@ -108,45 +148,51 @@ def device_dispatch(what: str = "", flops: float = None,
     if xprof_dir:
         xprof_cm, xprof_path = _maybe_xprof(xprof_dir, kernel)
     attrs = {"xprof": xprof_path} if xprof_path else {}
-    start = time.perf_counter()
-    try:
-        with trace.span(kernel, cat="device", phase=phase, **attrs):
-            yield
-    except Exception as e:
-        record_device_failure(
-            f"{kernel} raised {type(e).__name__}: {e}", exc=e)
-        raise
-    finally:
-        if xprof_cm is not None:
-            try:
-                xprof_cm.__exit__(None, None, None)
-            except Exception:  # noqa: BLE001
-                pass
-        elapsed = time.perf_counter() - start
-        reg = metrics_registry.registry()
-        reg.counter_inc(DEVICE_SECONDS, elapsed,
-                        help="host-observed seconds inside device dispatches")
-        reg.counter_inc(DEVICE_DISPATCHES, 1,
-                        help="device dispatch count")
-        reg.observe(DEVICE_DISPATCH_HIST, elapsed,
-                    help="per-dispatch host-observed latency",
-                    what=kernel)
-        reg.observe(DEVICE_KERNEL_HIST, elapsed,
-                    help="per-kernel dispatch latency, split first-call "
-                         "(compile) vs steady-state",
-                    kernel=kernel, phase=phase)
-        if flops:
-            reg.counter_inc(DEVICE_KERNEL_FLOPS, float(flops),
-                            help="useful FLOPs dispatched per kernel",
-                            kernel=kernel, phase=phase)
-        if bytes_moved:
-            reg.counter_inc(DEVICE_KERNEL_BYTES, float(bytes_moved),
-                            help="useful HBM bytes moved per kernel",
-                            kernel=kernel, phase=phase)
-        with _last_lock:
-            _first_seen.add(kernel)
-        if knob_bool("AUTOCYCLER_TIMINGS") and what:
-            log.message(f"[timing] device {what}: {format_duration(elapsed)}")
+    # the token (when the serve scheduler enabled it) is held across the
+    # timed region, so the dispatch histograms keep measuring pure on-chip
+    # time — the wait for the token lands in DEVICE_TOKEN_WAIT instead
+    with _device_token(kernel):
+        start = time.perf_counter()
+        try:
+            with trace.span(kernel, cat="device", phase=phase, **attrs):
+                yield
+        except Exception as e:
+            record_device_failure(
+                f"{kernel} raised {type(e).__name__}: {e}", exc=e)
+            raise
+        finally:
+            if xprof_cm is not None:
+                try:
+                    xprof_cm.__exit__(None, None, None)
+                except Exception:  # noqa: BLE001
+                    pass
+            elapsed = time.perf_counter() - start
+            reg = metrics_registry.registry()
+            reg.counter_inc(DEVICE_SECONDS, elapsed,
+                            help="host-observed seconds inside device "
+                                 "dispatches")
+            reg.counter_inc(DEVICE_DISPATCHES, 1,
+                            help="device dispatch count")
+            reg.observe(DEVICE_DISPATCH_HIST, elapsed,
+                        help="per-dispatch host-observed latency",
+                        what=kernel)
+            reg.observe(DEVICE_KERNEL_HIST, elapsed,
+                        help="per-kernel dispatch latency, split first-call "
+                             "(compile) vs steady-state",
+                        kernel=kernel, phase=phase)
+            if flops:
+                reg.counter_inc(DEVICE_KERNEL_FLOPS, float(flops),
+                                help="useful FLOPs dispatched per kernel",
+                                kernel=kernel, phase=phase)
+            if bytes_moved:
+                reg.counter_inc(DEVICE_KERNEL_BYTES, float(bytes_moved),
+                                help="useful HBM bytes moved per kernel",
+                                kernel=kernel, phase=phase)
+            with _last_lock:
+                _first_seen.add(kernel)
+            if knob_bool("AUTOCYCLER_TIMINGS") and what:
+                log.message(
+                    f"[timing] device {what}: {format_duration(elapsed)}")
 
 
 def device_kernel_snapshot() -> dict:

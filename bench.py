@@ -743,13 +743,22 @@ def bench_faultsmoke() -> None:
         sys.exit(1)
 
 
+SERVESMOKE_PATH = Path(__file__).resolve().parent / "SERVESMOKE.json"
+
+
 def bench_servesmoke() -> None:
     """Smoke the assembly-as-a-service path: start an in-process serve
     daemon, submit the same tiny isolate twice over real loopback HTTP, and
     check that (a) both jobs finish, (b) the warm second job beats the cold
     first (shared parse/repair caches + JIT already compiled), and (c) the
     daemon's outputs are byte-identical to a fresh CLI-path compress run
-    with caches disabled. One JSON line on stdout; exit 1 on failure."""
+    with caches disabled. Then the concurrency gate: the same 4 tiny jobs
+    as one batch against a 1-worker and a 3-worker daemon — outputs must
+    be byte-identical job for job, and on hosts with >= 3 cores the
+    3-worker wall must be < 0.8x the serial wall (the gate records the
+    speedup either way; it only *enforces* it where the hardware can
+    physically show one). Writes SERVESMOKE.json (surfaced by `bench.py
+    trend`); one JSON line on stdout; exit 1 on failure."""
     import contextlib
     import os
 
@@ -803,6 +812,11 @@ def bench_servesmoke() -> None:
         == (tmp / "ref" / name).read_bytes()
         for name in ("input_assemblies.gfa", "input_assemblies.yaml"))
     passed = states == ["done", "done"] and warm < cold and identical
+
+    # --- concurrency gate: 4 jobs as one batch, 1-worker vs 3-worker ---
+    conc = _servesmoke_concurrency(tmp, asm)
+    passed = passed and conc["passed"]
+
     # the latency split + SLO artifact: queue-wait vs execution per job,
     # the daemon's rolling-window quantiles/burn-rate, and the number of
     # sampler ticks the run produced (schema-tolerant consumers use .get)
@@ -820,10 +834,118 @@ def bench_servesmoke() -> None:
         "exec_s": [round(r["wall_s"], 3) for r in records],
         "slo": slo_report,
         "timeseries_ticks": len(read_timeseries(root / TIMESERIES_JSONL)),
+        "workers": conc["workers"],
+        "speedup": conc["speedup"],
+        "agg_queue_wait_s": conc["agg_queue_wait_s"],
+        "concurrency": conc,
     }
+    SERVESMOKE_PATH.write_text(json.dumps(artifact, indent=2) + "\n")
     print(json.dumps(artifact))
     if not passed:
         sys.exit(1)
+
+
+def _servesmoke_concurrency(tmp: Path, asm, jobs: int = 4,
+                            workers: int = 3) -> dict:
+    """The multi-worker throughput gate: submit ``jobs`` copies of the
+    same tiny isolate as ONE batch to a 1-worker daemon and to a
+    ``workers``-worker daemon, and compare walls + bytes. Byte-identity is
+    enforced unconditionally (concurrency must never change outputs); the
+    < 0.8x wall gate is enforced only when the host has at least
+    ``workers`` cores — a 1-core container cannot overlap CPU-bound jobs
+    and would fail on physics, not on a regression."""
+    import contextlib
+    import os
+
+    from autocycler_tpu.serve.client import request_json
+    from autocycler_tpu.serve.server import ServeHandle
+
+    walls = {}
+    waits = {}
+    devnull = open(os.devnull, "w")
+    try:
+        for label, n_workers in (("serial", 1), ("multi", workers)):
+            root = tmp / f"conc_{label}"
+            batch = {"command": "compress", "kmer": 51, "threads": 2,
+                     "batch": [
+                         {"assemblies_dir": str(asm),
+                          "out_dir": str(tmp / f"conc_out_{label}" / f"j{i}")}
+                         for i in range(jobs)]}
+            with contextlib.redirect_stderr(devnull):
+                handle = ServeHandle(root, port=0,
+                                     workers=n_workers).start()
+                try:
+                    t0 = time.perf_counter()
+                    status, parent = request_json(
+                        handle.endpoint, "POST", "/jobs", body=batch)
+                    assert status == 202, (status, parent)
+                    deadline = time.monotonic() + 600
+                    while True:
+                        status, parent = request_json(
+                            handle.endpoint, "GET", f"/jobs/{parent['id']}")
+                        if parent.get("state") in ("done", "failed"):
+                            break
+                        assert time.monotonic() < deadline, parent
+                        time.sleep(0.05)
+                    walls[label] = time.perf_counter() - t0
+                    waits[label] = parent.get("agg_queue_wait_s")
+                    assert parent.get("state") == "done", parent
+                finally:
+                    handle.stop()
+    finally:
+        devnull.close()
+
+    identical = all(
+        (tmp / "conc_out_serial" / f"j{i}" / name).read_bytes()
+        == (tmp / "conc_out_multi" / f"j{i}" / name).read_bytes()
+        for i in range(jobs)
+        for name in ("input_assemblies.gfa", "input_assemblies.yaml"))
+    speedup = walls["serial"] / walls["multi"] if walls["multi"] else None
+    cpu = os.cpu_count() or 1
+    gate_enforced = cpu >= workers
+    wall_ok = (not gate_enforced) \
+        or (walls["multi"] < 0.8 * walls["serial"])
+    return {
+        "passed": bool(identical and wall_ok),
+        "jobs": jobs,
+        "workers": workers,
+        "cpu_count": cpu,
+        "serial_wall_s": round(walls["serial"], 3),
+        "multi_wall_s": round(walls["multi"], 3),
+        "speedup": round(speedup, 2) if speedup else None,
+        "gate_enforced": gate_enforced,
+        "wall_ok": wall_ok,
+        "byte_identical": identical,
+        "agg_queue_wait_s": waits,
+    }
+
+
+def servesmoke_row(root=None) -> dict:
+    """The latest servesmoke artifact as one trend row; every field
+    optional (absent/invalid artifact → None-valued row, never a raise)."""
+    path = Path(root) / "SERVESMOKE.json" if root is not None \
+        else SERVESMOKE_PATH
+    row = {"present": False, "passed": None, "warm_speedup": None,
+           "byte_identical": None, "workers": None, "speedup": None,
+           "gate_enforced": None, "agg_queue_wait_s": None}
+    try:
+        data = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return row
+    if not isinstance(data, dict):
+        return row
+    conc = data.get("concurrency") or {}
+    row.update({
+        "present": True,
+        "passed": data.get("passed"),
+        "warm_speedup": data.get("warm_speedup"),
+        "byte_identical": data.get("byte_identical"),
+        "workers": data.get("workers"),
+        "speedup": data.get("speedup"),
+        "gate_enforced": conc.get("gate_enforced"),
+        "agg_queue_wait_s": data.get("agg_queue_wait_s"),
+    })
+    return row
 
 
 LINTSMOKE_PATH = Path(__file__).resolve().parent / "LINTSMOKE.json"
@@ -1725,10 +1847,23 @@ def bench_trend() -> None:
               f"crash points recovered byte-identically "
               f"in {fmt(chaos.get('wall_s'), '.1f')}s  (CHAOSSMOKE.json)",
               file=sys.stderr)
+    serve = servesmoke_row()
+    if serve.get("present"):
+        verdict = "ok" if serve.get("passed") else "FAIL"
+        gate = "enforced" if serve.get("gate_enforced") \
+            else "recorded only (too few cores)"
+        print("", file=sys.stderr)
+        print(f"servesmoke: {verdict} "
+              f"{fmt(serve.get('workers'))} workers "
+              f"{fmt(serve.get('speedup'), '.2f')}x over serial "
+              f"(gate {gate}, warm {fmt(serve.get('warm_speedup'), '.2f')}x, "
+              f"bytes identical: {serve.get('byte_identical')})  "
+              f"(SERVESMOKE.json)",
+              file=sys.stderr)
     print(json.dumps({"bench": "trend", "rounds": rows,
                       "multichip": mrows, "lintsmoke": lint,
                       "sketchsmoke": sketch, "streamsmoke": stream,
-                      "chaossmoke": chaos}))
+                      "chaossmoke": chaos, "servesmoke": serve}))
 
 
 def main() -> None:

@@ -263,6 +263,70 @@ def test_locks_no_module_lock_no_findings(tmp_path):
     assert findings == []
 
 
+# the pre-fix shape of the serve scheduler's worker-pool state: a class
+# that declares its lock discipline (_GUARDED_BY) but mutates the busy
+# map and job table without holding the lock
+PRE_FIX_UNLOCKED_FIELD = """
+    import threading
+
+    class Sched:
+        _GUARDED_BY = {"_lock": ("_jobs", "_busy")}
+
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._jobs = {}
+            self._busy = {}
+
+        def set_busy(self, worker, job_id):
+            self._busy[worker] = job_id
+
+        def clear_busy(self, worker):
+            self._busy.pop(worker, None)
+"""
+
+
+def test_locks_guarded_field_unlocked(tmp_path):
+    findings = lint_source(tmp_path, PRE_FIX_UNLOCKED_FIELD)
+    assert rules_of(findings) == ["locks.guarded-field"] * 2
+    assert all("_busy" in f.message for f in findings)
+
+
+def test_locks_guarded_field_clean(tmp_path):
+    # locked mutations, __init__ construction, *_locked contract methods
+    # and unguarded fields are all exempt
+    findings = lint_source(tmp_path, """
+        import threading
+
+        class Sched:
+            _GUARDED_BY = {"_lock": ("_jobs",)}
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._jobs = {}
+
+            def add(self, job):
+                with self._lock:
+                    self._jobs[job.id] = job
+
+            def _admit_locked(self, job):
+                self._jobs[job.id] = job
+
+            def note(self, text):
+                self._note = text
+    """)
+    assert findings == []
+
+
+def test_locks_guarded_field_without_declaration_is_silent(tmp_path):
+    # no _GUARDED_BY literal -> the rule does not bind to the class
+    findings = lint_source(tmp_path, """
+        class Plain:
+            def set(self, k, v):
+                self._jobs = {k: v}
+    """)
+    assert findings == []
+
+
 def test_locks_thread_daemon(tmp_path):
     findings = lint_source(tmp_path, """
         import threading
@@ -594,6 +658,7 @@ def test_rule_ids_are_stable():
     assert set(rule_ids()) == {
         "knobs.direct-read", "knobs.undeclared", "knobs.docs-drift",
         "locks.unguarded-global", "locks.thread-daemon",
+        "locks.guarded-field",
         "purity.impure-call",
         "readers.raise", "readers.unguarded-io",
         "metrics.name", "metrics.label", "metrics.span",

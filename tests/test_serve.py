@@ -213,7 +213,9 @@ def test_queue_full_returns_503(tmp_path, capsys):
     from autocycler_tpu.serve.server import ServeHandle
 
     gate = threading.Event()
-    handle = ServeHandle(tmp_path / "serve", port=0, queue_size=1)
+    # workers=1: the test's arithmetic (one stuck worker + queue of one)
+    # depends on exactly one job executing at a time
+    handle = ServeHandle(tmp_path / "serve", port=0, queue_size=1, workers=1)
     handle.scheduler._run_spec = lambda spec, out_dir, **kw: gate.wait(30)
     handle.start()
     try:
@@ -349,7 +351,7 @@ def test_daemon_restart_replays_queue_and_resumes_running(tmp_path,
     # stage raises after compress checkpointed), then the manifest entry
     # is flipped back to running — exactly what a kill -9 mid-cluster
     # leaves on disk
-    sched1 = Scheduler(root)
+    sched1 = Scheduler(root, workers=1)
     j1 = sched1.submit(spec_pipe)
     j2 = sched1.submit(spec_comp)
     j3 = sched1.submit(spec_comp)
@@ -368,7 +370,8 @@ def test_daemon_restart_replays_queue_and_resumes_running(tmp_path,
     checkpoint_mtime = compress_gfa.stat().st_mtime_ns
 
     # daemon #2 on the same root replays all three in submission order
-    sched2 = Scheduler(root)
+    # (workers=1 so the finished-epoch ordering below is deterministic)
+    sched2 = Scheduler(root, workers=1)
     err = capsys.readouterr().err
     assert f"{j1.id} resuming from last checkpointed stage" in err
     assert f"{j2.id} re-enqueued after restart" in err

@@ -1,0 +1,465 @@
+"""The multi-worker serve scheduler: concurrent jobs in one warm daemon.
+
+Covers the concurrency contract end to end: N workers executing jobs with
+interleaved-but-disjoint trace/QC/ledger scopes, batch fan-out under one
+parent id, restart replay of SEVERAL interrupted running jobs (the
+single-running assumption was the pre-fix bug), fault isolation when one
+job crashes mid-run beside a healthy sibling, the shared-secret token
+gate, and the device-token serialization switch.
+"""
+
+import json
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+pytestmark = pytest.mark.serve
+
+
+def _wait_until(predicate, timeout=60.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _request(endpoint, method, path, body=None):
+    from autocycler_tpu.serve.client import request_json
+    return request_json(endpoint, method, path, body=body)
+
+
+# ---- concurrent isolation under N workers ----
+
+
+def test_concurrent_jobs_have_disjoint_scopes(tmp_path, capsys):
+    """Three jobs running SIMULTANEOUSLY (a barrier proves the overlap)
+    each get their own trace run, their own QC journal entries and their
+    own ledger input lineage — nothing cross-contaminates, and the shared
+    journal/ledger tables are drained once the jobs finish."""
+    from autocycler_tpu.obs import ledger
+    from autocycler_tpu.obs import qc as obs_qc
+    from autocycler_tpu.serve.protocol import JobSpec
+    from autocycler_tpu.serve.scheduler import Scheduler
+
+    root = tmp_path / "serve"
+    sched = Scheduler(root, workers=3)
+    barrier = threading.Barrier(3, timeout=30)
+
+    inputs = {}
+    for tag in ("a", "b", "c"):
+        p = tmp_path / f"input_{tag}.fasta"
+        p.write_text(f">seq_{tag}\nACGT\n")
+        inputs[f"/asm_{tag}"] = p
+
+    def fake_run(spec, out_dir, job_id=None):
+        barrier.wait()                      # all three on-CPU at once
+        obs_qc.record("compress", isolate_dir=spec.assemblies_dir)
+        ledger.record_inputs([inputs[spec.assemblies_dir]])
+        ledger.record_stage("compress", outputs=())
+
+    sched._run_spec = fake_run
+    jobs = [sched.submit(JobSpec(assemblies_dir=f"/asm_{t}"))
+            for t in ("a", "b", "c")]
+    sched.start()
+    try:
+        assert _wait_until(lambda: all(j.state == "done" for j in jobs))
+    finally:
+        sched.shutdown()
+
+    for job, tag in zip(jobs, ("a", "b", "c")):
+        qc_report = json.loads((job.run_dir / "qc_report.json").read_text())
+        isolates = {e.get("isolate") for e in qc_report["entries"]}
+        assert isolates == {job.id}, (job.id, isolates)
+        assert all(e["metrics"]["isolate_dir"] == f"/asm_{tag}"
+                   for e in qc_report["entries"])
+        led = json.loads((job.run_dir / "ledger.json").read_text())
+        # exactly this job's input lineage, plus the cache-lineage block
+        assert set(led["inputs"]) == {str(inputs[f"/asm_{tag}"])}
+        assert {s["isolate"] for s in led["stages"]} == {job.id}
+        assert "caches" in led and "parse" in led["caches"]
+        # each job's trace run carries its own span stream
+        trace_text = (job.run_dir / "trace.jsonl").read_text()
+        assert f"job/{job.id}" in trace_text
+        other = [j.id for j in jobs if j.id != job.id]
+        assert not any(f"job/{o}" in trace_text for o in other)
+
+    # per-job drain keeps the long-lived daemon's shared tables bounded:
+    # nothing tagged with these jobs survives in the shared journal/ledger
+    # (entries other tests left behind are not ours to assert about)
+    ids = {j.id for j in jobs}
+    assert not [e for e in obs_qc.entries() if e.get("isolate") in ids]
+    led_after = ledger.build_ledger()
+    assert not {str(p) for p in inputs.values()} & set(led_after["inputs"])
+    assert not [s for s in led_after["stages"] if s.get("isolate") in ids]
+    capsys.readouterr()
+
+
+def test_worker_gauges_and_health(tmp_path, capsys):
+    """/healthz surfaces workers/busy_workers/utilization while jobs are
+    in flight, and the worker gauges land in the registry."""
+    from autocycler_tpu.obs import metrics_registry
+    from autocycler_tpu.serve.scheduler import BUSY_GAUGE, WORKERS_GAUGE
+    from autocycler_tpu.serve.server import ServeHandle
+
+    gate = threading.Event()
+    started = threading.Event()
+
+    handle = ServeHandle(tmp_path / "serve", port=0, workers=2)
+
+    def stuck(spec, out_dir, job_id=None):
+        started.set()
+        gate.wait(30)
+
+    handle.scheduler._run_spec = stuck
+    handle.start()
+    try:
+        spec = {"assemblies_dir": str(tmp_path)}
+        status, _ = _request(handle.endpoint, "POST", "/jobs", body=spec)
+        assert status == 202
+        assert started.wait(10)
+        status, health = _request(handle.endpoint, "GET", "/healthz")
+        assert status == 200
+        assert health["workers"] == 2
+        assert health["busy_workers"] == 1
+        assert health["utilization"] == 0.5
+        reg = metrics_registry.registry()
+        assert reg.value(WORKERS_GAUGE) == 2
+        assert reg.value(BUSY_GAUGE) == 1
+        gate.set()
+        assert _wait_until(handle.scheduler.idle)
+        _, health = _request(handle.endpoint, "GET", "/healthz")
+        assert health["busy_workers"] == 0
+    finally:
+        gate.set()
+        handle.stop()
+    capsys.readouterr()
+
+
+# ---- restart replay: several interrupted running jobs ----
+
+
+def test_restart_replays_all_interrupted_running_jobs(tmp_path, capsys):
+    """The pre-fix bug: replay assumed at most one job could be 'running'.
+    A multi-worker daemon dies with N of them — a new scheduler must
+    resume EVERY interrupted job, in true submission order (the persisted
+    submit timestamp, not the lexicographic id sort)."""
+    from autocycler_tpu.serve.scheduler import MANIFEST_NAME, Scheduler
+    from autocycler_tpu.utils.resilience import RunManifest
+
+    root = tmp_path / "serve"
+    root.mkdir()
+    manifest = RunManifest.load(root / MANIFEST_NAME)
+    # three jobs all caught mid-run; submitted_epoch deliberately disagrees
+    # with the id order (job-000002 submitted first)
+    epochs = {"job-000001": 100.0, "job-000002": 50.0, "job-000003": 75.0}
+    for name, epoch in epochs.items():
+        manifest.pending(name)
+        manifest.annotate(name, spec={"assemblies_dir": f"/asm/{name}"},
+                          out_dir=str(root / "jobs" / name / "out"),
+                          submitted_epoch=epoch)
+        manifest.start(name)
+
+    sched = Scheduler(root, workers=1)
+    err = capsys.readouterr().err
+    for name in epochs:
+        assert f"{name} resuming from last checkpointed stage" in err
+
+    replayed = {j.id: j for j in sched.jobs()}
+    assert set(replayed) == set(epochs)
+    assert all(j.resumed for j in replayed.values())
+
+    order = []
+    sched._run_spec = lambda spec, out_dir, job_id=None: \
+        order.append(spec.assemblies_dir)
+    sched.start()
+    try:
+        assert _wait_until(lambda: all(
+            j.state == "done" for j in replayed.values()))
+    finally:
+        sched.shutdown()
+    # submission order: epoch 50 (job 2), 75 (job 3), 100 (job 1)
+    assert order == ["/asm/job-000002", "/asm/job-000003",
+                     "/asm/job-000001"]
+    capsys.readouterr()
+
+
+# ---- fault isolation: one job crashes, the sibling completes ----
+
+
+def test_mid_job_crash_leaves_sibling_clean(tmp_path, monkeypatch, capsys):
+    """Two jobs in flight on two workers; one dies at a registered crash
+    point (the chaos harness's deterministic exit 43, simulated through
+    the patchable ``resilience._exit`` seam). The sibling must finish
+    cleanly, the crashed job is quarantined, and the daemon keeps
+    accepting work."""
+    from autocycler_tpu.serve.protocol import JobSpec
+    from autocycler_tpu.serve.scheduler import Scheduler
+    from autocycler_tpu.utils import resilience as rz
+
+    codes = []
+
+    def fake_exit(code):
+        codes.append(code)
+        raise RuntimeError(f"simulated crash exit {code}")
+
+    monkeypatch.setattr(rz, "_exit", fake_exit)
+    monkeypatch.setenv("AUTOCYCLER_CRASH_POINTS", "post-stage@1")
+    rz._reset_crash_hits_for_tests()
+
+    root = tmp_path / "serve"
+    sched = Scheduler(root, workers=2)
+    barrier = threading.Barrier(2, timeout=30)
+
+    def fake_run(spec, out_dir, job_id=None):
+        barrier.wait()                   # both jobs mid-flight together
+        rz.crash_point("post-stage", f"{job_id}/compress")
+
+    sched._run_spec = fake_run
+    j1 = sched.submit(JobSpec(assemblies_dir="/asm/one"))
+    j2 = sched.submit(JobSpec(assemblies_dir="/asm/two"))
+    sched.start()
+    try:
+        assert _wait_until(lambda: all(
+            j.state in ("done", "failed") for j in (j1, j2)))
+        states = sorted(j.state for j in (j1, j2))
+        assert states == ["done", "failed"], states
+        assert codes == [rz.CRASH_EXIT]
+        crashed = j1 if j1.state == "failed" else j2
+        assert "simulated crash" in crashed.error
+        assert sched.manifest.items[crashed.id]["status"] == "failed"
+
+        # the daemon is still serving: a fresh job after the crash
+        sched._run_spec = lambda spec, out_dir, job_id=None: None
+        j3 = sched.submit(JobSpec(assemblies_dir="/asm/three"))
+        assert _wait_until(lambda: j3.state == "done")
+    finally:
+        sched.shutdown()
+        rz._reset_crash_hits_for_tests()
+    capsys.readouterr()
+
+
+# ---- batch fan-out ----
+
+
+def test_batch_fanout_aggregation_http(tmp_path, capsys):
+    """POST /jobs with a batch body fans into child jobs under one parent;
+    the parent record aggregates states and queue waits; GET /jobs lists
+    batches; per-item validation errors name the failing item."""
+    from autocycler_tpu.serve.server import ServeHandle
+
+    handle = ServeHandle(tmp_path / "serve", port=0, workers=2)
+    handle.scheduler._run_spec = \
+        lambda spec, out_dir, job_id=None: time.sleep(0.02)
+    handle.start()
+    try:
+        body = {"command": "compress", "kmer": 31,
+                "batch": [{"assemblies_dir": "/asm/a"},
+                          {"assemblies_dir": "/asm/b", "kmer": 51}]}
+        status, parent = _request(handle.endpoint, "POST", "/jobs",
+                                  body=body)
+        assert status == 202
+        assert parent["kind"] == "batch" and parent["jobs"] == 2
+        # shared defaults merged under each child, child's own field wins
+        kmers = [c["spec"]["kmer"] for c in parent["children"]]
+        assert kmers == [31, 51]
+        assert all(c["parent"] == parent["id"] for c in parent["children"])
+
+        def agg():
+            return _request(handle.endpoint, "GET",
+                            f"/jobs/{parent['id']}")[1]
+
+        assert _wait_until(lambda: agg()["state"] == "done")
+        final = agg()
+        assert final["states"] == {"done": 2}
+        assert final["agg_queue_wait_s"] is not None
+        status, listing = _request(handle.endpoint, "GET", "/jobs")
+        assert [b["id"] for b in listing["batches"]] == [parent["id"]]
+
+        # per-item validation, whole-batch atomicity
+        status, err = _request(
+            handle.endpoint, "POST", "/jobs",
+            body={"batch": [{"assemblies_dir": "/ok"}, {"kmer": 51}]})
+        assert status == 400 and "batch item 1" in err["error"]
+    finally:
+        handle.stop()
+    capsys.readouterr()
+
+
+def test_batch_rejected_whole_when_queue_cannot_fit(tmp_path, capsys):
+    """All-or-nothing admission: a batch larger than the free queue slots
+    bounces with 503 and admits NO children."""
+    from autocycler_tpu.serve.protocol import JobSpec, parse_batch_spec
+    from autocycler_tpu.serve.scheduler import QueueFullError, Scheduler
+
+    sched = Scheduler(tmp_path / "serve", capacity=3, workers=1)
+    specs = parse_batch_spec(
+        {"batch": [{"assemblies_dir": f"/asm/{i}"} for i in range(4)]})
+    with pytest.raises(QueueFullError):
+        sched.submit_batch(specs)
+    assert sched.jobs() == [] and sched.batches() == []
+    # a fitting batch still admits, sharing the id sequence with jobs
+    ok = sched.submit_batch(specs[:2])
+    assert ok["jobs"] == 2
+    solo = sched.submit(JobSpec(assemblies_dir="/asm/solo"))
+    assert solo.id == "job-000004"
+    capsys.readouterr()
+
+
+def test_batch_parents_survive_restart(tmp_path, capsys):
+    """A restarted daemon rebuilds the fan-out map from the manifest: the
+    parent record keeps answering and pending children replay."""
+    from autocycler_tpu.serve.protocol import parse_batch_spec
+    from autocycler_tpu.serve.scheduler import Scheduler
+
+    root = tmp_path / "serve"
+    sched1 = Scheduler(root, workers=1)
+    specs = parse_batch_spec(
+        {"batch": [{"assemblies_dir": "/asm/a"},
+                   {"assemblies_dir": "/asm/b"}]})
+    parent = sched1.submit_batch(specs)
+    # daemon dies before the worker ever starts; children stay pending
+
+    sched2 = Scheduler(root, workers=2)
+    record = sched2.batch_record(parent["id"])
+    assert record is not None and record["jobs"] == 2
+    assert {c["parent"] for c in record["children"]} == {parent["id"]}
+    sched2._run_spec = lambda spec, out_dir, job_id=None: None
+    sched2.start()
+    try:
+        assert _wait_until(
+            lambda: sched2.batch_record(parent["id"])["state"] == "done")
+    finally:
+        sched2.shutdown()
+    capsys.readouterr()
+
+
+# ---- shared-secret token ----
+
+
+def _raw_get(endpoint, path, headers=None):
+    import http.client
+    from urllib.parse import urlparse
+
+    u = urlparse(endpoint)
+    conn = http.client.HTTPConnection(u.hostname, u.port, timeout=30)
+    try:
+        conn.request("GET", path, headers=headers or {})
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), resp.read()
+    finally:
+        conn.close()
+
+
+def test_token_gate_401_and_roundtrip(tmp_path, monkeypatch, capsys):
+    """With AUTOCYCLER_SERVE_TOKEN set, every route 401s without the
+    secret (Bearer or X-Autocycler-Token both accepted), the client
+    auto-attaches it, and the value never reaches serve.json or logs."""
+    monkeypatch.setenv("AUTOCYCLER_SERVE_TOKEN", "s3cret-t0ken")
+    from autocycler_tpu.serve.server import ServeHandle
+
+    handle = ServeHandle(tmp_path / "serve", port=0, workers=1)
+    handle.scheduler._run_spec = lambda spec, out_dir, job_id=None: None
+    handle.start()
+    try:
+        status, headers, _ = _raw_get(handle.endpoint, "/healthz")
+        assert status == 401
+        assert headers.get("WWW-Authenticate") == "Bearer"
+        status, _, _ = _raw_get(handle.endpoint, "/healthz",
+                                headers={"Authorization": "Bearer wrong"})
+        assert status == 401
+        status, _, _ = _raw_get(
+            handle.endpoint, "/healthz",
+            headers={"X-Autocycler-Token": "s3cret-t0ken"})
+        assert status == 200
+        # the client reads the knob and attaches the Bearer header itself
+        status, health = _request(handle.endpoint, "GET", "/healthz")
+        assert status == 200 and health["status"] == "ok"
+
+        info = json.loads(
+            (handle.root / "serve.json").read_text())
+        assert info["auth"] == "token"
+        assert "s3cret-t0ken" not in json.dumps(info)
+    finally:
+        handle.stop()
+    out = capsys.readouterr()
+    assert "s3cret-t0ken" not in out.out + out.err
+
+
+def test_non_loopback_bind_refused_without_token(tmp_path, monkeypatch):
+    from autocycler_tpu.serve.server import ServeHandle
+    from autocycler_tpu.utils.resilience import InputError
+
+    monkeypatch.delenv("AUTOCYCLER_SERVE_TOKEN", raising=False)
+    with pytest.raises(InputError, match="AUTOCYCLER_SERVE_TOKEN"):
+        ServeHandle(tmp_path / "serve", host="0.0.0.0", port=0)
+    # with a token the non-loopback bind is allowed
+    monkeypatch.setenv("AUTOCYCLER_SERVE_TOKEN", "t")
+    handle = ServeHandle(tmp_path / "serve2", host="0.0.0.0", port=0)
+    try:
+        assert handle.token == "t"
+    finally:
+        handle.server.server_close()
+        handle.scheduler.shutdown(wait=False)
+
+
+def test_token_redacted_from_ledger_and_snapshot(monkeypatch):
+    """The secret never lands in forensics artifacts: the ledger's env
+    block and the sentinel environment snapshot both redact it."""
+    monkeypatch.setenv("AUTOCYCLER_SERVE_TOKEN", "hunter2")
+    from autocycler_tpu.obs.ledger import build_ledger
+    from autocycler_tpu.obs.sentinel import environment_snapshot
+
+    led = build_ledger()
+    assert led["env"].get("AUTOCYCLER_SERVE_TOKEN") == "<redacted>"
+    assert "hunter2" not in json.dumps(led)
+    snap = environment_snapshot()
+    assert snap["env"].get("AUTOCYCLER_SERVE_TOKEN") == "<redacted>"
+    assert "hunter2" not in json.dumps(snap)
+
+
+# ---- device token ----
+
+
+def test_device_token_tracks_worker_count(tmp_path):
+    """workers>1 turns device-dispatch serialization on; workers=1 turns
+    it off (the bit-for-bit single-worker mode)."""
+    from autocycler_tpu.serve.scheduler import Scheduler
+    from autocycler_tpu.utils import timing
+
+    Scheduler(tmp_path / "s2", workers=2)
+    assert timing.device_token_enabled()
+    Scheduler(tmp_path / "s1", workers=1)
+    assert not timing.device_token_enabled()
+
+
+def test_device_token_serializes_dispatches(tmp_path):
+    """With the token enabled, two threads inside ``_device_token`` never
+    overlap — one job on-chip at a time."""
+    from autocycler_tpu.utils import timing
+
+    timing.enable_device_token(True)
+    try:
+        active = []
+        overlap = []
+
+        def one(tag):
+            with timing._device_token(f"k_{tag}"):
+                active.append(tag)
+                if len(active) > 1:
+                    overlap.append(tuple(active))
+                time.sleep(0.05)
+                active.remove(tag)
+
+        threads = [threading.Thread(target=one, args=(t,), daemon=True)
+                   for t in ("a", "b")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        assert overlap == []
+    finally:
+        timing.enable_device_token(False)
