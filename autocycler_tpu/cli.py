@@ -46,6 +46,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--resume", action="store_true",
                    help="replay a previous run from its batch_manifest.json, "
                         "retrying only failed/pending isolates")
+    p.add_argument("--fleet", choices=["off", "on", "auto"], default=None,
+                   help="route the run through the sharded fleet runner "
+                        "(bucketed shards, mesh-sharded distances, prefetched "
+                        "loads); default: the AUTOCYCLER_FLEET_MODE knob")
     p.add_argument("-t", "--threads", type=int, default=8)
 
     p = sub.add_parser("clean",
@@ -308,7 +312,7 @@ def dispatch(args) -> int:
         from .commands.batch import batch
         return batch(args.assemblies_parent, args.out_parent, args.kmer,
                      args.max_contigs, resume=args.resume,
-                     threads=args.threads)
+                     threads=args.threads, fleet=args.fleet)
     elif args.command == "clean":
         from .commands.clean import clean
         clean(args.in_gfa, args.out_gfa, args.remove, args.duplicate,

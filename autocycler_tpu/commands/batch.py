@@ -24,6 +24,20 @@ partial failure (2), and ``--resume`` replays only failed/pending isolates
 from the manifest. This mirrors the reference's per-assembler tolerance
 (helper.rs:645-654) one level up: some of N isolates failing must not cost
 the other N-1 their multi-hour run.
+
+Fleet mode (``--fleet`` / ``AUTOCYCLER_FLEET_MODE``, parallel/fleet.py):
+instead of one global compress -> distances -> cluster -> finalise sweep,
+isolates are packed into size-bucketed shards sized to the device mesh.
+Each shard's contraction is one device dispatch sharded over the isolate
+axis (parallel.mesh.shard_leading_axis), padded up a power-of-two shape
+ladder so XLA compiles once per bucket, and the host load/encode of
+upcoming isolates runs ahead on the shared pool, overlapping the current
+shard's device work. The serial path stays the oracle: per-isolate outputs
+are byte-identical by construction (same helpers, same integer device
+math), which `bench.py fleetsmoke` and tests/test_fleet.py enforce. The
+``mid-fleet-shard`` crash point between a shard's durable compress
+checkpoints and its cluster stage makes preemption mid-shard a resumable
+event (chaos-harness covered).
 """
 
 from __future__ import annotations
@@ -31,7 +45,7 @@ from __future__ import annotations
 import gc
 import os
 from pathlib import Path
-from typing import List
+from typing import List, NamedTuple, Optional
 
 from ..models.simplify import simplify_structure
 from ..obs import ledger, trace
@@ -52,6 +66,15 @@ from .trim import trim
 MANIFEST_NAME = "batch_manifest.json"
 
 
+class IsolateJob(NamedTuple):
+    """One isolate of a (fleet) batch: where its assemblies live and where
+    its outputs go. The CLI derives these from isolate subdirectories;
+    serve's fleet route derives them from batch job specs."""
+    name: str
+    asm_dir: Path
+    out_dir: Path
+
+
 def find_isolate_dirs(parent) -> List[Path]:
     parent = Path(parent)
     if not parent.is_dir():
@@ -62,18 +85,157 @@ def find_isolate_dirs(parent) -> List[Path]:
     return isolates
 
 
+def _cluster_outputs(out_dir: Path) -> List[Path]:
+    clustering = out_dir / "clustering"
+    return [clustering / "pairwise_distances.phylip",
+            clustering / "clustering.newick",
+            clustering / "clustering.tsv",
+            clustering / "clustering.yaml"] \
+        + sorted(clustering.glob("qc_*/cluster_*/1_untrimmed.gfa"))
+
+
+def _load_isolate(asm_dir, out_dir: Path, k_size: int, max_contigs: int,
+                  threads: int):
+    """The host side of one isolate's compress: load + parse + encode +
+    end-repair. Shared verbatim by the serial loop and the fleet prefetch
+    lane, so both paths produce identical sequences by construction."""
+    from ..metrics import InputAssemblyMetrics
+    from ..utils.cache import open_cache
+
+    # warm-start caches live under the isolate's out dir, so a --resume
+    # (or repeat) run skips load+encode+repair for isolates whose inputs
+    # have not changed
+    sequences, _ = load_sequences(asm_dir, k_size, InputAssemblyMetrics(),
+                                  max_contigs, threads,
+                                  cache=open_cache(out_dir))
+    return sequences
+
+
+def _build_isolate(out_dir: Path, sequences, k_size: int, threads: int):
+    """Build + simplify + persist one isolate's unitig graph (the device
+    side of compress). Must run one isolate at a time: the stream spill
+    root is process-global state."""
+    # streamed k-mer spill lives under the isolate's out dir, so bins from
+    # concurrent/killed batch runs never collide
+    from ..stream import prepare_stream_root
+    prepare_stream_root(out_dir)
+    graph = build_unitig_graph(sequences, k_size, threads=threads)
+    simplify_structure(graph, sequences)
+    os.makedirs(out_dir, exist_ok=True)
+    graph.save_gfa(out_dir / "input_assemblies.gfa", sequences)
+    obs_qc.compress_qc(graph, sequences)
+    ledger.record_stage(
+        "compress", outputs=[out_dir / "input_assemblies.gfa"])
+    return graph
+
+
+def _screen_and_finalise(jobs: List[IsolateJob], mesh, errs, manifest,
+                         completed: List[str]) -> None:
+    """Trim screen + trim/resolve/combine for clustered isolates: ONE
+    batched device DP screens every isolate's overlap jobs, then each
+    isolate finalises under quarantine. Serial batch calls this once over
+    the whole run; fleet mode calls it per shard — per-isolate outputs are
+    identical either way because every DP job's verdict/traceback depends
+    only on that job."""
+    from ..models import UnitigGraph
+
+    iso_cluster_dirs = {}
+    graphs = {}
+    with stage_timer("batch/trim_screen"):
+        for job in jobs:
+            qc_pass = job.out_dir / "clustering" / "qc_pass"
+            dirs = sorted(d for d in qc_pass.iterdir() if d.is_dir()) \
+                if qc_pass.is_dir() else []
+            # per-isolate graph loading is quarantined too: one unreadable
+            # cluster GFA must not sink the whole batched screen
+            with errs.quarantine(job.name):
+                for cdir in dirs:
+                    graphs[cdir] = UnitigGraph.from_gfa_file(
+                        cdir / "1_untrimmed.gfa")
+            if errs.failed(job.name):
+                manifest.fail(job.name, str(errs.errors[job.name].cause),
+                              stage="trim")
+                for cdir in dirs:
+                    graphs.pop(cdir, None)
+            else:
+                iso_cluster_dirs[job.name] = dirs
+        cluster_dirs = [d for dirs in iso_cluster_dirs.values()
+                        for d in dirs]
+        screens = _batched_trim_screens(cluster_dirs, graphs, mesh=mesh)
+    n_all = sum(len(s) for s in screens.values())
+    n_dev = sum(isinstance(v, list) for s in screens.values()
+                for v in s.values())
+    n_host = sum(v is True for s in screens.values() for v in s.values())
+    log.message(f"{n_all} trim DPs screened; {n_dev} alignments decoded from "
+                f"the device traceback; {n_host} need the full host DP")
+    log.message()
+
+    with stage_timer("batch/finalise"):
+        for job in jobs:
+            if job.name not in iso_cluster_dirs:
+                continue
+            with trace.span(f"isolate/{job.name}", cat="isolate",
+                            stage="finalise"), obs_qc.scope(job.name), \
+                    errs.quarantine(job.name):
+                for cdir in iso_cluster_dirs[job.name]:
+                    trimmed = trim(cdir, dp_screen=screens[cdir],
+                                   preloaded=graphs.pop(cdir))
+                    resolve(cdir, preloaded=trimmed)
+                    del trimmed   # reference-cyclic; drop before collecting
+                    gc.collect()
+                qc_pass = job.out_dir / "clustering" / "qc_pass"
+                finals = sorted(qc_pass.glob("cluster_*/5_final.gfa")) \
+                    if qc_pass.is_dir() else []
+                if finals:
+                    combine(job.out_dir, finals)
+            if errs.failed(job.name):
+                manifest.fail(job.name, str(errs.errors[job.name].cause),
+                              stage="finalise")
+            else:
+                manifest.stage_done(
+                    job.name, "finalise",
+                    outputs=[job.out_dir / "consensus_assembly.gfa",
+                             job.out_dir / "consensus_assembly.fasta"])
+                manifest.done(job.name)
+                completed.append(job.name)
+
+
+def _summarise(completed: List[str], errs, manifest_path: Path,
+               out_parent: Path, n_todo: int) -> int:
+    log.section_header("Finished!")
+    n_failed = len(errs)
+    log.message(f"{len(completed)} isolate(s) complete, {n_failed} failed "
+                f"(statuses recorded in {manifest_path})")
+    if n_failed:
+        for name in sorted(errs.errors):
+            log.message(f"  FAILED {name}: {errs.errors[name].cause}")
+        log.message("Re-run with --resume to retry only the failed isolates.")
+    log.message(f"Per-isolate outputs: {out_parent}/<isolate>/clustering/ "
+                f"+ consensus_assembly.gfa/.fasta")
+    log.message()
+    if not completed:
+        raise AutocyclerError(
+            f"all {n_todo} isolate(s) failed; see {manifest_path}")
+    return 2 if n_failed else 0
+
+
 def batch(assemblies_parent, out_parent, k_size: int = 51,
           max_contigs: int = 25, resume: bool = False,
-          threads: int = 1) -> int:
+          threads: int = 1, fleet: Optional[str] = None) -> int:
     """Compress every isolate and emit per-isolate clustering from one
     batched device distance step. Per-isolate failures are quarantined into
     the run manifest; returns the process exit code (0 = all complete,
     2 = partial failure; all-failed raises). ``threads`` reaches end-repair
-    and the k-mer grouping of every isolate's compress."""
+    and the k-mer grouping of every isolate's compress. ``fleet`` overrides
+    the ``AUTOCYCLER_FLEET_MODE`` knob ('off'/'on'/'auto'); when engaged
+    the run goes through the sharded fleet runner instead of the serial
+    sweep, with byte-identical per-isolate outputs."""
     if k_size < 11 or k_size > 501 or k_size % 2 == 0:
         quit_with_error("--kmer must be an odd number between 11 and 501")
     from ..utils import check_threads
     check_threads(threads)
+    from ..parallel.fleet import fleet_engaged, resolve_fleet_mode
+    mode = resolve_fleet_mode(fleet)
     log.section_header("Starting autocycler batch")
     log.explanation("Each isolate subdirectory is compressed into a unitig graph; the "
                     "exact all-vs-all contig distance matrices of ALL isolates are then "
@@ -118,13 +280,15 @@ def batch(assemblies_parent, out_parent, k_size: int = 51,
             elif manifest.stage_complete(iso.name, "compress"):
                 resume_compress.add(iso.name)
 
-    def _cluster_outputs(out_dir: Path) -> List[Path]:
-        clustering = out_dir / "clustering"
-        return [clustering / "pairwise_distances.phylip",
-                clustering / "clustering.newick",
-                clustering / "clustering.tsv",
-                clustering / "clustering.yaml"] \
-            + sorted(clustering.glob("qc_*/cluster_*/1_untrimmed.gfa"))
+    if fleet_engaged(mode, len(todo)):
+        jobs = [IsolateJob(iso.name, iso, out_parent / iso.name)
+                for iso in todo]
+        return _fleet_batch(jobs, out_parent, k_size, max_contigs, threads,
+                            manifest, manifest_path, resume_cluster,
+                            resume_compress, errs)
+    if mode != "off":
+        log.message(f"Fleet mode {mode!r} not engaged for {len(todo)} "
+                    "isolate(s) — running the serial path")
 
     # ---- per-isolate compress (quarantined) ----
     from ..models import UnitigGraph
@@ -157,29 +321,10 @@ def batch(assemblies_parent, out_parent, k_size: int = 51,
                         skipped=True)
                 else:
                     log.message(f"Compressing isolate {iso.name}")
-                    from ..metrics import InputAssemblyMetrics
-                    from ..utils.cache import open_cache
-                    # warm-start caches live under the isolate's out dir,
-                    # so a --resume (or repeat) run skips load+encode+
-                    # repair for isolates whose inputs have not changed
-                    sequences, _ = load_sequences(
-                        iso, k_size, InputAssemblyMetrics(), max_contigs,
-                        threads, cache=open_cache(out_dir))
-                    # streamed k-mer spill lives under the isolate's out
-                    # dir, so bins from concurrent/killed batch runs never
-                    # collide
-                    from ..stream import prepare_stream_root
-                    prepare_stream_root(out_dir)
-                    graph = build_unitig_graph(sequences, k_size,
-                                               threads=threads)
-                    simplify_structure(graph, sequences)
-                    os.makedirs(out_dir, exist_ok=True)
-                    graph.save_gfa(out_dir / "input_assemblies.gfa",
-                                   sequences)
-                    obs_qc.compress_qc(graph, sequences)
-                    ledger.record_stage(
-                        "compress",
-                        outputs=[out_dir / "input_assemblies.gfa"])
+                    sequences = _load_isolate(iso, out_dir, k_size,
+                                              max_contigs, threads)
+                    graph = _build_isolate(out_dir, sequences, k_size,
+                                           threads)
                 M, w, ids = membership_matrix(graph, sequences)
                 compressed.append((iso, (sequences, ids), M, w))
                 del graph
@@ -239,84 +384,216 @@ def batch(assemblies_parent, out_parent, k_size: int = 51,
                     "decoded from the device DP's packed traceback bits, so the "
                     "host never re-runs the DP and the final graphs are bitwise "
                     "identical to sequential trim.")
-    # per-isolate graph loading is quarantined too: one unreadable cluster
-    # GFA must not sink the whole batched screen
+    completed: List[str] = []
+    _screen_and_finalise(
+        [IsolateJob(iso.name, iso, out_parent / iso.name)
+         for iso in clustered],
+        mesh, errs, manifest, completed)
+    return _summarise(completed, errs, manifest_path, out_parent, len(todo))
+
+
+# ---------------------------------------------------------------------------
+# Fleet mode (parallel/fleet.py planning + bucketed device shapes)
+# ---------------------------------------------------------------------------
+
+def _fleet_batch(jobs: List[IsolateJob], out_parent: Path, k_size: int,
+                 max_contigs: int, threads: int, manifest: RunManifest,
+                 manifest_path: Path, resume_cluster: set,
+                 resume_compress: set, errs) -> int:
+    """The sharded fleet runner: size-bucketed shards, one mesh-sharded
+    contraction per shard, prefetched host loads, stage-granular + fleet-
+    granular resume. Byte-identical to the serial sweep per isolate."""
     from ..models import UnitigGraph
-    iso_cluster_dirs = {}
-    graphs = {}
-    with stage_timer("batch/trim_screen"):
-        for iso in clustered:
-            qc_pass = out_parent / iso.name / "clustering" / "qc_pass"
-            dirs = sorted(d for d in qc_pass.iterdir() if d.is_dir()) \
-                if qc_pass.is_dir() else []
-            with errs.quarantine(iso.name):
-                for cdir in dirs:
-                    graphs[cdir] = UnitigGraph.from_gfa_file(
-                        cdir / "1_untrimmed.gfa")
-            if errs.failed(iso.name):
-                manifest.fail(iso.name, str(errs.errors[iso.name].cause),
-                              stage="trim")
-                for cdir in dirs:
-                    graphs.pop(cdir, None)
-            else:
-                iso_cluster_dirs[iso.name] = dirs
-        cluster_dirs = [d for dirs in iso_cluster_dirs.values() for d in dirs]
-        screens = _batched_trim_screens(cluster_dirs, graphs, mesh=mesh)
-    n_all = sum(len(s) for s in screens.values())
-    n_dev = sum(isinstance(v, list) for s in screens.values()
-                for v in s.values())
-    n_host = sum(v is True for s in screens.values() for v in s.values())
-    log.message(f"{n_all} trim DPs screened; {n_dev} alignments decoded from "
-                f"the device traceback; {n_host} need the full host DP")
+    from ..parallel import fleet as fleet_mod
+    from ..utils.knobs import knob_int
+    from ..utils.pool import prefetch_iter
+    from ..utils.resilience import crash_point
+
+    by_name = {j.name: j for j in jobs}
+    resume_jobs = [j for j in jobs if j.name in resume_cluster]
+    fleet_jobs = [j for j in jobs if j.name not in resume_cluster]
+    n_dev = fleet_mod.fleet_devices()
+    plan = fleet_mod.plan_fleet(
+        {j.name: fleet_mod.isolate_cost(j.asm_dir) for j in fleet_jobs},
+        shard_size=n_dev,
+        n_buckets=knob_int("AUTOCYCLER_FLEET_BUCKETS"))
+    log.section_header("Fleet plan")
+    log.explanation("Isolates are packed into size-bucketed shards; each shard's exact "
+                    "membership contraction is ONE device dispatch sharded over the "
+                    "isolate axis (padded up a power-of-two shape ladder, so XLA "
+                    "compiles once per bucket), and the host load/encode of upcoming "
+                    "isolates runs ahead on the shared pool, overlapping the current "
+                    "shard's device work.")
+    log.message(f"{len(fleet_jobs)} isolate(s) in {len(plan.shards)} "
+                f"shard(s) of up to {plan.shard_size} "
+                f"({plan.n_buckets} size bucket(s), {n_dev} device(s))")
     log.message()
 
-    # ---- per-isolate trim + resolve + combine (quarantined) ----
-    completed = []
-    with stage_timer("batch/finalise"):
-        for iso in clustered:
-            if iso.name not in iso_cluster_dirs:
-                continue
-            with trace.span(f"isolate/{iso.name}", cat="isolate",
-                            stage="finalise"), obs_qc.scope(iso.name), \
-                    errs.quarantine(iso.name):
-                for cdir in iso_cluster_dirs[iso.name]:
-                    trimmed = trim(cdir, dp_screen=screens[cdir],
-                                   preloaded=graphs.pop(cdir))
-                    resolve(cdir, preloaded=trimmed)
-                    del trimmed   # reference-cyclic; drop before collecting
-                    gc.collect()
-                qc_pass = out_parent / iso.name / "clustering" / "qc_pass"
-                finals = sorted(qc_pass.glob("cluster_*/5_final.gfa")) \
-                    if qc_pass.is_dir() else []
-                if finals:
-                    combine(out_parent / iso.name, finals)
-            if errs.failed(iso.name):
-                manifest.fail(iso.name, str(errs.errors[iso.name].cause),
-                              stage="finalise")
-            else:
-                manifest.stage_done(
-                    iso.name, "finalise",
-                    outputs=[out_parent / iso.name / "consensus_assembly.gfa",
-                             out_parent / iso.name
-                             / "consensus_assembly.fasta"])
-                manifest.done(iso.name)
-                completed.append(iso.name)
+    prefetch = knob_int("AUTOCYCLER_FLEET_PREFETCH")
+    depth = max(1, int(prefetch)) * plan.shard_size
+    order = [by_name[name] for sh in plan.shards for name in sh.names]
 
-    log.section_header("Finished!")
-    n_failed = len(errs)
-    log.message(f"{len(completed)} isolate(s) complete, {n_failed} failed "
-                f"(statuses recorded in {manifest_path})")
-    if n_failed:
-        for name in sorted(errs.errors):
-            log.message(f"  FAILED {name}: {errs.errors[name].cause}")
-        log.message("Re-run with --resume to retry only the failed isolates.")
-    log.message(f"Per-isolate outputs: {out_parent}/<isolate>/clustering/ "
-                f"+ consensus_assembly.gfa/.fasta")
-    log.message()
-    if not completed:
+    def _load_job(job: IsolateJob):
+        """One isolate's host load, run ahead on the shared pool while the
+        current shard owns the device. Failures are returned as values and
+        re-raised under the consumer's quarantine, so one corrupt isolate
+        cannot kill the prefetch lane for the isolates behind it."""
+        try:
+            with trace.span(f"isolate/{job.name}", cat="isolate",
+                            stage="load"), obs_qc.scope(job.name):
+                if job.name in resume_compress:
+                    graph, sequences = UnitigGraph.from_gfa_file(
+                        job.out_dir / "input_assemblies.gfa")
+                    ledger.record_stage(
+                        "compress",
+                        outputs=[job.out_dir / "input_assemblies.gfa"],
+                        skipped=True)
+                    return ("graph", graph, sequences)
+                sequences = _load_isolate(job.asm_dir, job.out_dir, k_size,
+                                          max_contigs, threads)
+                return ("seqs", sequences, None)
+        except Exception as e:  # noqa: BLE001 — re-raised at consume time
+            return ("err", e, None)
+
+    # the lane is wider than the prefetch depth so a load task that fans
+    # its own parse/encode subtasks across the shared executor always
+    # leaves >= threads free workers — no nested-submission starvation
+    loads = prefetch_iter(_load_job, order, workers=threads + depth,
+                          depth=depth)
+    mesh = make_mesh()
+    completed: List[str] = []
+    any_compressed = bool(resume_jobs)
+    for shard in plan.shards:
+        with trace.span(f"fleet/shard-{shard.index:03d}", cat="fleet",
+                        bucket=shard.bucket, isolates=len(shard.names)):
+            compressed = []   # (job, (sequences, ids), M, w)
+            with stage_timer("batch/compress"):
+                for name in shard.names:
+                    job = by_name[name]
+                    manifest.start(job.name)
+                    loaded = next(loads)
+                    with trace.span(f"isolate/{job.name}", cat="isolate",
+                                    stage="compress"), \
+                            obs_qc.scope(job.name), \
+                            errs.quarantine(job.name):
+                        if loaded[0] == "err":
+                            raise loaded[1]
+                        if loaded[0] == "graph":
+                            log.message(
+                                f"{job.name}: compress checkpoint verified "
+                                "— reloading unitig graph (--resume)")
+                            graph, sequences = loaded[1], loaded[2]
+                        else:
+                            log.message(f"Compressing isolate {job.name}")
+                            sequences = loaded[1]
+                            graph = _build_isolate(job.out_dir, sequences,
+                                                   k_size, threads)
+                        M, w, ids = membership_matrix(graph, sequences)
+                        compressed.append((job, (sequences, ids), M, w))
+                        del graph
+                        gc.collect()
+                    if errs.failed(job.name):
+                        manifest.fail(job.name,
+                                      str(errs.errors[job.name].cause),
+                                      stage="compress")
+                    else:
+                        manifest.stage_done(
+                            job.name, "compress",
+                            outputs=[job.out_dir / "input_assemblies.gfa"])
+            with stage_timer("batch/distances"):
+                inters = fleet_mod.fleet_membership_intersections(
+                    [c[2] for c in compressed],
+                    [c[3] for c in compressed],
+                    devices=n_dev) if compressed else []
+            # the registered preemption boundary: every isolate of this
+            # shard has a durable compress checkpoint, nothing after has
+            # run — a kill here must resume into reload + re-cluster
+            crash_point("mid-fleet-shard", f"shard-{shard.index:03d}")
+            shard_clustered: List[IsolateJob] = []
+            with stage_timer("batch/cluster"):
+                for (job, (sequences, ids), _, _), inter \
+                        in zip(compressed, inters):
+                    with trace.span(f"isolate/{job.name}", cat="isolate",
+                                    stage="cluster"), \
+                            obs_qc.scope(job.name), \
+                            errs.quarantine(job.name):
+                        distances = intersections_to_distances(inter, ids)
+                        run_cluster(job.out_dir, max_contigs=max_contigs,
+                                    precomputed_distances=distances)
+                        log.message(f"{job.name}: {len(sequences)} contigs "
+                                    "clustered")
+                        shard_clustered.append(job)
+                    if errs.failed(job.name):
+                        manifest.fail(job.name,
+                                      str(errs.errors[job.name].cause),
+                                      stage="cluster")
+                    else:
+                        manifest.stage_done(
+                            job.name, "cluster",
+                            outputs=_cluster_outputs(job.out_dir))
+            if compressed:
+                any_compressed = True
+            _screen_and_finalise(shard_clustered, mesh, errs, manifest,
+                                 completed)
+            fleet_mod.record_shard_metrics(len(shard.names), shard.bucket)
+    if resume_jobs:
+        for job in resume_jobs:
+            manifest.start(job.name)
+            log.message(f"{job.name}: compress + cluster checkpoints "
+                        "verified — resuming at trim (--resume)")
+            with obs_qc.scope(job.name):
+                ledger.record_stage(
+                    "compress",
+                    outputs=[job.out_dir / "input_assemblies.gfa"],
+                    skipped=True)
+                ledger.record_stage(
+                    "cluster", outputs=_cluster_outputs(job.out_dir),
+                    skipped=True)
+        _screen_and_finalise(resume_jobs, mesh, errs, manifest, completed)
+    if not any_compressed:
         raise AutocyclerError(
-            f"all {len(todo)} isolate(s) failed; see {manifest_path}")
-    return 2 if n_failed else 0
+            f"all {len(jobs)} isolate(s) failed during compress; "
+            f"see {manifest_path}")
+    return _summarise(completed, errs, manifest_path, out_parent, len(jobs))
+
+
+def run_fleet_jobs(jobs: List[IsolateJob], k_size: int = 51,
+                   max_contigs: int = 25, threads: int = 1,
+                   manifest_path=None, resume: bool = False) -> int:
+    """Serve's entry into the fleet runner: one scheduler admission fans
+    its batch items over the mesh in a single worker slot. ``jobs`` carry
+    explicit per-item assembly/output dirs; the fleet manifest at
+    ``manifest_path`` gives the admission crash-safe replay (a restarted
+    daemon re-runs the job with ``resume=True`` and it re-enters at the
+    per-isolate stage checkpoints). Returns the batch exit code (0 = all
+    complete, 2 = partial failure; all-failed raises)."""
+    jobs = [IsolateJob(j.name, Path(j.asm_dir), Path(j.out_dir))
+            for j in jobs]
+    manifest_path = Path(manifest_path)
+    manifest = RunManifest.load(manifest_path) if resume \
+        else RunManifest(manifest_path)
+    todo = []
+    for job in jobs:
+        if resume and manifest.status(job.name) == "done":
+            log.message(f"{job.name}: already complete — skipped (resume)")
+            continue
+        manifest.pending(job.name)
+        todo.append(job)
+    if not todo:
+        log.message("All fleet isolates already complete; nothing to do")
+        return 0
+    resume_cluster = set()
+    resume_compress = set()
+    if resume:
+        for job in todo:
+            if manifest.stage_complete(job.name, "cluster"):
+                resume_cluster.add(job.name)
+            elif manifest.stage_complete(job.name, "compress"):
+                resume_compress.add(job.name)
+    errs = collect_errors()
+    return _fleet_batch(todo, manifest_path.parent, k_size, max_contigs,
+                        threads, manifest, manifest_path, resume_cluster,
+                        resume_compress, errs)
 
 
 def _batched_trim_screens(cluster_dirs, graphs, max_unitigs: int = 5000,

@@ -1,0 +1,256 @@
+"""Fleet planning + bucketed device shapes for multi-isolate batch runs.
+
+The fleet runner (commands/batch.py `--fleet`) scales `autocycler batch`
+along the ROADMAP's fleet rung: isolates are packed into *shards* sized to
+the device mesh, each shard's exact membership contraction runs as ONE
+device dispatch sharded over the leading (isolate) axis, and the host
+load/encode of upcoming isolates overlaps the current shard's device work
+(the Gerbil producer/consumer shape, arXiv:1607.06618, already used by the
+stream spill pipeline).
+
+Two shape problems make the naive version slow, and both are solved here
+with the KMC 2 fixed-size-bin idea (arXiv:1407.1507):
+
+- **Bucketed packing** (:func:`plan_fleet`): isolates vary by orders of
+  magnitude (a 6 Mbp chromosome next to 2 kb plasmids). Padding every
+  shard to the global maximum wastes FLOPs and memory; compiling per exact
+  shape retraces XLA once per isolate. The planner sorts isolates by input
+  cost, splits the order into a small number of contiguous size buckets,
+  and forms shards *within* a bucket — so similar-sized isolates share a
+  shard and the padding stays tight.
+- **Bucketed device shapes** (:func:`bucket_dim` +
+  :func:`fleet_membership_intersections`): each shard's [B, S, U]
+  membership tensors are padded up a power-of-two ladder, quantising the
+  shape space to at most a handful of distinct shapes per run. The
+  contraction is jitted ONCE at module scope, so XLA compiles once per
+  ladder shape ("once per bucket") instead of once per shard.
+
+The contraction itself is placed with ``parallel.mesh.shard_leading_axis``
+— isolates ride the flattened ('data', 'seq') mesh as pure data
+parallelism, no collectives — and stays integer end to end, so every
+isolate's matrix is bit-identical to the serial
+``batched_membership_intersections`` / single-isolate computation.
+Isolates whose weighted membership could overflow int32 accumulation take
+the exact int64 host matmul, exactly as the serial path does.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..obs import metrics_registry
+
+# registry metric names (static by the analysis.rules.metrics contract)
+FLEET_SHARDS_TOTAL = "autocycler_fleet_shards_total"
+FLEET_ISOLATES_TOTAL = "autocycler_fleet_isolates_total"
+FLEET_PAD_RATIO = "autocycler_fleet_pad_ratio"
+FLEET_SHAPE_BUCKETS = "autocycler_fleet_shape_buckets"
+
+# padding ladder floors: shapes below these round up to the floor, so tiny
+# synthetic isolates share one compile instead of one per contig count
+_PAD_FLOOR_S = 8
+_PAD_FLOOR_U = 64
+
+FLEET_MODES = ("off", "on", "auto")
+
+
+def resolve_fleet_mode(cli_value: Optional[str] = None) -> str:
+    """The effective fleet mode: the CLI --fleet flag when given, else the
+    ``AUTOCYCLER_FLEET_MODE`` knob. Unknown values are an input error (the
+    CLI argparse choices catch them first; this guards the knob path)."""
+    from ..utils.knobs import knob_str
+    from ..utils.resilience import InputError
+
+    mode = (cli_value or knob_str("AUTOCYCLER_FLEET_MODE") or "off")
+    mode = mode.strip().lower()
+    if mode not in FLEET_MODES:
+        raise InputError(f"unknown fleet mode {mode!r} "
+                         f"(choose from {', '.join(FLEET_MODES)})")
+    return mode
+
+
+def fleet_devices() -> int:
+    """How many devices the fleet planner shards for:
+    ``AUTOCYCLER_FLEET_DEVICES`` when > 0, else the attached device count
+    (1 on any mesh-discovery failure — the plan still runs, unsharded)."""
+    from ..utils.knobs import knob_int
+
+    forced = knob_int("AUTOCYCLER_FLEET_DEVICES")
+    if forced is not None and int(forced) > 0:
+        return int(forced)
+    try:
+        from .mesh import _devices_with_deadline
+        return max(1, len(_devices_with_deadline()))
+    except Exception:  # noqa: BLE001 — planning degrades to one device
+        return 1
+
+
+def fleet_engaged(mode: str, n_isolates: int) -> bool:
+    """Whether the fleet path runs: 'on' engages for any multi-isolate
+    batch, 'auto' additionally requires >1 device (a one-device fleet only
+    buys the prefetch overlap). A single isolate ALWAYS degrades to the
+    serial path — there is nothing to pack, and bit-for-bit equivalence
+    with `autocycler batch` is then true by construction."""
+    if n_isolates <= 1:
+        return False
+    if mode == "on":
+        return True
+    if mode == "auto":
+        return fleet_devices() > 1
+    return False
+
+
+def isolate_cost(asm_dir) -> int:
+    """The planner's cost proxy for one isolate: total bytes of its
+    assembly files. Never raises — an unreadable dir costs 0 and fails
+    later inside the per-isolate quarantine, where it is recorded."""
+    from ..utils.io import _ASSEMBLY_EXTS
+
+    total = 0
+    try:
+        for p in Path(asm_dir).iterdir():
+            if p.is_file() and p.name.lower().endswith(_ASSEMBLY_EXTS):
+                try:
+                    total += p.stat().st_size
+                except OSError:
+                    continue
+    except OSError:
+        return 0
+    return total
+
+
+@dataclass(frozen=True)
+class FleetShard:
+    """One device dispatch's worth of isolates (≤ shard_size names, all
+    from the same size bucket)."""
+    index: int
+    bucket: int
+    names: Tuple[str, ...]
+
+
+@dataclass
+class FleetPlan:
+    shards: List[FleetShard]
+    shard_size: int
+    n_buckets: int
+
+
+def plan_fleet(costs: Dict[str, int], shard_size: int,
+               n_buckets: int) -> FleetPlan:
+    """Pack isolates into bucketed shards.
+
+    Isolates are ordered by descending cost (name-tiebroken, so the plan
+    is deterministic), the order is split into ``n_buckets`` contiguous
+    near-equal-count groups (rank quantiles — the KMC 2 fixed-size-bin
+    rule), and each group is chunked into shards of ``shard_size``. An
+    adversarially skewed input (one 6 Mbp isolate among 2 kb plasmids)
+    lands the giant in its own bucket, so the plasmid shards never pay its
+    padding."""
+    shard_size = max(1, int(shard_size))
+    names = sorted(costs, key=lambda n: (-costs[n], n))
+    n_buckets = max(1, min(int(n_buckets), len(names) or 1))
+    bounds = np.linspace(0, len(names), n_buckets + 1).astype(int)
+    shards: List[FleetShard] = []
+    for b in range(n_buckets):
+        group = names[bounds[b]:bounds[b + 1]]
+        for i in range(0, len(group), shard_size):
+            chunk = tuple(group[i:i + shard_size])
+            if chunk:
+                shards.append(FleetShard(len(shards), b, chunk))
+    return FleetPlan(shards=shards, shard_size=shard_size,
+                     n_buckets=n_buckets)
+
+
+def bucket_dim(n: int, floor: int) -> int:
+    """Round a dimension up the padding ladder: the smallest power-of-two
+    multiple of ``floor`` that holds ``n``. Quantising shapes to the
+    ladder caps the number of distinct compiled programs at the ladder
+    length (~log of the size range) instead of one per isolate."""
+    v = max(1, int(floor))
+    n = max(1, int(n))
+    while v < n:
+        v <<= 1
+    return v
+
+
+@functools.lru_cache(maxsize=1)
+def _jitted_membership_step():
+    """The fleet contraction, jitted ONCE at module scope: jax caches the
+    compiled executable per input shape, so every shard padded to the same
+    ladder shape reuses one compile. (A fresh ``jax.jit(step)`` per call —
+    the serial path's pattern — retraces every dispatch.)"""
+    import jax
+    import jax.numpy as jnp
+
+    def step(Mw, M):
+        return jnp.einsum("bsu,btu->bst", Mw, M,
+                          preferred_element_type=jnp.int32)
+
+    return jax.jit(step)
+
+
+def fleet_membership_intersections(M_list: List[np.ndarray],
+                                   w_list: List[np.ndarray],
+                                   devices: Optional[int] = None
+                                   ) -> List[np.ndarray]:
+    """Exact per-isolate contig intersection matrices for one fleet shard.
+
+    Same contract as ``parallel.batch.batched_membership_intersections``
+    (returns [S_i, S_i] int64 matrices; isolates past int32 accumulation
+    range take the exact host matmul), but laid out for the fleet: the
+    [B, S, U] tensors are padded up the bucket ladder (S, U) and to a
+    device multiple (B), then placed across the flattened mesh with
+    ``shard_leading_axis`` — each device contracts its own isolates, no
+    collectives — through the ONE module-jitted einsum. Integer arithmetic
+    end to end keeps every matrix bit-identical to the serial step."""
+    from ..ops.distance import exceeds_int32_accumulation
+    from .mesh import shard_leading_axis
+
+    B = len(M_list)
+    if B == 0:
+        return []
+    n_dev = int(devices) if devices else fleet_devices()
+    S = bucket_dim(max(m.shape[0] for m in M_list), _PAD_FLOOR_S)
+    U = bucket_dim(max(m.shape[1] for m in M_list), _PAD_FLOOR_U)
+    Bp = -(-B // n_dev) * n_dev
+    Mw = np.zeros((Bp, S, U), dtype=np.int32)
+    Mp = np.zeros((Bp, S, U), dtype=np.int32)
+    host_only = []   # isolates whose intersections could exceed int32
+    for i, (m, w) in enumerate(zip(M_list, w_list)):
+        s, u = m.shape
+        weighted = m.astype(np.int64) * w[None, :]
+        if exceeds_int32_accumulation(weighted):
+            host_only.append(i)
+            continue
+        Mp[i, :s, :u] = m
+        Mw[i, :s, :u] = weighted
+    real = sum(m.shape[0] * m.shape[1] for m in M_list)
+    metrics_registry.gauge_set(
+        FLEET_PAD_RATIO, round(Bp * S * U / max(1, real), 3),
+        help="padded/real element ratio of the last fleet contraction")
+    _, Mw_d, Mp_d = shard_leading_axis(np.int32(0), Mw, Mp)
+    from ..utils.timing import device_dispatch
+    with device_dispatch("fleet membership contraction"):
+        inter = np.asarray(
+            _jitted_membership_step()(Mw_d, Mp_d)).astype(np.int64)
+    out = [inter[i, :m.shape[0], :m.shape[0]]
+           for i, m in enumerate(M_list)]
+    for i in host_only:
+        m, w = M_list[i], w_list[i]
+        out[i] = (m.astype(np.int64) * w[None, :]) @ m.astype(np.int64).T
+    return out
+
+
+def record_shard_metrics(n_isolates: int, bucket: int) -> None:
+    """Per-shard counters the obs registry (and `autocycler top`) sees."""
+    metrics_registry.counter_inc(
+        FLEET_SHARDS_TOTAL, 1,
+        help="fleet shards dispatched", bucket=str(bucket))
+    metrics_registry.counter_inc(
+        FLEET_ISOLATES_TOTAL, n_isolates,
+        help="isolates processed through the fleet runner")

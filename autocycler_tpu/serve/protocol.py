@@ -123,6 +123,33 @@ def is_batch_spec(data) -> bool:
     return isinstance(data, dict) and "batch" in data
 
 
+def is_fleet_batch(data) -> bool:
+    """True when a batch body opts into the fleet runner: a truthy
+    top-level ``"fleet"`` next to the ``"batch"`` array. The batch then
+    admits as ONE scheduler job whose execution fans the items over the
+    mesh (commands.batch.run_fleet_jobs) instead of N child jobs."""
+    return is_batch_spec(data) and bool(data.get("fleet"))
+
+
+def validate_fleet_batch(specs) -> None:
+    """Constraints the fleet runner adds on top of batch validation: the
+    items share one device plan, so kmer and max_contigs must be uniform
+    across the batch, and every item must run the full pipeline (a
+    compress-only fleet item would silently skip its cluster/consensus
+    outputs). Raises :class:`InputError` (HTTP 400)."""
+    if len({s.kmer for s in specs}) > 1:
+        raise InputError("fleet batch requires a uniform 'kmer' "
+                         "across all items")
+    if len({s.max_contigs for s in specs}) > 1:
+        raise InputError("fleet batch requires a uniform 'max_contigs' "
+                         "across all items")
+    bad = [i for i, s in enumerate(specs) if s.command != "pipeline"]
+    if bad:
+        raise InputError(f"fleet batch items must use command='pipeline' "
+                         f"(item {bad[0]} is "
+                         f"{specs[bad[0]].command!r})")
+
+
 def parse_batch_spec(data) -> list:
     """Validate a batch body into a list of :class:`JobSpec`.
 
@@ -140,7 +167,7 @@ def parse_batch_spec(data) -> list:
     if len(items) > BATCH_MAX:
         raise InputError(f"batch fan-out is capped at {BATCH_MAX} jobs "
                          f"(got {len(items)})")
-    shared = {k: v for k, v in data.items() if k != "batch"}
+    shared = {k: v for k, v in data.items() if k not in ("batch", "fleet")}
     specs = []
     for i, item in enumerate(items):
         if not isinstance(item, dict):

@@ -93,7 +93,8 @@ class Job:
     """One job's record: the spec plus lifecycle state and artifact paths."""
 
     def __init__(self, job_id: str, spec: JobSpec, run_dir: Path,
-                 out_dir: Path):
+                 out_dir: Path,
+                 fleet_specs: Optional[List[JobSpec]] = None):
         self.id = job_id
         self.spec = spec
         self.run_dir = run_dir
@@ -102,6 +103,9 @@ class Job:
         self.error: Optional[str] = None
         self.resumed = False              # replayed after a daemon restart
         self.parent: Optional[str] = None  # batch parent id, when fanned out
+        # a fleet admission: ONE queue slot whose execution fans these
+        # items over the mesh (commands.batch.run_fleet_jobs)
+        self.fleet_specs: Optional[List[JobSpec]] = fleet_specs
         self.submitted_epoch = time.time()
         self.started_epoch: Optional[float] = None
         self.finished_epoch: Optional[float] = None
@@ -109,6 +113,14 @@ class Job:
         self.queue_wait_s: Optional[float] = None
 
     def to_dict(self) -> dict:
+        if self.fleet_specs:
+            # additive key only: existing clients keep parsing records
+            # that predate fleet admissions unchanged
+            return {**self._base_dict(),
+                    "fleet": len(self.fleet_specs)}
+        return self._base_dict()
+
+    def _base_dict(self) -> dict:
         return {
             "id": self.id,
             "state": self.state,
@@ -191,6 +203,28 @@ class Scheduler:
             status = entry.get("status")
             if status not in ("pending", "running"):
                 continue
+            if entry.get("kind") == "fleet":
+                # a fleet admission replays as ONE job; its execution
+                # resumes from the fleet manifest's per-isolate stage
+                # checkpoints (commands.batch.run_fleet_jobs resume=True)
+                try:
+                    fleet_specs = [parse_job_spec(s)
+                                   for s in (entry.get("fleet_specs") or [])]
+                    if not fleet_specs:
+                        raise InputError("empty fleet spec list")
+                except (InputError, TypeError) as e:
+                    self.manifest.fail(name, f"unreplayable fleet spec: {e}")
+                    continue
+                run_dir = self.root / "jobs" / name
+                out_dir = Path(entry.get("out_dir") or (run_dir / "out"))
+                job = Job(name, fleet_specs[0], run_dir, out_dir,
+                          fleet_specs=fleet_specs)
+                job.resumed = status == "running"
+                submitted = entry.get("submitted_epoch")
+                if isinstance(submitted, (int, float)):
+                    job.submitted_epoch = float(submitted)
+                replay.append(job)
+                continue
             spec_data = entry.get("spec")
             if not isinstance(spec_data, dict):
                 # pre-replay manifests carried no spec: nothing to re-run,
@@ -253,13 +287,17 @@ class Scheduler:
         return job
 
     def _admit_locked(self, spec: JobSpec,
-                      parent: Optional[str] = None) -> Job:
-        """Create + enqueue one job. Caller holds ``self._lock``."""
+                      parent: Optional[str] = None,
+                      fleet_specs: Optional[List[JobSpec]] = None) -> Job:
+        """Create + enqueue one job. Caller holds ``self._lock``.
+        ``fleet_specs`` must be threaded through here (not assigned after)
+        — the job is visible to workers the moment it is enqueued, and a
+        late assignment would race a worker into the single-spec path."""
         job_id = f"job-{self._next_id:06d}"
         self._next_id += 1
         run_dir = self.root / "jobs" / job_id
         out_dir = Path(spec.out_dir) if spec.out_dir else run_dir / "out"
-        job = Job(job_id, spec, run_dir, out_dir)
+        job = Job(job_id, spec, run_dir, out_dir, fleet_specs=fleet_specs)
         job.parent = parent
         try:
             self._queue.put_nowait(job)
@@ -271,6 +309,28 @@ class Scheduler:
                 f"work queue is full ({self.capacity} jobs); "
                 "retry after a job completes") from None
         self._jobs[job_id] = job
+        return job
+
+    def submit_fleet(self, specs: List[JobSpec]) -> Job:
+        """Admit a fleet batch as ONE job: a single queue slot and worker
+        whose execution fans the items over the device mesh
+        (commands.batch.run_fleet_jobs), instead of ``submit_batch``'s N
+        independent child jobs. Raises :class:`QueueFullError` when the
+        queue is at capacity."""
+        specs = list(specs)
+        with self._lock:
+            job = self._admit_locked(specs[0], fleet_specs=specs)
+        # persist the full item list: a restarted daemon rebuilds the
+        # fleet job from the manifest entry alone and resumes it from the
+        # per-isolate stage checkpoints in its fleet manifest
+        self.manifest.annotate(
+            job.id, kind="fleet",
+            fleet_specs=[s.to_dict() for s in specs],
+            out_dir=str(job.out_dir),
+            submitted_epoch=round(job.submitted_epoch, 3))
+        metrics_registry.counter_inc(
+            SUBMITTED_TOTAL, 1, help="jobs admitted into the work queue")
+        self._gauge_depth()
         return job
 
     def submit_batch(self, specs: List[JobSpec]) -> dict:
@@ -481,7 +541,10 @@ class Scheduler:
                         trace.span(f"job/{job.id}", cat="command",
                                    job=job.id, command=spec.command))
                     ctx.enter_context(obs_qc.scope(job.id))
-                    self._run_spec(spec, job.out_dir, job_id=job.id)
+                    if job.fleet_specs:
+                        self._run_fleet(job)
+                    else:
+                        self._run_spec(spec, job.out_dir, job_id=job.id)
             except (AutocyclerError, OSError) as e:
                 failure = e
             except Exception as e:  # noqa: BLE001 — a bug in one job's
@@ -565,6 +628,34 @@ class Scheduler:
                     outputs) -> None:
         if job_id is not None:
             self.manifest.stage_done(job_id, stage, outputs=outputs)
+
+    def _run_fleet(self, job: Job) -> None:
+        """The fleet job body: one admission fanned over the mesh through
+        the CLI's fleet runner. Each item's outputs land in its spec's
+        ``out_dir`` (default: ``<job run_dir>/out/isolate-NN``); the fleet
+        manifest in the job's run dir gives daemon restarts per-isolate
+        stage-granular resume. Partial failure (exit 2 — some isolates
+        quarantined inside the fleet run) fails the job with the manifest
+        path, matching `autocycler batch`'s exit contract."""
+        from ..commands.batch import IsolateJob, run_fleet_jobs
+        assert job.fleet_specs
+        jobs = []
+        for i, spec in enumerate(job.fleet_specs):
+            name = f"isolate-{i:02d}"
+            out_dir = Path(spec.out_dir) if spec.out_dir \
+                else job.out_dir / name
+            jobs.append(IsolateJob(name, Path(spec.assemblies_dir), out_dir))
+        manifest_path = job.run_dir / "fleet_manifest.json"
+        spec = job.fleet_specs[0]
+        rc = run_fleet_jobs(jobs, k_size=spec.kmer,
+                            max_contigs=spec.max_contigs,
+                            threads=spec.threads,
+                            manifest_path=manifest_path,
+                            resume=job.resumed)
+        if rc != 0:
+            raise AutocyclerError(
+                f"fleet run completed with failed isolate(s); "
+                f"see {manifest_path}")
 
     def _run_spec(self, spec: JobSpec, out_dir: Path,
                   job_id: Optional[str] = None) -> None:

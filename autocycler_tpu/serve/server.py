@@ -55,7 +55,8 @@ from ..utils import log
 from ..utils.knobs import knob_str
 from ..utils.resilience import InputError
 from .protocol import (DEFAULT_PORT, SERVE_INFO_JSON, is_batch_spec,
-                       parse_batch_spec, parse_job_spec)
+                       is_fleet_batch, parse_batch_spec, parse_job_spec,
+                       validate_fleet_batch)
 from .scheduler import SHED_TOTAL, QueueFullError, Scheduler
 
 # a sampler whose last tick is older than this many intervals is stale —
@@ -220,8 +221,11 @@ class _Handler(BaseHTTPRequestHandler):
             try:
                 body = self._read_json()
                 batch = is_batch_spec(body)
+                fleet = is_fleet_batch(body)
                 specs = parse_batch_spec(body) if batch \
                     else [parse_job_spec(body)]
+                if fleet:
+                    validate_fleet_batch(specs)
             except InputError as e:
                 metrics_registry.counter_inc(
                     "autocycler_serve_rejected_total", 1,
@@ -249,7 +253,12 @@ class _Handler(BaseHTTPRequestHandler):
                      "retry_after_s": RETRY_AFTER_S},
                     "/jobs", headers={"Retry-After": RETRY_AFTER_S})
             try:
-                if batch:
+                if fleet:
+                    # one admission, one queue slot: the worker fans the
+                    # items over the device mesh via the fleet runner
+                    record = self.state.scheduler.submit_fleet(
+                        specs).to_dict()
+                elif batch:
                     record = self.state.scheduler.submit_batch(specs)
                 else:
                     record = self.state.scheduler.submit(specs[0]).to_dict()

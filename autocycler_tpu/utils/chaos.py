@@ -48,15 +48,20 @@ sys.exit(batch(sys.argv[1], sys.argv[2], k_size=int(sys.argv[3]),
 def _child_env(repo_root: str, crash_points: Optional[str] = None) -> dict:
     """A deterministic child environment: CPU jax, streaming spill forced
     on (so the mid-spill-write point is actually exercised), warm-start
-    caches on (ditto mid-cache-store). The oracle runs with the SAME
-    environment minus the armed crash point — byte-identity must hold
-    across the crash, not across a mode switch."""
+    caches on (ditto mid-cache-store), fleet mode on with a forced
+    one-device plan (two isolates -> two shards, so mid-fleet-shard fires
+    between the first shard's durable compress checkpoints and its
+    cluster stage). The oracle runs with the SAME environment minus the
+    armed crash point — byte-identity must hold across the crash, not
+    across a mode switch."""
     env = dict(os.environ)
     env["PYTHONPATH"] = repo_root + (
         os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
     env.update({"JAX_PLATFORMS": "cpu",
                 "AUTOCYCLER_STREAM_KMERS": "on",
-                "AUTOCYCLER_ENCODE_CACHE": "1"})
+                "AUTOCYCLER_ENCODE_CACHE": "1",
+                "AUTOCYCLER_FLEET_MODE": "on",
+                "AUTOCYCLER_FLEET_DEVICES": "1"})
     env.pop("AUTOCYCLER_CRASH_POINTS", None)
     env.pop("AUTOCYCLER_FAULTS", None)
     if crash_points:

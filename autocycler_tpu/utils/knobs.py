@@ -379,6 +379,31 @@ KNOBS: Dict[str, Knob] = dict(
             None,
             "Arm registered crash points for chaos testing: comma list of 'point[@n]' entries, crashing the process at the n-th hit of the point (default first).",
         ),
+        # --- fleet batch ---------------------------------------------------
+        _k(
+            "AUTOCYCLER_FLEET_MODE",
+            "str",
+            "off",
+            "Fleet runner for `autocycler batch`: 'off' (serial oracle), 'on', or 'auto' (engage when >1 device and >1 isolate). The CLI --fleet flag overrides.",
+        ),
+        _k(
+            "AUTOCYCLER_FLEET_BUCKETS",
+            "int",
+            4,
+            "Number of isolate-size buckets the fleet planner packs shards from; fewer buckets = fewer XLA compiles, more padding waste.",
+        ),
+        _k(
+            "AUTOCYCLER_FLEET_PREFETCH",
+            "int",
+            2,
+            "Shards of isolate loads kept in flight ahead of the device step (multiplied by the shard width); <=1 disables host/device overlap.",
+        ),
+        _k(
+            "AUTOCYCLER_FLEET_DEVICES",
+            "int",
+            0,
+            "Device count the fleet planner shards for; 0 discovers the attached mesh. Tests force N host devices via XLA_FLAGS=--xla_force_host_platform_device_count.",
+        ),
         # --- serve / SLOs --------------------------------------------------
         _k(
             "AUTOCYCLER_SERVE",

@@ -176,8 +176,10 @@ def collect_errors() -> ErrorCollector:
 #   mid-spill-write     half a spill record written to a stream bin
 #   mid-cache-store     cache payload written to its tmp file, not renamed
 #   pre-artifact-rename manifest/ledger tmp written, os.replace pending
+#   mid-fleet-shard     a fleet shard's compress checkpoints are durable but
+#                       its cluster/finalise stages have not started
 CRASH_POINTS = ("post-stage", "mid-spill-write", "mid-cache-store",
-                "pre-artifact-rename")
+                "pre-artifact-rename", "mid-fleet-shard")
 FAULT_SITES = ("subprocess", "fasta", "gfa", "native_load", "native_abi",
                "native_build", "stream_write", "stream_read",
                "stream_format") + CRASH_POINTS

@@ -1372,6 +1372,137 @@ def chaossmoke_row(root=None) -> dict:
     return row
 
 
+FLEETSMOKE_PATH = Path(__file__).resolve().parent / "FLEETSMOKE.json"
+
+# one child per mode so jit caches, the device runtime and the fleet knobs
+# never leak between the serial oracle and the fleet run
+_FLEETSMOKE_CHILD = r"""
+import sys
+from autocycler_tpu.commands.batch import batch
+sys.exit(batch(sys.argv[1], sys.argv[2], k_size=int(sys.argv[3]),
+               threads=int(sys.argv[4])))
+"""
+
+
+def bench_fleetsmoke() -> None:
+    """`python bench.py fleetsmoke`: the fleet runner vs the serial oracle
+    on a 16-isolate synthetic batch (3 assemblies each). Two child runs of
+    `autocycler batch` — AUTOCYCLER_FLEET_MODE=off, then =on with two
+    forced host devices (--xla_force_host_platform_device_count) — and two
+    gates: per-isolate final outputs byte-identical (ALWAYS enforced; the
+    fleet path must be a pure reordering), and fleet wall <= 0.8x serial
+    wall, enforced only when the host has >= 2 usable cores (a one-core
+    box can't overlap anything, so the speedup is recorded, not gated).
+    Writes FLEETSMOKE.json (surfaced by `bench.py trend`); one JSON line
+    on stdout; exit 1 on fail."""
+    import os
+    import shutil
+    import subprocess
+
+    tests_dir = str(Path(__file__).resolve().parent / "tests")
+    if tests_dir not in sys.path:
+        sys.path.insert(0, tests_dir)
+    from synthetic import make_isolate_dirs
+
+    from autocycler_tpu.utils.chaos import artifact_digests
+
+    n_isolates, kmer, threads, devices = 16, 21, 2, 2
+    t0 = time.perf_counter()
+    tmp = Path(tempfile.mkdtemp(prefix="autocycler_fleetsmoke_"))
+    parent = make_isolate_dirs(tmp / "isolates", n_isolates, seed0=11,
+                               n_assemblies=3, chromosome_len=800,
+                               plasmid_len=150)
+    child = tmp / "child.py"
+    child.write_text(_FLEETSMOKE_CHILD)
+    setup_s = time.perf_counter() - t0
+    repo_root = str(Path(__file__).resolve().parent)
+
+    def run(mode_env, out_name):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        env.update({"JAX_PLATFORMS": "cpu"})
+        env.pop("AUTOCYCLER_CRASH_POINTS", None)
+        env.pop("AUTOCYCLER_FAULTS", None)
+        env.update(mode_env)
+        t = time.perf_counter()
+        res = subprocess.run(
+            [sys.executable, str(child), str(parent), str(tmp / out_name),
+             str(kmer), str(threads)],
+            env=env, capture_output=True, text=True, timeout=1800)
+        wall = time.perf_counter() - t
+        if res.returncode != 0:
+            print(res.stdout[-4000:], file=sys.stderr)
+            print(res.stderr[-4000:], file=sys.stderr)
+            raise RuntimeError(f"fleetsmoke child ({out_name}) failed "
+                               f"rc={res.returncode}")
+        return wall
+
+    serial_wall = run({"AUTOCYCLER_FLEET_MODE": "off"}, "serial")
+    fleet_wall = run(
+        {"AUTOCYCLER_FLEET_MODE": "on",
+         "XLA_FLAGS": f"--xla_force_host_platform_device_count={devices}"},
+        "fleet")
+
+    serial = artifact_digests(tmp / "serial")
+    fleet = artifact_digests(tmp / "fleet")
+    byte_identical = bool(serial) and serial == fleet \
+        and all(v is not None for v in serial.values())
+    cores = os.cpu_count() or 1
+    speedup = serial_wall / fleet_wall if fleet_wall else None
+    gate_enforced = cores >= 2
+    speedup_ok = (fleet_wall <= 0.8 * serial_wall) if gate_enforced else True
+    passed = bool(byte_identical and speedup_ok)
+    artifact = {
+        "bench": "fleetsmoke",
+        "passed": passed,
+        "byte_identical": byte_identical,
+        "n_isolates": n_isolates,
+        "n_artifacts": len(serial),
+        "devices": devices,
+        "threads": threads,
+        "cores": cores,
+        "serial_wall_s": round(serial_wall, 2),
+        "fleet_wall_s": round(fleet_wall, 2),
+        "speedup": round(speedup, 2) if speedup else None,
+        "gate_enforced": gate_enforced,
+        "speedup_ok": speedup_ok,
+        "setup_s": round(setup_s, 2),
+    }
+    FLEETSMOKE_PATH.write_text(json.dumps(artifact, indent=2) + "\n")
+    print(json.dumps(artifact))
+    shutil.rmtree(tmp, ignore_errors=True)
+    if not passed:
+        sys.exit(1)
+
+
+def fleetsmoke_row(root=None) -> dict:
+    """The latest fleetsmoke artifact as one trend row; every field
+    optional (absent/invalid artifact → None-valued row, never a raise)."""
+    path = Path(root) / "FLEETSMOKE.json" if root is not None \
+        else FLEETSMOKE_PATH
+    row = {"present": False, "passed": None, "byte_identical": None,
+           "n_isolates": None, "speedup": None, "gate_enforced": None,
+           "serial_wall_s": None, "fleet_wall_s": None}
+    try:
+        data = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return row
+    if not isinstance(data, dict):
+        return row
+    row.update({
+        "present": True,
+        "passed": data.get("passed"),
+        "byte_identical": data.get("byte_identical"),
+        "n_isolates": data.get("n_isolates"),
+        "speedup": data.get("speedup"),
+        "gate_enforced": data.get("gate_enforced"),
+        "serial_wall_s": data.get("serial_wall_s"),
+        "fleet_wall_s": data.get("fleet_wall_s"),
+    })
+    return row
+
+
 GUARD_BASELINE_PATH = Path(__file__).resolve().parent / "BENCH_GUARD.json"
 GUARD_TOLERANCE = 1.25
 
@@ -1847,6 +1978,21 @@ def bench_trend() -> None:
               f"crash points recovered byte-identically "
               f"in {fmt(chaos.get('wall_s'), '.1f')}s  (CHAOSSMOKE.json)",
               file=sys.stderr)
+    fleetrow = fleetsmoke_row()
+    if fleetrow.get("present"):
+        verdict = "ok" if fleetrow.get("passed") else "FAIL"
+        gate = "enforced" if fleetrow.get("gate_enforced") \
+            else "recorded only (too few cores)"
+        print("", file=sys.stderr)
+        print(f"fleetsmoke: {verdict} "
+              f"{fmt(fleetrow.get('speedup'), '.2f')}x over serial batch "
+              f"on {fmt(fleetrow.get('n_isolates'))} isolates "
+              f"(gate {gate}, "
+              f"serial {fmt(fleetrow.get('serial_wall_s'), '.1f')}s, "
+              f"fleet {fmt(fleetrow.get('fleet_wall_s'), '.1f')}s, "
+              f"bytes identical: {fleetrow.get('byte_identical')})  "
+              f"(FLEETSMOKE.json)",
+              file=sys.stderr)
     serve = servesmoke_row()
     if serve.get("present"):
         verdict = "ok" if serve.get("passed") else "FAIL"
@@ -1863,7 +2009,8 @@ def bench_trend() -> None:
     print(json.dumps({"bench": "trend", "rounds": rows,
                       "multichip": mrows, "lintsmoke": lint,
                       "sketchsmoke": sketch, "streamsmoke": stream,
-                      "chaossmoke": chaos, "servesmoke": serve}))
+                      "chaossmoke": chaos, "fleetsmoke": fleetrow,
+                      "servesmoke": serve}))
 
 
 def main() -> None:
@@ -1909,6 +2056,8 @@ def main() -> None:
         bench_streamsmoke()
     elif len(sys.argv) > 1 and sys.argv[1] == "chaossmoke":
         bench_chaossmoke()
+    elif len(sys.argv) > 1 and sys.argv[1] == "fleetsmoke":
+        bench_fleetsmoke()
     elif len(sys.argv) > 1 and sys.argv[1] == "guard":
         bench_guard(sys.argv[2:])
     elif len(sys.argv) > 1 and sys.argv[1] == "trend":

@@ -22,3 +22,35 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.fixture
+def forced_devices():
+    """Run a python snippet in a child pinned to N virtual CPU devices.
+
+    The suite's own interpreter is locked to the 8-device emulation above
+    (XLA flags are read once at jax init), so tests that need a specific
+    device count — the fleet runner's mesh sharding, isolate counts not
+    divisible by the mesh — spawn a child with
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=N`` instead.
+    Returns a runner: ``run(n, code, env_extra=None)`` ->
+    ``subprocess.CompletedProcess`` (text mode, output captured)."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def run(n, code, env_extra=None, timeout=600):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n}"
+        env.update(env_extra or {})
+        return subprocess.run([sys.executable, "-c", code], env=env,
+                              capture_output=True, text=True,
+                              timeout=timeout)
+
+    return run

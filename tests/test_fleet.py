@@ -1,0 +1,271 @@
+"""The fleet runner: bucketed packing, ladder shapes, sharded contraction
+parity, and fleet-vs-serial byte identity on real `autocycler batch` runs.
+
+The planner/padding tests cover the adversarial shapes named in the design:
+one 6 Mbp isolate among 2 kb plasmids (skew must not make every shard pay
+chromosome padding), isolate counts not divisible by the device count, and
+the single-isolate fleet that must degrade to the serial path bit for bit.
+The child-process test forces a real multi-device host platform
+(--xla_force_host_platform_device_count) so `shard_leading_axis` actually
+shards rather than silently degrading to one device.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from synthetic import make_isolate_dirs
+
+from autocycler_tpu.parallel import fleet
+
+pytestmark = pytest.mark.fleet
+
+
+# ---- planning ----
+
+def test_plan_skew_isolates_giant_from_plasmid_shards():
+    # one 6 Mbp chromosome isolate among seven 2 kb plasmid isolates: the
+    # giant must share a shard with at most one other isolate from the top
+    # bucket, and no pure-plasmid shard may contain it (their padding
+    # stays at plasmid scale)
+    costs = {"giant": 6_000_000}
+    costs.update({f"plasmid_{i}": 2_000 + i for i in range(7)})
+    plan = fleet.plan_fleet(costs, shard_size=4, n_buckets=4)
+    assert plan.n_buckets == 4
+    all_names = [n for sh in plan.shards for n in sh.names]
+    assert sorted(all_names) == sorted(costs)          # exactly once each
+    giant_shards = [sh for sh in plan.shards if "giant" in sh.names]
+    assert len(giant_shards) == 1
+    assert giant_shards[0].bucket == 0                 # top size bucket
+    # 8 isolates / 4 buckets = 2 per bucket: the giant drags at most one
+    # plasmid into its bucket; the other six never pay its padding
+    assert len(giant_shards[0].names) <= 2
+    for sh in plan.shards:
+        if sh is not giant_shards[0]:
+            assert "giant" not in sh.names
+            assert len(sh.names) <= 4
+
+
+def test_plan_deterministic_and_respects_shard_size():
+    costs = {f"iso_{i}": (i * 37) % 11 for i in range(13)}
+    a = fleet.plan_fleet(costs, shard_size=3, n_buckets=2)
+    b = fleet.plan_fleet(dict(reversed(list(costs.items()))),
+                         shard_size=3, n_buckets=2)
+    assert a == b                                      # dict order ignored
+    assert all(len(sh.names) <= 3 for sh in a.shards)
+    assert [sh.index for sh in a.shards] == list(range(len(a.shards)))
+
+
+def test_plan_count_not_divisible_by_shard_size():
+    costs = {f"iso_{i}": 100 - i for i in range(5)}
+    plan = fleet.plan_fleet(costs, shard_size=2, n_buckets=1)
+    assert [len(sh.names) for sh in plan.shards] == [2, 2, 1]
+    assert [n for sh in plan.shards for n in sh.names] == \
+        [f"iso_{i}" for i in range(5)]                 # descending cost
+
+
+def test_bucket_dim_power_of_two_ladder():
+    assert fleet.bucket_dim(1, 8) == 8
+    assert fleet.bucket_dim(8, 8) == 8
+    assert fleet.bucket_dim(9, 8) == 16
+    assert fleet.bucket_dim(17, 8) == 32
+    assert fleet.bucket_dim(3, 64) == 64
+    assert fleet.bucket_dim(65, 64) == 128
+    # ladder shapes, not exact shapes: at most log2(range) compiles
+    dims = {fleet.bucket_dim(n, 8) for n in range(1, 200)}
+    assert dims == {8, 16, 32, 64, 128, 256}
+
+
+def test_fleet_engaged_rules(monkeypatch):
+    monkeypatch.setenv("AUTOCYCLER_FLEET_DEVICES", "4")
+    assert not fleet.fleet_engaged("off", 10)
+    assert not fleet.fleet_engaged("on", 1)            # nothing to pack
+    assert not fleet.fleet_engaged("auto", 1)
+    assert fleet.fleet_engaged("on", 2)
+    assert fleet.fleet_engaged("auto", 2)
+    monkeypatch.setenv("AUTOCYCLER_FLEET_DEVICES", "1")
+    assert not fleet.fleet_engaged("auto", 10)         # one device: serial
+    assert fleet.fleet_engaged("on", 10)
+
+
+def test_resolve_fleet_mode_knob_and_validation(monkeypatch):
+    from autocycler_tpu.utils.resilience import InputError
+
+    monkeypatch.delenv("AUTOCYCLER_FLEET_MODE", raising=False)
+    assert fleet.resolve_fleet_mode(None) == "off"
+    monkeypatch.setenv("AUTOCYCLER_FLEET_MODE", "auto")
+    assert fleet.resolve_fleet_mode(None) == "auto"
+    assert fleet.resolve_fleet_mode("on") == "on"      # CLI wins
+    monkeypatch.setenv("AUTOCYCLER_FLEET_MODE", "warp")
+    with pytest.raises(InputError, match="unknown fleet mode"):
+        fleet.resolve_fleet_mode(None)
+
+
+def test_isolate_cost_counts_assembly_bytes(tmp_path):
+    d = tmp_path / "iso"
+    d.mkdir()
+    (d / "a.fasta").write_text(">c\n" + "A" * 100 + "\n")
+    (d / "b.fa").write_text(">c\n" + "C" * 50 + "\n")
+    (d / "notes.txt").write_text("ignored")
+    assert fleet.isolate_cost(d) == (100 + 3 + 1) + (50 + 3 + 1)
+    assert fleet.isolate_cost(tmp_path / "missing") == 0
+
+
+# ---- contraction parity ----
+
+def _random_membership(rng, s, u):
+    M = (rng.random((s, u)) < 0.4).astype(np.int32)
+    w = rng.integers(1, 50, size=u).astype(np.int64)
+    return M, w
+
+
+def _host_expected(M, w):
+    return (M.astype(np.int64) * w[None, :]) @ M.astype(np.int64).T
+
+
+@pytest.mark.parametrize("devices", [None, 3])
+def test_fleet_intersections_match_host_matmul(devices):
+    # ragged isolate shapes, count not divisible by the device count —
+    # padding plus sharding must be invisible in the results
+    rng = np.random.default_rng(5)
+    shapes = [(3, 10), (7, 130), (1, 5), (12, 64), (5, 70)]
+    Ms, ws = zip(*(_random_membership(rng, s, u) for s, u in shapes))
+    out = fleet.fleet_membership_intersections(list(Ms), list(ws),
+                                               devices=devices)
+    assert len(out) == len(Ms)
+    for M, w, inter in zip(Ms, ws, out):
+        assert inter.dtype == np.int64
+        assert inter.shape == (M.shape[0], M.shape[0])
+        np.testing.assert_array_equal(inter, _host_expected(M, w))
+
+
+def test_fleet_intersections_int32_overflow_takes_host_path():
+    rng = np.random.default_rng(6)
+    M_small, w_small = _random_membership(rng, 4, 20)
+    # weights past int32 accumulation range: must fall back to the exact
+    # int64 host matmul for THIS isolate only, same as the serial path
+    M_big = np.ones((3, 40), dtype=np.int32)
+    w_big = np.full(40, 2**28, dtype=np.int64)
+    out = fleet.fleet_membership_intersections(
+        [M_small, M_big], [w_small, w_big], devices=2)
+    np.testing.assert_array_equal(out[0], _host_expected(M_small, w_small))
+    np.testing.assert_array_equal(out[1], _host_expected(M_big, w_big))
+    assert out[1][0, 0] == 40 * 2**28                  # > int32 max
+
+
+def test_fleet_intersections_empty():
+    assert fleet.fleet_membership_intersections([], []) == []
+
+
+_CHILD_PARITY = r"""
+import json
+import numpy as np
+import jax
+from autocycler_tpu.parallel import fleet
+
+assert len(jax.devices()) == 4, jax.devices()
+rng = np.random.default_rng(11)
+Ms, ws = [], []
+for s, u in [(3, 9), (5, 40), (2, 70), (6, 12), (4, 33)]:
+    Ms.append((rng.random((s, u)) < 0.5).astype(np.int32))
+    ws.append(rng.integers(1, 30, size=u).astype(np.int64))
+out = fleet.fleet_membership_intersections(Ms, ws, devices=4)
+expect = [(m.astype(np.int64) * w[None, :]) @ m.astype(np.int64).T
+          for m, w in zip(Ms, ws)]
+assert all(np.array_equal(a, b) for a, b in zip(out, expect))
+print(json.dumps({"ok": True, "devices": len(jax.devices()),
+                  "checksum": int(sum(int(a.sum()) for a in out))}))
+"""
+
+
+def test_sharded_parity_on_forced_four_device_child(forced_devices):
+    # the suite interpreter is pinned to 8 emulated devices at import; a
+    # child with XLA_FLAGS=--xla_force_host_platform_device_count=4 proves
+    # the mesh sharding path is exercised with a real >1 device platform
+    res = forced_devices(4, _CHILD_PARITY)
+    assert res.returncode == 0, res.stderr[-3000:]
+    payload = json.loads(res.stdout.strip().splitlines()[-1])
+    assert payload["ok"] and payload["devices"] == 4
+
+
+# ---- end-to-end byte identity ----
+
+def _final_digests(out_dir):
+    from autocycler_tpu.utils.chaos import artifact_digests
+    return artifact_digests(out_dir)
+
+
+def test_fleet_batch_byte_identical_to_serial(tmp_path, monkeypatch):
+    from autocycler_tpu.commands.batch import batch
+
+    parent = make_isolate_dirs(tmp_path / "isolates", 3, seed0=3,
+                               n_assemblies=3, chromosome_len=160,
+                               plasmid_len=70)
+    rc = batch(parent, tmp_path / "serial", k_size=21, fleet="off")
+    assert rc == 0
+    monkeypatch.setenv("AUTOCYCLER_FLEET_DEVICES", "2")
+    rc = batch(parent, tmp_path / "fleet", k_size=21, fleet="on")
+    assert rc == 0
+    serial = _final_digests(tmp_path / "serial")
+    assert len(serial) == 9 and all(serial.values())   # 3 isolates x 3
+    assert _final_digests(tmp_path / "fleet") == serial
+    manifest = json.loads(
+        (tmp_path / "fleet" / "batch_manifest.json").read_text())
+    assert all(e["status"] == "done" for e in manifest["items"].values())
+
+
+def test_single_isolate_fleet_degrades_to_serial_bit_for_bit(tmp_path):
+    from autocycler_tpu.commands.batch import batch
+
+    parent = make_isolate_dirs(tmp_path / "isolates", 1, seed0=9,
+                               n_assemblies=3, chromosome_len=160,
+                               plasmid_len=70)
+    rc = batch(parent, tmp_path / "serial", k_size=21, fleet="off")
+    assert rc == 0
+    # fleet explicitly ON, but a single isolate has nothing to pack: the
+    # run must take the serial code path and produce identical bytes
+    rc = batch(parent, tmp_path / "fleet", k_size=21, fleet="on")
+    assert rc == 0
+    serial = _final_digests(tmp_path / "serial")
+    assert len(serial) == 3 and all(serial.values())
+    assert _final_digests(tmp_path / "fleet") == serial
+
+
+class _Crash(RuntimeError):
+    """Stands in for the os._exit a real crash injection performs."""
+
+
+def test_fleet_resume_after_mid_shard_kill_reenters_cleanly(
+        tmp_path, monkeypatch):
+    # in-process twin of the chaos cycle: arm the crash point so the first
+    # run dies between a shard's compress checkpoints and its cluster
+    # stage, then --resume must finish byte-identically to serial
+    from autocycler_tpu.commands.batch import batch
+    from autocycler_tpu.utils import resilience as rz
+
+    def _raise(code):
+        raise _Crash(code)
+
+    parent = make_isolate_dirs(tmp_path / "isolates", 2, seed0=4,
+                               n_assemblies=3, chromosome_len=160,
+                               plasmid_len=70)
+    rc = batch(parent, tmp_path / "serial", k_size=21, fleet="off")
+    assert rc == 0
+    monkeypatch.setenv("AUTOCYCLER_FLEET_DEVICES", "1")
+    monkeypatch.setenv("AUTOCYCLER_CRASH_POINTS", "mid-fleet-shard")
+    monkeypatch.setattr(rz, "_exit", _raise)
+    # hit counters are process-lifetime; earlier in-process fleet runs in
+    # this suite have already passed the point
+    rz._reset_crash_hits_for_tests()
+    try:
+        with pytest.raises(_Crash):
+            batch(parent, tmp_path / "fleet", k_size=21, fleet="on")
+    finally:
+        rz._reset_crash_hits_for_tests()
+    monkeypatch.delenv("AUTOCYCLER_CRASH_POINTS")
+    rc = batch(parent, tmp_path / "fleet", k_size=21, fleet="on",
+               resume=True)
+    assert rc == 0
+    assert _final_digests(tmp_path / "fleet") == \
+        _final_digests(tmp_path / "serial")

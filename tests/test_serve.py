@@ -469,3 +469,107 @@ def test_burn_rate_shedding_503_retry_after_and_recovery(serve_handle,
     assert status == 202
     assert _wait_job(endpoint, rec["id"])["state"] == "done"
     capsys.readouterr()
+
+
+# ------------------------------------------------------------ fleet batch
+
+
+def test_fleet_batch_protocol_validation():
+    from autocycler_tpu.serve.protocol import (is_fleet_batch,
+                                               parse_batch_spec,
+                                               validate_fleet_batch)
+    from autocycler_tpu.utils.resilience import InputError
+
+    body = {"fleet": True, "command": "pipeline", "kmer": 21,
+            "batch": [{"assemblies_dir": "/a"}, {"assemblies_dir": "/b"}]}
+    assert is_fleet_batch(body)
+    assert not is_fleet_batch({"batch": [{"assemblies_dir": "/a"}]})
+    assert not is_fleet_batch({"fleet": True})          # no batch array
+    # "fleet" is routing, not a shared spec field: it must not leak into
+    # the merged per-item specs (parse_job_spec rejects unknown fields)
+    specs = parse_batch_spec(body)
+    assert len(specs) == 2 and all(s.kmer == 21 for s in specs)
+    validate_fleet_batch(specs)
+
+    mixed_k = parse_batch_spec({
+        "fleet": 1, "command": "pipeline",
+        "batch": [{"assemblies_dir": "/a", "kmer": 21},
+                  {"assemblies_dir": "/b", "kmer": 31}]})
+    with pytest.raises(InputError, match="uniform 'kmer'"):
+        validate_fleet_batch(mixed_k)
+    compress_only = parse_batch_spec({
+        "fleet": 1,
+        "batch": [{"assemblies_dir": "/a"}, {"assemblies_dir": "/b"}]})
+    with pytest.raises(InputError, match="pipeline"):
+        validate_fleet_batch(compress_only)
+
+
+def test_fleet_batch_one_admission_fans_over_mesh(serve_handle, tmp_path,
+                                                  monkeypatch, capsys):
+    """A fleet POST admits as ONE job whose execution runs every item
+    through the fleet runner, with per-item consensus outputs."""
+    monkeypatch.setenv("AUTOCYCLER_FLEET_DEVICES", "1")
+    iso_a = make_assemblies(tmp_path / "iso_a", n_assemblies=3,
+                            chromosome_len=160, plasmid_len=70, seed=3)
+    iso_b = make_assemblies(tmp_path / "iso_b", n_assemblies=3,
+                            chromosome_len=160, plasmid_len=70, seed=4)
+    endpoint = serve_handle.endpoint
+    status, rec = _request(endpoint, "POST", "/jobs", body={
+        "fleet": True, "command": "pipeline", "kmer": 21, "threads": 1,
+        "batch": [{"assemblies_dir": str(iso_a)},
+                  {"assemblies_dir": str(iso_b)}]})
+    assert status == 202
+    assert rec["fleet"] == 2                  # one admission, two items
+    assert rec["id"].startswith("job-")       # a job slot, not a batch id
+    record = _wait_job(endpoint, rec["id"])
+    assert record["state"] == "done", record.get("error")
+    out = tmp_path / "serve" / "jobs" / rec["id"] / "out"
+    for name in ("isolate-00", "isolate-01"):
+        assert (out / name / "consensus_assembly.fasta").is_file()
+        assert (out / name / "input_assemblies.gfa").is_file()
+    # the fleet manifest records per-isolate stage checkpoints for replay
+    manifest = json.loads((tmp_path / "serve" / "jobs" / rec["id"]
+                           / "fleet_manifest.json").read_text())
+    assert sorted(manifest["items"]) == ["isolate-00", "isolate-01"]
+    assert all(e["status"] == "done" for e in manifest["items"].values())
+    capsys.readouterr()
+
+
+def test_fleet_batch_rejects_invalid_with_400(serve_handle, tmp_path):
+    endpoint = serve_handle.endpoint
+    status, err = _request(endpoint, "POST", "/jobs", body={
+        "fleet": True,
+        "batch": [{"assemblies_dir": str(tmp_path)},
+                  {"assemblies_dir": str(tmp_path)}]})
+    assert status == 400
+    assert "pipeline" in err["error"]
+
+
+def test_fleet_job_replays_after_daemon_restart(tmp_path, capsys):
+    """A daemon that dies with a fleet admission queued (or running)
+    rebuilds it from the manifest entry alone — as ONE fleet job, not a
+    single-spec job."""
+    from autocycler_tpu.serve.protocol import parse_job_spec
+    from autocycler_tpu.serve.scheduler import Scheduler
+
+    root = tmp_path / "serve"
+    sched1 = Scheduler(root, workers=1)   # never started: job stays queued
+    specs = [parse_job_spec({"assemblies_dir": f"/iso/{i}",
+                             "command": "pipeline"}) for i in range(3)]
+    job = sched1.submit_fleet(specs)
+    assert job.fleet_specs and len(job.fleet_specs) == 3
+    assert job.to_dict()["fleet"] == 3
+
+    sched2 = Scheduler(root, workers=1)
+    replayed = sched2.job(job.id)
+    assert replayed is not None
+    assert replayed.fleet_specs is not None
+    assert [s.assemblies_dir for s in replayed.fleet_specs] == \
+        [f"/iso/{i}" for i in range(3)]
+    assert replayed.state == "queued" and not replayed.resumed
+
+    # caught mid-run: the replayed job must resume, not restart
+    sched2.manifest.start(job.id)
+    sched3 = Scheduler(root, workers=1)
+    assert sched3.job(job.id).resumed
+    capsys.readouterr()
