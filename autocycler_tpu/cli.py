@@ -183,6 +183,11 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="PATH",
                    help="additionally write a self-contained run_report.html "
                         "(into the run dir, or to PATH when given)")
+    p.add_argument("--correlate", default=None, metavar="TRACE_ID",
+                   help="cross-run mode: merge every trace under run_dir "
+                        "carrying this correlation id (client submissions, "
+                        "replica jobs, fleet shards) into one Chrome trace "
+                        "with one process lane per run")
 
     p = sub.add_parser("resolve", help="resolve repeats in the unitig graph")
     p.add_argument("-c", "--cluster_dir", required=True)
@@ -226,6 +231,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="daemon Unix socket path")
     p.add_argument("-d", "--dir", dest="serve_dir",
                    help="daemon root — reads its serve.json discovery file")
+    p.add_argument("--fleet-dir", dest="fleet_dir",
+                   help="fleet dir of replica serve roots: route the job "
+                        "to the least-loaded healthy replica (probes each "
+                        "replica's /healthz; overrides --server/--dir)")
+    p.add_argument("--trace-id", dest="trace_id",
+                   help="correlation id to propagate (default: minted per "
+                        "submission; see `autocycler report --correlate`)")
     p.add_argument("--command", dest="job_command", default="compress",
                    choices=["compress", "pipeline"],
                    help="compress only, or the full per-isolate pipeline "
@@ -293,6 +305,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="--follow refresh interval in seconds (default 2)")
     p.add_argument("--cycles", type=int,
                    help="--follow: stop after this many frames")
+    p.add_argument("--fleet", action="store_true",
+                   help="federated view: treat DIR as a fleet dir of "
+                        "replica serve roots, poll every replica's /healthz "
+                        "+ /metrics and render the merged snapshot with the "
+                        "scale verdict (writes fleet_status.json)")
 
     p = sub.add_parser("trim", help="trim contigs in a cluster")
     p.add_argument("-c", "--cluster_dir", required=True)
@@ -355,7 +372,8 @@ def dispatch(args) -> int:
                     report_path=args.report, knobs_md=args.knobs_md)
     elif args.command == "report":
         from .obs.report import report
-        return report(args.run_dir, as_json=args.json, html=args.html)
+        return report(args.run_dir, as_json=args.json, html=args.html,
+                      correlate=args.correlate)
     elif args.command == "resolve":
         from .commands.resolve import resolve
         resolve(args.cluster_dir, args.verbose)
@@ -369,10 +387,12 @@ def dispatch(args) -> int:
         from .serve.client import submit
         return submit(args.assemblies_dir, server=args.server,
                       socket_path=args.socket_path, serve_dir=args.serve_dir,
+                      fleet_dir=args.fleet_dir,
                       command=args.job_command, out_dir=args.out_dir,
                       kmer=args.kmer, max_contigs=args.max_contigs,
                       threads=args.threads, wait=args.wait,
-                      follow=args.follow, timeout=args.timeout)
+                      follow=args.follow, timeout=args.timeout,
+                      trace_id=args.trace_id)
     elif args.command == "subsample":
         from .commands.subsample import subsample
         subsample(args.reads, args.out_dir, args.genome_size, args.count,
@@ -387,7 +407,8 @@ def dispatch(args) -> int:
     elif args.command == "top":
         from .obs.top import top
         return top(args.dir, follow=args.follow and not args.once,
-                   interval=args.interval, cycles=args.cycles)
+                   interval=args.interval, cycles=args.cycles,
+                   fleet=args.fleet)
     elif args.command == "watch":
         from .obs.watch import watch
         return watch(args.run_dir, follow=args.follow and not args.once,

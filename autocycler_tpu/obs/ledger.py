@@ -216,10 +216,13 @@ def _cache_lineage() -> dict:
 
 
 def build_ledger(command: Optional[str] = None,
-                 scope: Optional[str] = None) -> dict:
+                 scope: Optional[str] = None,
+                 trace_id: Optional[str] = None) -> dict:
     """The full ledger payload. With ``scope``, only inputs and stage
     entries tagged with that isolate scope are included — each concurrent
-    serve job's ledger carries exactly its own lineage."""
+    serve job's ledger carries exactly its own lineage. ``trace_id`` (the
+    submission's correlation id) is recorded as an additive key so a
+    ledger links back to the client-side submission."""
     with _lock:
         inputs = {key[1]: dict(digest) for key, digest in _inputs.items()
                   if scope is None or _in_scope(key[0], scope)}
@@ -236,16 +239,19 @@ def build_ledger(command: Optional[str] = None,
     }
     if command:
         ledger["command"] = command
+    if trace_id:
+        ledger["trace_id"] = trace_id
     return ledger
 
 
 def write_ledger(run_dir, command: Optional[str] = None,
-                 scope: Optional[str] = None) -> Optional[Path]:
+                 scope: Optional[str] = None,
+                 trace_id: Optional[str] = None) -> Optional[Path]:
     """Write ``ledger.json`` atomically (tempfile + rename — a reader or a
     crash never sees a torn ledger). Returns the path, or None when there
     is nothing to record or the write failed. ``scope`` filters to one
     isolate scope's entries (see :func:`build_ledger`)."""
-    payload = build_ledger(command, scope=scope)
+    payload = build_ledger(command, scope=scope, trace_id=trace_id)
     if not payload["inputs"] and not payload["stages"]:
         return None
     path = Path(run_dir) / LEDGER_JSON

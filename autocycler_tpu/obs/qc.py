@@ -174,13 +174,15 @@ def summary(journal: Optional[List[dict]] = None) -> dict:
     return out
 
 
-def write_qc_report(run_dir, scope: Optional[str] = None) -> Optional[Path]:
+def write_qc_report(run_dir, scope: Optional[str] = None,
+                    trace_id: Optional[str] = None) -> Optional[Path]:
     """Write ``qc_report.json`` (journal + summary) atomically into the run
     directory; returns the path (None on failure or empty journal —
     telemetry never fails the pipeline). With ``scope``, only entries
     tagged with that isolate scope are written — how concurrent serve jobs
     each get a report of exactly their own entries from the shared
-    journal."""
+    journal. ``trace_id`` (the submission's correlation id) rides along as
+    an additive payload key."""
     with _lock:
         selected = [dict(e) for e in _entries
                     if scope is None or _in_scope(e, scope)]
@@ -188,6 +190,8 @@ def write_qc_report(run_dir, scope: Optional[str] = None) -> Optional[Path]:
         return None
     payload = {"schema": 1, "created_epoch": round(time.time(), 3),
                "entries": selected}
+    if trace_id:
+        payload["trace_id"] = trace_id
     payload["summary"] = summary(selected)
     path = Path(run_dir) / QC_REPORT_JSON
     try:

@@ -28,6 +28,7 @@ from __future__ import annotations
 import html as _html
 import json
 import sys
+import time
 from pathlib import Path
 from typing import Dict, List, Optional
 
@@ -772,11 +773,117 @@ def render_html(report: dict) -> str:
     return "\n".join(parts) + "\n"
 
 
+def find_correlated_traces(root, trace_id: str) -> List[dict]:
+    """Every trace under ``root`` carrying ``trace_id``: the run header's
+    ``trace_id`` (daemon-side jobs) or any span whose ``trace`` attr
+    matches (fleet shards, legacy runs). Searches the root itself, its
+    ``jobs/*`` run dirs, and one replica level down (``<replica>/jobs/*``)
+    — the fleet-dir layout. Returns [{"path", "rel", "trace"}] sorted by
+    run start time."""
+    root = Path(root)
+    candidates: List[Path] = []
+    for pattern in (TRACE_JSONL, f"jobs/*/{TRACE_JSONL}",
+                    f"*/{TRACE_JSONL}", f"*/jobs/*/{TRACE_JSONL}"):
+        candidates.extend(root.glob(pattern))
+    matched: List[dict] = []
+    seen = set()
+    for path in candidates:
+        resolved = path.resolve()
+        if resolved in seen:
+            continue
+        seen.add(resolved)
+        loaded = load_trace(path)
+        if loaded is None:
+            continue
+        run = loaded.get("run") or {}
+        hit = run.get("trace_id") == trace_id or any(
+            (s.get("attrs") or {}).get("trace") == trace_id
+            for s in loaded["spans"])
+        if not hit:
+            continue
+        try:
+            rel = str(path.parent.relative_to(root)) or "."
+        except ValueError:
+            rel = str(path.parent)
+        matched.append({"path": path, "rel": rel, "trace": loaded})
+    matched.sort(key=lambda m: (m["trace"]["run"].get("t0_epoch") or 0.0,
+                                m["rel"]))
+    return matched
+
+
+def write_correlated_trace(root, trace_id: str,
+                           out_path=None) -> Optional[Path]:
+    """Merge every trace under ``root`` matching ``trace_id`` into ONE
+    Chrome trace: one process lane per matched run (labelled by its run
+    dir relative to ``root``), events aligned on a shared wall clock via
+    each run header's ``t0_epoch`` — so the client's submit, each
+    replica's job and its fleet shards render on one timeline. Returns the
+    output path, or None when nothing matched."""
+    root = Path(root)
+    matched = find_correlated_traces(root, trace_id)
+    if not matched:
+        return None
+    t0 = min(m["trace"]["run"].get("t0_epoch") or 0.0 for m in matched)
+    events: List[dict] = []
+    for pid, m in enumerate(matched, start=1):
+        run = m["trace"]["run"]
+        label = m["rel"] if m["rel"] != "." else (run.get("name") or "run")
+        offset_s = (run.get("t0_epoch") or t0) - t0
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"name": label}})
+        for s in m["trace"]["spans"]:
+            events.append({
+                "name": s.get("name", "?"), "cat": s.get("cat", "span"),
+                "ph": "X",
+                "ts": round((offset_s + (s.get("ts") or 0.0)) * 1e6, 3),
+                "dur": round((s.get("dur") or 0.0) * 1e6, 3),
+                "pid": pid, "tid": s.get("tid", 0),
+                "args": dict(s.get("attrs", {}),
+                             **({"mem": s["mem"]} if "mem" in s else {})),
+            })
+    out = Path(out_path) if out_path \
+        else root / f"trace_correlated_{trace_id}.chrome.json"
+    out.write_text(json.dumps({"traceEvents": events,
+                               "displayTimeUnit": "ms"}))
+    return out
+
+
+def correlate_report(root, trace_id: str) -> int:
+    """CLI body of `autocycler report --correlate <id>`."""
+    matched = find_correlated_traces(root, trace_id)
+    if not matched:
+        print(f"Error: no trace under {root} carries correlation id "
+              f"{trace_id!r} (looked in {TRACE_JSONL}, jobs/*/, */jobs/*/)",
+              file=sys.stderr)
+        return 1
+    try:
+        out = write_correlated_trace(root, trace_id)
+    except OSError as e:
+        print(f"Error: could not write merged trace: {e}", file=sys.stderr)
+        return 1
+    print(f"correlation {trace_id}: {len(matched)} trace(s)")
+    for m in matched:
+        run = m["trace"]["run"]
+        spans = m["trace"]["spans"]
+        t0_epoch = run.get("t0_epoch")
+        started = time.strftime("%H:%M:%S", time.localtime(t0_epoch)) \
+            if isinstance(t0_epoch, (int, float)) else "?"
+        print(f"  {m['rel']:40s} {run.get('name', '?'):20s} "
+              f"{len(spans):5d} spans  started {started}")
+    print(f"merged Chrome trace: {out}")
+    return 0
+
+
 def report(run_dir, as_json: bool = False,
-           html: Optional[str] = None) -> int:
+           html: Optional[str] = None,
+           correlate: Optional[str] = None) -> int:
     """CLI entry point for `autocycler report`. ``html`` of "" writes
     ``run_report.html`` into the run dir; a non-empty value is the output
-    path; None skips HTML."""
+    path; None skips HTML. ``correlate`` switches to cross-run mode:
+    merge every trace under ``run_dir`` carrying that correlation id into
+    one Chrome trace with one process lane per replica/shard."""
+    if correlate:
+        return correlate_report(run_dir, correlate)
     built = build_report(run_dir)
     if built is None:
         print(f"Error: no telemetry found in {run_dir} (expected "

@@ -323,19 +323,130 @@ def _latency_line(slo: Optional[dict], entries: List[dict]) -> Optional[str]:
     return line
 
 
+def _fleet_snapshot(root: Path) -> Optional[dict]:
+    """A fresh fleet snapshot: poll the replicas discovered under ``root``
+    (this also refreshes ``fleet_status.json`` and advances the verdict
+    engine's persisted hysteresis state); when nothing is discoverable,
+    fall back to a previously written ``fleet_status.json``."""
+    from .federate import FLEET_STATUS_JSON, FleetScraper, read_fleet_status
+    scraper = FleetScraper(fleet_dir=root)
+    snap = scraper.poll()
+    if snap.get("replicas"):
+        return snap
+    stale = read_fleet_status(root / FLEET_STATUS_JSON)
+    return stale if stale.get("replicas") else None
+
+
+def render_fleet_frame(root) -> Optional[str]:
+    """One `top --fleet` frame: per-replica health lines, the fleet
+    rollup, merged latency quantiles and the hysteresis-gated scale
+    verdict. None when no replica (live or recorded) is visible."""
+    root = Path(root)
+    snap = _fleet_snapshot(root)
+    if snap is None:
+        return None
+    summary = snap.get("summary") or {}
+    verdict = snap.get("verdict") or {}
+    lines: List[str] = []
+    lines.append(f"Autocycler fleet — {root}  "
+                 f"[{summary.get('replicas', 0)} replica(s): "
+                 f"{summary.get('healthy', 0)} healthy, "
+                 f"{summary.get('stale', 0)} stale, "
+                 f"{summary.get('down', 0)} down]")
+    for name in sorted(snap.get("replicas") or {}):
+        block = snap["replicas"][name] or {}
+        health = block.get("health") or {}
+        if block.get("healthy"):
+            state = health.get("status", "ok")
+        elif health:
+            state = "stale"
+        else:
+            state = "down"
+        line = (f"  {name:16s} {state:8s} "
+                f"{block.get('endpoint', '?')}")
+        if health:
+            workers = health.get("workers") or 0
+            busy = health.get("busy_workers") or 0
+            line += (f"  queue {health.get('queue_depth', 0)}"
+                     f"  busy {busy}/{workers}")
+            slo = health.get("slo") or {}
+            burn = slo.get("burn_rate")
+            if isinstance(burn, (int, float)):
+                line += f"  burn {burn:g}"
+            if health.get("version"):
+                line += f"  v{health['version']}"
+        elif block.get("error"):
+            line += f"  ({block['error']})"
+        lines.append(line)
+    util = summary.get("utilization")
+    rollup = (f"Fleet        queue {summary.get('queue_depth', 0)}"
+              f"  busy {summary.get('busy_workers', 0)}"
+              f"/{summary.get('workers', 0)}")
+    if isinstance(util, (int, float)):
+        rollup += f"  util {util * 100:.0f}%"
+    burn = summary.get("burn_rate")
+    if isinstance(burn, (int, float)):
+        rollup += f"  burn {burn:g}"
+    jobs = summary.get("jobs") or {}
+    if jobs:
+        rollup += "  jobs " + " · ".join(
+            f"{n} {state}" for state, n in sorted(jobs.items()))
+    lines.append(rollup)
+    # fleet latency: the merged (bucket-wise summed) job-seconds histogram
+    # with the most observations across label sets
+    hists = (snap.get("metrics") or {}).get("hists") or {}
+    best = None
+    for key, h in hists.items():
+        if key.startswith("autocycler_serve_job_seconds") \
+                and isinstance(h, dict) and h.get("count"):
+            if best is None or h["count"] > best["count"]:
+                best = h
+    if best is not None and best.get("p50") is not None:
+        line = (f"Latency      fleet p50 {obs_report._fmt_s(best['p50'])}")
+        if best.get("p95") is not None:
+            line += f"  p95 {obs_report._fmt_s(best['p95'])}"
+        line += (f"  ({best['count']} job(s) across "
+                 f"{best.get('replicas', '?')} replica(s))")
+        lines.append(line)
+    if summary.get("version_skew"):
+        lines.append("Versions     SKEW: "
+                     + ", ".join(summary.get("versions") or []))
+    vline = f"Verdict      {verdict.get('verdict', 'steady').upper()}"
+    reasons = verdict.get("reasons") or []
+    if reasons:
+        vline += "  (" + "; ".join(reasons) + ")"
+    desired = verdict.get("desired")
+    if desired and desired != verdict.get("verdict"):
+        vline += (f"  [pending {desired}: streak "
+                  f"{verdict.get('streak', 0)}/{verdict.get('needed', 1)}]")
+    cooldown = verdict.get("cooldown_remaining_s")
+    if isinstance(cooldown, (int, float)) and cooldown > 0:
+        vline += f"  [cooldown {obs_report._fmt_s(cooldown)}]"
+    lines.append(vline)
+    return "\n".join(lines).rstrip() + "\n"
+
+
 def top(root, follow: bool = False, interval: float = 2.0,
-        cycles: Optional[int] = None) -> int:
+        cycles: Optional[int] = None, fleet: bool = False) -> int:
     """CLI entry for `autocycler top`. ``--once`` renders the current
     fleet state and exits (1 when the directory holds no artifacts at
     all); ``--follow`` re-renders until interrupted (or ``cycles``
-    frames)."""
+    frames). ``--fleet`` switches to the federated view: ``root`` is a
+    fleet dir of replica serve roots, each frame polls every replica and
+    renders the merged snapshot + scale verdict."""
     root = Path(root)
+    render = render_fleet_frame if fleet else render_top_frame
     if not follow:
-        frame = render_top_frame(root)
+        frame = render(root)
         if frame is None:
-            print(f"Error: no {TIMESERIES_JSONL}, serve.json or "
-                  f"serve_manifest.json in {root} — nothing to show",
-                  file=sys.stderr)
+            if fleet:
+                print(f"Error: no replica serve.json (or fleet_status.json)"
+                      f" under {root} — nothing to federate",
+                      file=sys.stderr)
+            else:
+                print(f"Error: no {TIMESERIES_JSONL}, serve.json or "
+                      f"serve_manifest.json in {root} — nothing to show",
+                      file=sys.stderr)
             return 1
         print(frame, end="")
         return 0
@@ -343,7 +454,7 @@ def top(root, follow: bool = False, interval: float = 2.0,
     announced_wait = False
     with contextlib.suppress(KeyboardInterrupt):
         while True:
-            frame = render_top_frame(root)
+            frame = render(root)
             if frame is None:
                 if not announced_wait:
                     print(f"Waiting for artifacts in {root} "

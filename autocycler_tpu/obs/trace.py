@@ -256,12 +256,16 @@ def current_span() -> Optional[_Span]:
     return stack[-1] if stack else None
 
 
-def _create_run(trace_dir, name: str) -> _Run:
+def _create_run(trace_dir, name: str, trace_id: Optional[str] = None) -> _Run:
     trace_dir = Path(trace_dir)
     trace_dir.mkdir(parents=True, exist_ok=True)
     run = _Run(trace_dir, name)
     header = {"type": "run", "name": name, "t0_epoch": run.t0_epoch,
               "pid": os.getpid(), "argv": list(sys.argv)}
+    if trace_id:
+        # cross-process correlation id (X-Autocycler-Trace):
+        # `autocycler report --correlate <id>` matches runs on this key
+        header["trace_id"] = trace_id
     run.file.write(json.dumps(header) + "\n")
     run.file.flush()
     return run
@@ -280,12 +284,14 @@ def start_run(trace_dir, name: str = "run") -> Path:
         return _run.dir
 
 
-def open_run(trace_dir, name: str = "run") -> _Run:
+def open_run(trace_dir, name: str = "run",
+             trace_id: Optional[str] = None) -> _Run:
     """Open a *scoped* run: records like the process-wide run but does not
     claim the process-wide slot, so any number can be open concurrently
     (one per serve job). Threads record into it via :class:`bind_run`;
-    finish it with :func:`close_run`."""
-    run = _create_run(trace_dir, name)
+    finish it with :func:`close_run`. ``trace_id`` (a client correlation
+    id) lands in the run header for `report --correlate`."""
+    run = _create_run(trace_dir, name, trace_id=trace_id)
     with _lock:
         _scoped_runs.append(run)
     return run

@@ -23,7 +23,8 @@ from typing import Optional, Tuple
 from urllib.parse import urlparse
 
 from ..utils import AutocyclerError, log
-from .protocol import DEFAULT_PORT, SERVE_INFO_JSON, JobSpec
+from .protocol import (DEFAULT_PORT, SERVE_INFO_JSON, TRACE_HEADER, JobSpec,
+                       mint_trace_id, sanitize_trace_id)
 
 
 class _UnixHTTPConnection(http.client.HTTPConnection):
@@ -38,6 +39,19 @@ class _UnixHTTPConnection(http.client.HTTPConnection):
         self.sock = sock
 
 
+def _read_serve_info(path) -> dict:
+    """Never-raise ``serve.json`` reader, mirroring ``read_manifest``: a
+    missing, torn (daemon mid-write or crashed), or non-object discovery
+    file yields {} — the CALLER decides whether that's fatal, with one
+    clear error at the decision point instead of a JSONDecodeError
+    traceback from whichever byte the tear landed on."""
+    try:
+        data = json.loads(Path(path).read_text())
+    except (OSError, ValueError):
+        return {}
+    return data if isinstance(data, dict) else {}
+
+
 def resolve_endpoint(server: Optional[str] = None,
                      socket_path: Optional[str] = None,
                      serve_dir=None) -> str:
@@ -48,14 +62,13 @@ def resolve_endpoint(server: Optional[str] = None,
         return f"unix:{socket_path}"
     if serve_dir is not None:
         info_path = Path(serve_dir) / SERVE_INFO_JSON
-        try:
-            info = json.loads(info_path.read_text())
-            if info.get("endpoint"):
-                return info["endpoint"]
-        except (OSError, json.JSONDecodeError) as e:
-            raise AutocyclerError(
-                f"cannot read daemon discovery file {info_path} "
-                f"({e}) — is `autocycler serve` running with that root?")
+        endpoint = _read_serve_info(info_path).get("endpoint")
+        if isinstance(endpoint, str) and endpoint:
+            return endpoint
+        raise AutocyclerError(
+            f"cannot resolve a daemon endpoint from {info_path} "
+            f"(missing, unreadable, or torn discovery file) — is "
+            f"`autocycler serve` running with that root?")
     from ..utils.knobs import knob_str
     env = (knob_str("AUTOCYCLER_SERVE") or "").strip()
     if env:
@@ -76,13 +89,17 @@ def _connect(endpoint: str, timeout: float = 10.0
 
 def request_json(endpoint: str, method: str, path: str,
                  body: Optional[dict] = None,
-                 timeout: float = 10.0) -> Tuple[int, dict]:
+                 timeout: float = 10.0,
+                 headers: Optional[dict] = None) -> Tuple[int, dict]:
     """One JSON request/response round trip; raises AutocyclerError when
-    the daemon is unreachable."""
+    the daemon is unreachable. ``headers`` are extra request headers
+    (e.g. the X-Autocycler-Trace correlation id)."""
     conn = _connect(endpoint, timeout=timeout)
     try:
         payload = json.dumps(body).encode() if body is not None else None
+        extra = dict(headers or {})
         headers = {"Content-Type": "application/json"} if payload else {}
+        headers.update(extra)
         # shared-secret auth rides automatically when the client's
         # environment carries the daemon's token knob; the value is sent
         # on the wire only, never logged
@@ -128,24 +145,47 @@ def wait_for_job(endpoint: str, job_id: str, poll_s: float = 0.5,
 
 def submit(assemblies_dir, server: Optional[str] = None,
            socket_path: Optional[str] = None, serve_dir=None,
+           fleet_dir=None,
            command: str = "compress", out_dir=None, kmer: int = 51,
            max_contigs: int = 25, threads: int = 8,
            wait: bool = False, follow: bool = False,
-           poll_s: float = 0.5, timeout: Optional[float] = None) -> int:
+           poll_s: float = 0.5, timeout: Optional[float] = None,
+           trace_id: Optional[str] = None) -> int:
     """CLI entry for `autocycler submit`. Returns the exit code: 0 for an
-    admitted (or, with --wait/--follow, completed) job, 1 for a failed one."""
-    endpoint = resolve_endpoint(server, socket_path, serve_dir)
+    admitted (or, with --wait/--follow, completed) job, 1 for a failed one.
+
+    With ``fleet_dir``, the endpoint comes from the client-side router
+    (least-loaded healthy replica) instead of a single daemon's
+    discovery file. Every submission mints (or sanitizes the caller's)
+    correlation id and sends it as the X-Autocycler-Trace header; the
+    daemon threads it into the job's trace/QC/ledger so
+    `autocycler report --correlate <id>` can reassemble the whole story."""
+    if fleet_dir is not None:
+        from .router import pick_replica
+        picked = pick_replica(fleet_dir=fleet_dir)
+        endpoint = picked["endpoint"]
+        log.message(
+            f"routed to {picked['name']} ({endpoint}; "
+            f"queue {picked['queue_depth']}, "
+            f"busy {picked['busy_workers']}/{picked['workers']}, "
+            f"{picked['candidates']} healthy)")
+    else:
+        endpoint = resolve_endpoint(server, socket_path, serve_dir)
+    cid = sanitize_trace_id(trace_id) or mint_trace_id()
     spec = JobSpec(assemblies_dir=str(assemblies_dir), command=command,
                    out_dir=str(out_dir) if out_dir else None, kmer=kmer,
                    max_contigs=max_contigs, threads=threads)
     status, record = request_json(endpoint, "POST", "/jobs",
-                                  body=spec.to_dict())
+                                  body=spec.to_dict(),
+                                  headers={TRACE_HEADER: cid})
     if status != 202:
         raise AutocyclerError(
             f"job submission rejected (HTTP {status}): "
             f"{record.get('error', record)}")
     job_id = record["id"]
     log.message(f"submitted {job_id} [{record['state']}] to {endpoint}")
+    log.message(f"  trace id: {cid} "
+                f"(autocycler report --correlate {cid})")
     log.message(f"  run dir: {record['run_dir']}")
     log.message(f"  outputs: {record['out_dir']}")
     if follow:

@@ -14,6 +14,8 @@ a rejected spec costs an HTTP 400, never a quarantined job.
 
 from __future__ import annotations
 
+import re
+import uuid
 from dataclasses import dataclass
 from typing import Optional
 
@@ -25,6 +27,32 @@ DEFAULT_PORT = 8642
 # daemon discovery file written into the serve root so local clients
 # (`autocycler submit --dir <root>`) find the endpoint without flags
 SERVE_INFO_JSON = "serve.json"
+
+# cross-process correlation id header: the client mints one id per
+# submission and the daemon threads it into the job's trace run header,
+# QC scope, ledger and fleet-shard spans — `autocycler report --correlate
+# <id>` then merges every matching trace.jsonl into one Chrome trace. It
+# rides a header (not the spec body) so pre-federation daemons ignore it
+# instead of rejecting the spec.
+TRACE_HEADER = "X-Autocycler-Trace"
+TRACE_ID_MAX = 64
+_TRACE_ID_RE = re.compile(r"[^A-Za-z0-9._-]+")
+
+
+def mint_trace_id() -> str:
+    """A fresh correlation id: short, URL/filename/label-safe."""
+    return f"t-{uuid.uuid4().hex[:12]}"
+
+
+def sanitize_trace_id(raw) -> Optional[str]:
+    """Normalize a client-supplied correlation id: keep only
+    ``[A-Za-z0-9._-]``, cap the length, and return None for anything
+    empty — a hostile or torn header value can never become a path
+    component or an unbounded label."""
+    if not isinstance(raw, str):
+        return None
+    cleaned = _TRACE_ID_RE.sub("", raw.strip())[:TRACE_ID_MAX]
+    return cleaned or None
 
 # job lifecycle: queued -> running -> done | failed. "failed" covers
 # quarantined jobs — the job is recorded and the daemon keeps serving.

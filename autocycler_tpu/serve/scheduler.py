@@ -94,7 +94,8 @@ class Job:
 
     def __init__(self, job_id: str, spec: JobSpec, run_dir: Path,
                  out_dir: Path,
-                 fleet_specs: Optional[List[JobSpec]] = None):
+                 fleet_specs: Optional[List[JobSpec]] = None,
+                 trace_id: Optional[str] = None):
         self.id = job_id
         self.spec = spec
         self.run_dir = run_dir
@@ -106,6 +107,11 @@ class Job:
         # a fleet admission: ONE queue slot whose execution fans these
         # items over the mesh (commands.batch.run_fleet_jobs)
         self.fleet_specs: Optional[List[JobSpec]] = fleet_specs
+        # cross-process correlation id (X-Autocycler-Trace): already
+        # sanitized at the HTTP boundary, threaded (never assigned late —
+        # the job is worker-visible once enqueued) into the trace run
+        # header, QC report and ledger
+        self.trace_id: Optional[str] = trace_id
         self.submitted_epoch = time.time()
         self.started_epoch: Optional[float] = None
         self.finished_epoch: Optional[float] = None
@@ -121,7 +127,7 @@ class Job:
         return self._base_dict()
 
     def _base_dict(self) -> dict:
-        return {
+        record = {
             "id": self.id,
             "state": self.state,
             "spec": self.spec.to_dict(),
@@ -139,6 +145,10 @@ class Job:
             "queue_wait_s": round(self.queue_wait_s, 3)
             if self.queue_wait_s is not None else None,
         }
+        if self.trace_id:
+            # additive key only: pre-federation clients keep parsing
+            record["trace_id"] = self.trace_id
+        return record
 
 
 class Scheduler:
@@ -217,8 +227,10 @@ class Scheduler:
                     continue
                 run_dir = self.root / "jobs" / name
                 out_dir = Path(entry.get("out_dir") or (run_dir / "out"))
+                tid = entry.get("trace_id")
                 job = Job(name, fleet_specs[0], run_dir, out_dir,
-                          fleet_specs=fleet_specs)
+                          fleet_specs=fleet_specs,
+                          trace_id=tid if isinstance(tid, str) else None)
                 job.resumed = status == "running"
                 submitted = entry.get("submitted_epoch")
                 if isinstance(submitted, (int, float)):
@@ -239,7 +251,9 @@ class Scheduler:
                 continue
             run_dir = self.root / "jobs" / name
             out_dir = Path(entry.get("out_dir") or (run_dir / "out"))
-            job = Job(name, spec, run_dir, out_dir)
+            tid = entry.get("trace_id")
+            job = Job(name, spec, run_dir, out_dir,
+                      trace_id=tid if isinstance(tid, str) else None)
             job.resumed = status == "running"
             parent = entry.get("parent")
             if isinstance(parent, str):
@@ -271,16 +285,18 @@ class Scheduler:
 
     # ---- admission ----
 
-    def submit(self, spec: JobSpec) -> Job:
+    def submit(self, spec: JobSpec,
+               trace_id: Optional[str] = None) -> Job:
         """Admit one job into the bounded queue; raises
         :class:`QueueFullError` at capacity (never blocks the caller)."""
         with self._lock:
-            job = self._admit_locked(spec)
+            job = self._admit_locked(spec, trace_id=trace_id)
         # persist everything replay needs: a restarted daemon rebuilds the
         # Job from the manifest entry alone
         self.manifest.annotate(
             job.id, spec=spec.to_dict(), out_dir=str(job.out_dir),
-            submitted_epoch=round(job.submitted_epoch, 3))
+            submitted_epoch=round(job.submitted_epoch, 3),
+            **({"trace_id": job.trace_id} if job.trace_id else {}))
         metrics_registry.counter_inc(
             SUBMITTED_TOTAL, 1, help="jobs admitted into the work queue")
         self._gauge_depth()
@@ -288,16 +304,19 @@ class Scheduler:
 
     def _admit_locked(self, spec: JobSpec,
                       parent: Optional[str] = None,
-                      fleet_specs: Optional[List[JobSpec]] = None) -> Job:
+                      fleet_specs: Optional[List[JobSpec]] = None,
+                      trace_id: Optional[str] = None) -> Job:
         """Create + enqueue one job. Caller holds ``self._lock``.
-        ``fleet_specs`` must be threaded through here (not assigned after)
-        — the job is visible to workers the moment it is enqueued, and a
-        late assignment would race a worker into the single-spec path."""
+        ``fleet_specs`` and ``trace_id`` must be threaded through here
+        (not assigned after) — the job is visible to workers the moment it
+        is enqueued, and a late assignment would race a worker into the
+        single-spec path (or an untagged trace run)."""
         job_id = f"job-{self._next_id:06d}"
         self._next_id += 1
         run_dir = self.root / "jobs" / job_id
         out_dir = Path(spec.out_dir) if spec.out_dir else run_dir / "out"
-        job = Job(job_id, spec, run_dir, out_dir, fleet_specs=fleet_specs)
+        job = Job(job_id, spec, run_dir, out_dir, fleet_specs=fleet_specs,
+                  trace_id=trace_id)
         job.parent = parent
         try:
             self._queue.put_nowait(job)
@@ -311,7 +330,8 @@ class Scheduler:
         self._jobs[job_id] = job
         return job
 
-    def submit_fleet(self, specs: List[JobSpec]) -> Job:
+    def submit_fleet(self, specs: List[JobSpec],
+                     trace_id: Optional[str] = None) -> Job:
         """Admit a fleet batch as ONE job: a single queue slot and worker
         whose execution fans the items over the device mesh
         (commands.batch.run_fleet_jobs), instead of ``submit_batch``'s N
@@ -319,7 +339,8 @@ class Scheduler:
         queue is at capacity."""
         specs = list(specs)
         with self._lock:
-            job = self._admit_locked(specs[0], fleet_specs=specs)
+            job = self._admit_locked(specs[0], fleet_specs=specs,
+                                     trace_id=trace_id)
         # persist the full item list: a restarted daemon rebuilds the
         # fleet job from the manifest entry alone and resumes it from the
         # per-isolate stage checkpoints in its fleet manifest
@@ -327,13 +348,15 @@ class Scheduler:
             job.id, kind="fleet",
             fleet_specs=[s.to_dict() for s in specs],
             out_dir=str(job.out_dir),
-            submitted_epoch=round(job.submitted_epoch, 3))
+            submitted_epoch=round(job.submitted_epoch, 3),
+            **({"trace_id": job.trace_id} if job.trace_id else {}))
         metrics_registry.counter_inc(
             SUBMITTED_TOTAL, 1, help="jobs admitted into the work queue")
         self._gauge_depth()
         return job
 
-    def submit_batch(self, specs: List[JobSpec]) -> dict:
+    def submit_batch(self, specs: List[JobSpec],
+                     trace_id: Optional[str] = None) -> dict:
         """Fan a multi-isolate batch out into child jobs under one parent
         id. All-or-nothing: when fewer than ``len(specs)`` queue slots are
         free the whole batch is rejected (503), so a client never has to
@@ -351,7 +374,8 @@ class Scheduler:
                     "complete")
             parent_id = f"batch-{self._next_id:06d}"
             self._next_id += 1
-            children = [self._admit_locked(spec, parent=parent_id)
+            children = [self._admit_locked(spec, parent=parent_id,
+                                           trace_id=trace_id)
                         for spec in specs]
             self._parents[parent_id] = {
                 "children": [j.id for j in children],
@@ -360,7 +384,8 @@ class Scheduler:
             self.manifest.annotate(
                 job.id, spec=job.spec.to_dict(), out_dir=str(job.out_dir),
                 submitted_epoch=round(job.submitted_epoch, 3),
-                parent=parent_id)
+                parent=parent_id,
+                **({"trace_id": job.trace_id} if job.trace_id else {}))
         self.manifest.annotate(
             parent_id, kind="batch", children=[j.id for j in children],
             submitted_epoch=self._parents[parent_id]["submitted_epoch"])
@@ -526,7 +551,8 @@ class Scheduler:
             run = None
             try:
                 run = trace.open_run(job.run_dir,
-                                     name=f"serve-{spec.command}")
+                                     name=f"serve-{spec.command}",
+                                     trace_id=job.trace_id)
             except OSError:
                 # unwritable run dir — run the job untraced rather than
                 # refuse it
@@ -537,9 +563,12 @@ class Scheduler:
                 with contextlib.ExitStack() as ctx:
                     if run is not None:
                         ctx.enter_context(trace.bind_run(run))
+                    span_attrs = {"job": job.id, "command": spec.command}
+                    if job.trace_id:
+                        span_attrs["trace"] = job.trace_id
                     ctx.enter_context(
                         trace.span(f"job/{job.id}", cat="command",
-                                   job=job.id, command=spec.command))
+                                   **span_attrs))
                     ctx.enter_context(obs_qc.scope(job.id))
                     if job.fleet_specs:
                         self._run_fleet(job)
@@ -556,10 +585,11 @@ class Scheduler:
                 if run is not None:
                     run_dir = trace.close_run(run)
                     if run_dir:
-                        obs_qc.write_qc_report(run_dir, scope=job.id)
+                        obs_qc.write_qc_report(run_dir, scope=job.id,
+                                               trace_id=job.trace_id)
                         ledger.write_ledger(
                             run_dir, command=f"serve/{spec.command}",
-                            scope=job.id)
+                            scope=job.id, trace_id=job.trace_id)
                 # the job's journal/ledger entries are flushed into its run
                 # dir; drain them so a long-lived daemon's shared tables
                 # stay bounded

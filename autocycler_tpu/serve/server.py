@@ -54,8 +54,9 @@ from ..obs.timeseries import TimeseriesSampler, timeseries_enabled
 from ..utils import log
 from ..utils.knobs import knob_str
 from ..utils.resilience import InputError
-from .protocol import (DEFAULT_PORT, SERVE_INFO_JSON, is_batch_spec,
-                       is_fleet_batch, parse_batch_spec, parse_job_spec,
+from .protocol import (DEFAULT_PORT, SERVE_INFO_JSON, TRACE_HEADER,
+                       is_batch_spec, is_fleet_batch, parse_batch_spec,
+                       parse_job_spec, sanitize_trace_id,
                        validate_fleet_batch)
 from .scheduler import SHED_TOTAL, QueueFullError, Scheduler
 
@@ -169,6 +170,13 @@ class _Handler(BaseHTTPRequestHandler):
         if parsed.path == "/healthz":
             return self._send_json(200, self.state.health(), "/healthz")
         if parsed.path == "/metrics":
+            # ?format=json serves the registry snapshot (full histogram
+            # bucket state incl. min/max) — what the fleet federation
+            # scraper merges; the default stays Prometheus text
+            query = parse_qs(parsed.query)
+            if query.get("format", [""])[0] == "json":
+                return self._send_json(200, metrics_registry.snapshot(),
+                                       "/metrics")
             body = metrics_registry.to_prometheus().encode()
             return self._send_bytes(200, body,
                                     "text/plain; version=0.0.4", "/metrics")
@@ -218,6 +226,10 @@ class _Handler(BaseHTTPRequestHandler):
             return
         parsed = urlparse(self.path)
         if parsed.path == "/jobs":
+            # correlation id: optional client-minted header, sanitized so a
+            # hostile value can never become a label or path fragment; it
+            # threads through the scheduler into trace/QC/ledger artifacts
+            trace_id = sanitize_trace_id(self.headers.get(TRACE_HEADER))
             try:
                 body = self._read_json()
                 batch = is_batch_spec(body)
@@ -257,11 +269,13 @@ class _Handler(BaseHTTPRequestHandler):
                     # one admission, one queue slot: the worker fans the
                     # items over the device mesh via the fleet runner
                     record = self.state.scheduler.submit_fleet(
-                        specs).to_dict()
+                        specs, trace_id=trace_id).to_dict()
                 elif batch:
-                    record = self.state.scheduler.submit_batch(specs)
+                    record = self.state.scheduler.submit_batch(
+                        specs, trace_id=trace_id)
                 else:
-                    record = self.state.scheduler.submit(specs[0]).to_dict()
+                    record = self.state.scheduler.submit(
+                        specs[0], trace_id=trace_id).to_dict()
             except QueueFullError as e:
                 return self._send_json(503, {"error": str(e)}, "/jobs",
                                        headers={"Retry-After": RETRY_AFTER_S})
@@ -332,6 +346,10 @@ class ServeHandle:
     def start(self) -> "ServeHandle":
         """Start the scheduler worker and the HTTP accept loop (on a
         background thread) and write the discovery file."""
+        # version info rides every /metrics export so a federated scrape
+        # can flag replica version skew
+        from ..obs.federate import record_build_info
+        record_build_info()
         self.scheduler.start()
         if self.sampler is not None:
             self.sampler.start()

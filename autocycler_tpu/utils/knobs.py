@@ -447,6 +447,55 @@ KNOBS: Dict[str, Knob] = dict(
             None,
             "Burn-rate threshold above which the serve daemon sheds new submissions with 503 + Retry-After; unset disables admission control.",
         ),
+        # --- fleet federation / scale verdicts -----------------------------
+        _k(
+            "AUTOCYCLER_FED_TIMEOUT_S",
+            "float",
+            2.0,
+            "Per-replica timeout in seconds for the fleet scraper's /healthz and /metrics polls; a slow replica is marked unhealthy, never waited on.",
+        ),
+        _k(
+            "AUTOCYCLER_FED_STALE_S",
+            "float",
+            30.0,
+            "Freshness window in seconds for fleet federation: a replica that fails a scrape keeps its last-known data (marked stale) for this long, then reports unknown.",
+        ),
+        _k(
+            "AUTOCYCLER_SCALE_OUT_BURN",
+            "float",
+            1.0,
+            "Fleet burn rate above which the scale-verdict engine proposes scale_out.",
+        ),
+        _k(
+            "AUTOCYCLER_SCALE_OUT_UTIL",
+            "float",
+            0.8,
+            "Fleet worker utilization (busy/total) above which the scale-verdict engine proposes scale_out.",
+        ),
+        _k(
+            "AUTOCYCLER_SCALE_OUT_QUEUE",
+            "float",
+            2.0,
+            "Queued jobs per healthy replica above which the scale-verdict engine proposes scale_out.",
+        ),
+        _k(
+            "AUTOCYCLER_SCALE_IN_UTIL",
+            "float",
+            0.0,
+            "Fleet utilization below which an idle multi-replica fleet proposes scale_in; the default 0.0 disables scale_in (utilization is never < 0).",
+        ),
+        _k(
+            "AUTOCYCLER_SCALE_COOLDOWN_S",
+            "float",
+            60.0,
+            "Minimum seconds between scale-verdict flips; a fresh flip holds through the cooldown even when the inputs keep flapping.",
+        ),
+        _k(
+            "AUTOCYCLER_SCALE_HYSTERESIS",
+            "int",
+            2,
+            "Consecutive agreeing fleet polls required before the scale verdict flips (floor 1).",
+        ),
         # --- bench ---------------------------------------------------------
         _k(
             "AUTOCYCLER_BENCH_THREADS",

@@ -1503,6 +1503,208 @@ def fleetsmoke_row(root=None) -> dict:
     return row
 
 
+FEDSMOKE_PATH = Path(__file__).resolve().parent / "FEDSMOKE.json"
+
+
+def bench_fedsmoke() -> None:
+    """`python bench.py fedsmoke`: the fleet-federation path end to end.
+    Two in-process serve replicas under one fleet dir; 4 tiny compress
+    jobs submitted through the client-side router (`--fleet-dir`) with
+    four gates: (a) the router spreads the idle fleet 2/2 and every
+    routed output is byte-identical to a direct caches-off compress run;
+    (b) the federated scraper's fleet_status.json carries EXACT counter
+    sums (merged counter == sum of the per-replica /metrics scrapes, key
+    for key); (c) the scale-verdict engine walks
+    steady -> scale_out -> steady when the SLO objective is pinned
+    impossibly tight for two polls and then released (hysteresis=2,
+    cooldown=0); (d) two more jobs submitted under ONE correlation id
+    land on both replicas and `report --correlate` merges their traces
+    into one Chrome trace with one process lane per replica. Writes
+    FEDSMOKE.json (surfaced by `bench.py trend`); one JSON line on
+    stdout; exit 1 on failure."""
+    import contextlib
+    import os
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent / "tests"))
+    from synthetic import make_assemblies
+
+    from autocycler_tpu.commands.compress import compress as run_compress
+    from autocycler_tpu.obs.federate import (FleetScraper, discover_replicas,
+                                             scrape_replica)
+    from autocycler_tpu.obs.report import (find_correlated_traces,
+                                           write_correlated_trace)
+    from autocycler_tpu.obs.timeseries import _flat_key
+    from autocycler_tpu.serve import client
+    from autocycler_tpu.serve.protocol import mint_trace_id
+    from autocycler_tpu.serve.server import ServeHandle
+    from autocycler_tpu.utils import cache as warm_cache
+
+    t0 = time.perf_counter()
+    tmp = Path(tempfile.mkdtemp(prefix="autocycler_fedsmoke_"))
+    asm = make_assemblies(tmp, n_assemblies=3, chromosome_len=30_000,
+                          plasmid_len=2_000, n_snps=10)
+    fleet = tmp / "fleet"
+    # two polls must flip the verdict, and a flip must never be blocked
+    # by the (autoscaler-scale) default cooldown
+    os.environ["AUTOCYCLER_SCALE_HYSTERESIS"] = "2"
+    os.environ["AUTOCYCLER_SCALE_COOLDOWN_S"] = "0"
+    warm_cache.set_shared_cache_dir(fleet / ".cache")
+    handles = [ServeHandle(fleet / f"r{i}", port=0).start()
+               for i in range(2)]
+    devnull = open(os.devnull, "w")
+    verdicts = []
+    try:
+        with contextlib.redirect_stderr(devnull):
+            # --- gate (a): router spread + byte identity ---
+            for i in range(4):
+                rc = client.submit(asm, fleet_dir=fleet, command="compress",
+                                   out_dir=tmp / f"out{i}", threads=2,
+                                   wait=True, poll_s=0.1, timeout=600)
+                assert rc == 0, f"routed job {i} failed"
+            os.environ["AUTOCYCLER_ENCODE_CACHE"] = "0"
+            try:
+                run_compress(asm, tmp / "ref", 51, 25, threads=2)
+            finally:
+                os.environ.pop("AUTOCYCLER_ENCODE_CACHE", None)
+
+            # --- gate (c): the verdict walk. One idle poll, two polls
+            # with the p50 objective pinned below any real job (every
+            # window job violates -> burn 2.0 > out_burn), two released.
+            scraper = FleetScraper(fleet_dir=fleet)
+            verdicts.append(scraper.poll()["verdict"]["verdict"])
+            os.environ["AUTOCYCLER_SLO_P50_S"] = "0.0001"
+            try:
+                for _ in range(2):
+                    verdicts.append(scraper.poll()["verdict"]["verdict"])
+            finally:
+                os.environ.pop("AUTOCYCLER_SLO_P50_S", None)
+            for _ in range(2):
+                verdicts.append(scraper.poll()["verdict"]["verdict"])
+
+            # --- gate (d): one correlation id across both replicas ---
+            cid = mint_trace_id()
+            for i in range(2):
+                rc = client.submit(asm, fleet_dir=fleet, command="compress",
+                                   out_dir=tmp / f"corr{i}", threads=2,
+                                   wait=True, poll_s=0.1, timeout=600,
+                                   trace_id=cid)
+                assert rc == 0, f"correlated job {i} failed"
+
+            # --- gate (b): exact counter sums, after the last poll so
+            # fleet_status.json reflects a quiescent fleet ---
+            snap = scraper.poll()
+            # re-scrape each replica directly and re-derive the serve
+            # counter sums. The job-lifecycle counters are quiescent
+            # post-run; requests_total is not (every scrape response
+            # increments it, including these), so the exactness contract
+            # is checked on the families whose value the scrape cannot
+            # perturb.
+            expect = {}
+            for rep in discover_replicas(fleet_dir=fleet):
+                metrics = scrape_replica(rep["endpoint"]).get(
+                    "metrics") or {}
+                for name, metric in metrics.items():
+                    if metric.get("type") != "counter" \
+                            or not name.startswith("autocycler_serve_") \
+                            or name == "autocycler_serve_requests_total":
+                        continue
+                    for entry in metric.get("values") or []:
+                        key = _flat_key(name, entry.get("labels") or {})
+                        expect[key] = round(
+                            expect.get(key, 0.0)
+                            + float(entry.get("value") or 0.0), 6)
+    finally:
+        with contextlib.redirect_stderr(devnull):
+            for handle in handles:
+                handle.stop()
+        warm_cache.set_shared_cache_dir(None)
+        devnull.close()
+        for key in ("AUTOCYCLER_SCALE_HYSTERESIS",
+                    "AUTOCYCLER_SCALE_COOLDOWN_S"):
+            os.environ.pop(key, None)
+
+    spread = sorted(len(h.scheduler.jobs()) for h in handles)
+    identical = all(
+        (tmp / out / name).read_bytes() == (tmp / "ref" / name).read_bytes()
+        for out in ("out0", "out1", "out2", "out3", "corr0", "corr1")
+        for name in ("input_assemblies.gfa", "input_assemblies.yaml"))
+
+    merged = snap["metrics"]["counters"]
+    counters_exact = bool(expect) \
+        and all(merged.get(k) == v for k, v in expect.items())
+
+    expected_verdicts = ["steady", "steady", "scale_out", "scale_out",
+                         "steady"]
+    verdict_ok = verdicts == expected_verdicts
+
+    matches = find_correlated_traces(fleet, cid)
+    corr_replicas = sorted({m["rel"].split("/")[0] for m in matches})
+    corr_out = write_correlated_trace(fleet, cid)
+    lanes = 0
+    if corr_out is not None:
+        chrome = json.loads(corr_out.read_text())
+        lanes = sum(1 for e in chrome.get("traceEvents", [])
+                    if e.get("name") == "process_name")
+    corr_ok = len(matches) == 2 and corr_replicas == ["r0", "r1"] \
+        and lanes == 2
+
+    passed = bool(spread == [3, 3] and identical and counters_exact
+                  and verdict_ok and corr_ok)
+    artifact = {
+        "bench": "fedsmoke",
+        "passed": passed,
+        "replicas": len(handles),
+        "jobs": 6,
+        "spread": spread,
+        "byte_identical": identical,
+        "counters_exact": counters_exact,
+        "counters_checked": len(expect),
+        "verdicts": verdicts,
+        "verdict_ok": verdict_ok,
+        "summary": snap.get("summary"),
+        "correlation_id": cid,
+        "correlated_runs": len(matches),
+        "correlated_replicas": corr_replicas,
+        "lanes": lanes,
+        "correlation_ok": corr_ok,
+        "wall_s": round(time.perf_counter() - t0, 2),
+    }
+    FEDSMOKE_PATH.write_text(json.dumps(artifact, indent=2) + "\n")
+    print(json.dumps(artifact))
+    if not passed:
+        sys.exit(1)
+
+
+def fedsmoke_row(root=None) -> dict:
+    """The latest fedsmoke artifact as one trend row; every field
+    optional (absent/invalid artifact → None-valued row, never a raise)."""
+    path = Path(root) / "FEDSMOKE.json" if root is not None \
+        else FEDSMOKE_PATH
+    row = {"present": False, "passed": None, "replicas": None,
+           "jobs": None, "spread": None, "byte_identical": None,
+           "counters_exact": None, "verdict_ok": None, "lanes": None,
+           "wall_s": None}
+    try:
+        data = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return row
+    if not isinstance(data, dict):
+        return row
+    row.update({
+        "present": True,
+        "passed": data.get("passed"),
+        "replicas": data.get("replicas"),
+        "jobs": data.get("jobs"),
+        "spread": data.get("spread"),
+        "byte_identical": data.get("byte_identical"),
+        "counters_exact": data.get("counters_exact"),
+        "verdict_ok": data.get("verdict_ok"),
+        "lanes": data.get("lanes"),
+        "wall_s": data.get("wall_s"),
+    })
+    return row
+
+
 GUARD_BASELINE_PATH = Path(__file__).resolve().parent / "BENCH_GUARD.json"
 GUARD_TOLERANCE = 1.25
 
@@ -2006,11 +2208,24 @@ def bench_trend() -> None:
               f"bytes identical: {serve.get('byte_identical')})  "
               f"(SERVESMOKE.json)",
               file=sys.stderr)
+    fed = fedsmoke_row()
+    if fed.get("present"):
+        verdict = "ok" if fed.get("passed") else "FAIL"
+        print("", file=sys.stderr)
+        print(f"fedsmoke: {verdict} "
+              f"{fmt(fed.get('jobs'))} routed jobs over "
+              f"{fmt(fed.get('replicas'))} replicas "
+              f"(spread {fed.get('spread')}, "
+              f"bytes identical: {fed.get('byte_identical')}, "
+              f"counter sums exact: {fed.get('counters_exact')}, "
+              f"verdict walk: {fed.get('verdict_ok')}, "
+              f"correlated lanes: {fmt(fed.get('lanes'))})  (FEDSMOKE.json)",
+              file=sys.stderr)
     print(json.dumps({"bench": "trend", "rounds": rows,
                       "multichip": mrows, "lintsmoke": lint,
                       "sketchsmoke": sketch, "streamsmoke": stream,
                       "chaossmoke": chaos, "fleetsmoke": fleetrow,
-                      "servesmoke": serve}))
+                      "servesmoke": serve, "fedsmoke": fed}))
 
 
 def main() -> None:
@@ -2058,6 +2273,8 @@ def main() -> None:
         bench_chaossmoke()
     elif len(sys.argv) > 1 and sys.argv[1] == "fleetsmoke":
         bench_fleetsmoke()
+    elif len(sys.argv) > 1 and sys.argv[1] == "fedsmoke":
+        bench_fedsmoke()
     elif len(sys.argv) > 1 and sys.argv[1] == "guard":
         bench_guard(sys.argv[2:])
     elif len(sys.argv) > 1 and sys.argv[1] == "trend":

@@ -1,0 +1,381 @@
+"""Fleet federation: replica discovery, the never-raise scraper, bucket-wise
+histogram merging, scale verdicts and cross-replica trace correlation.
+
+The merge guarantees mirror test_timeseries's quantile tests: a fleet
+p50/p95 computed from bucket-wise-summed histograms must agree with exact
+numpy percentiles of the POOLED per-replica samples to within the bucket
+width, and always bracket the observed [min, max]. The live tests drive
+two real in-process daemons over loopback HTTP: load-aware routing, merged
+``fleet_status.json``, build-info skew detection and a correlation id
+traced client -> replica -> job run.
+"""
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+from synthetic import make_assemblies
+
+pytestmark = [pytest.mark.serve, pytest.mark.obs]
+
+
+# ---------------------------------------------------------------- merging
+
+
+def _bucket_width(edges, value):
+    prev = 0.0
+    for edge in edges:
+        if value <= edge:
+            return edge - prev
+        prev = edge
+    return float("inf")
+
+
+@pytest.mark.parametrize("q,n_replicas", [(0.5, 2), (0.95, 2), (0.5, 5),
+                                          (0.95, 5)])
+def test_merged_hist_quantiles_vs_numpy(q, n_replicas):
+    """Fleet-merged p50/p95 must bracket the pooled per-replica samples:
+    merging bucket counts edge-for-edge is exact, so the only error left
+    is the same bucket-interpolation error a single registry has."""
+    from autocycler_tpu.obs.federate import merge_metrics
+    from autocycler_tpu.obs.metrics_registry import (MetricsRegistry,
+                                                     SECONDS_BUCKETS)
+
+    rng = random.Random(7 * n_replicas)
+    pooled = []
+    snapshots = {}
+    for r in range(n_replicas):
+        reg = MetricsRegistry()
+        # deliberately uneven load per replica
+        for _ in range(100 + 400 * r):
+            v = rng.lognormvariate(0.5, 0.9)
+            pooled.append(v)
+            reg.observe("autocycler_serve_job_seconds", v,
+                        buckets=SECONDS_BUCKETS, help="h",
+                        command="compress")
+        snapshots[f"r{r}"] = reg.snapshot()
+    merged = merge_metrics(snapshots)
+    entry = merged["hists"][
+        "autocycler_serve_job_seconds{command=compress}"]
+    assert entry["count"] == len(pooled)
+    assert entry["replicas"] == n_replicas and entry["skipped"] == 0
+    assert entry["min"] == pytest.approx(min(pooled))
+    assert entry["max"] == pytest.approx(max(pooled))
+    est = entry["p50"] if q == 0.5 else entry["p95"]
+    exact = float(np.percentile(pooled, q * 100))
+    assert est is not None
+    assert abs(est - exact) <= _bucket_width(SECONDS_BUCKETS, exact)
+    assert min(pooled) <= est <= max(pooled)
+
+
+def test_merge_counters_and_gauges():
+    from autocycler_tpu.obs.federate import merge_metrics
+    from autocycler_tpu.obs.metrics_registry import MetricsRegistry
+
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.counter_inc("autocycler_serve_jobs_total", 3, help="h", state="done")
+    b.counter_inc("autocycler_serve_jobs_total", 4, help="h", state="done")
+    b.counter_inc("autocycler_serve_jobs_total", 1, help="h", state="failed")
+    a.gauge_set("autocycler_serve_queue_depth", 2, help="h")
+    b.gauge_set("autocycler_serve_queue_depth", 5, help="h")
+    merged = merge_metrics({"a": a.snapshot(), "b": b.snapshot()})
+    assert merged["counters"][
+        "autocycler_serve_jobs_total{state=done}"] == 7
+    assert merged["counters"][
+        "autocycler_serve_jobs_total{state=failed}"] == 1
+    depth = merged["gauges"]["autocycler_serve_queue_depth"]
+    assert depth["replicas"] == {"a": 2.0, "b": 5.0}
+    assert depth["sum"] == 7.0 and depth["min"] == 2.0 and depth["max"] == 5.0
+
+
+def test_merge_hist_mismatched_edges_skipped():
+    """Replicas disagreeing on bucket ladders cannot be summed edge-wise:
+    the biggest-count group wins and the rest are counted as skipped."""
+    from autocycler_tpu.obs.federate import merge_hist_entries
+    from autocycler_tpu.obs.metrics_registry import (DEFAULT_BUCKETS,
+                                                     SECONDS_BUCKETS,
+                                                     MetricsRegistry)
+
+    big, small = MetricsRegistry(), MetricsRegistry()
+    for _ in range(10):
+        big.observe("autocycler_x_seconds", 1.0, buckets=SECONDS_BUCKETS,
+                    help="h")
+    small.observe("autocycler_x_seconds", 1.0, buckets=DEFAULT_BUCKETS,
+                  help="h")
+    entries = [big.snapshot()["autocycler_x_seconds"]["values"][0],
+               small.snapshot()["autocycler_x_seconds"]["values"][0]]
+    merged = merge_hist_entries(entries)
+    assert merged["count"] == 10
+    assert merged["replicas"] == 1 and merged["skipped"] == 1
+    assert merge_hist_entries([]) is None
+
+
+# ---------------------------------------------------------------- registry
+
+
+def test_read_serve_info_never_raises(tmp_path):
+    from autocycler_tpu.obs.federate import read_serve_info
+
+    assert read_serve_info(tmp_path / "missing.json") == {}
+    torn = tmp_path / "torn.json"
+    torn.write_text('{"endpoint": "http://127.0.0.1:1')
+    assert read_serve_info(torn) == {}
+    listy = tmp_path / "list.json"
+    listy.write_text('["not", "an", "object"]')
+    assert read_serve_info(listy) == {}
+
+
+def test_discover_replicas(tmp_path):
+    from autocycler_tpu.obs.federate import discover_replicas
+
+    (tmp_path / "r0").mkdir()
+    (tmp_path / "r1").mkdir()
+    (tmp_path / "r0" / "serve.json").write_text(
+        json.dumps({"endpoint": "http://127.0.0.1:1111"}))
+    (tmp_path / "r1" / "serve.json").write_text(
+        json.dumps({"endpoint": "http://127.0.0.1:2222"}))
+    (tmp_path / "r1" / "torn").mkdir()          # dir without serve.json
+    reps = discover_replicas(fleet_dir=tmp_path)
+    assert [(r["name"], r["endpoint"]) for r in reps] == [
+        ("r0", "http://127.0.0.1:1111"), ("r1", "http://127.0.0.1:2222")]
+    # explicit endpoints lead, duplicates collapse
+    reps = discover_replicas(fleet_dir=tmp_path,
+                             endpoints=["http://127.0.0.1:1111"])
+    assert [r["name"] for r in reps] == ["replica-0", "r1"]
+    assert discover_replicas() == []
+
+
+def test_scraper_dead_replica_never_raises(tmp_path, monkeypatch):
+    """A dead endpoint costs one timeout and a down mark — never an
+    exception, and its last-known health carries forward (stale) within
+    AUTOCYCLER_FED_STALE_S."""
+    from autocycler_tpu.obs.federate import FleetScraper, scrape_replica
+
+    monkeypatch.setenv("AUTOCYCLER_FED_TIMEOUT_S", "0.2")
+    dead = "http://127.0.0.1:9"     # discard port: nothing listens
+    assert "error" in scrape_replica(dead)
+
+    (tmp_path / "r0").mkdir()
+    (tmp_path / "r0" / "serve.json").write_text(
+        json.dumps({"endpoint": dead}))
+    out = tmp_path / "fleet_status.json"
+    # seed a prior snapshot so staleness carry-forward has data
+    import time
+    out.write_text(json.dumps({
+        "replicas": {"r0": {"scraped_epoch": time.time(),
+                            "health": {"status": "ok", "workers": 2}}}}))
+    scraper = FleetScraper(fleet_dir=tmp_path, out_path=out)
+    snap = scraper.poll()
+    block = snap["replicas"]["r0"]
+    assert block["healthy"] is False and block["stale"] is True
+    assert block["health"]["workers"] == 2      # carried forward
+    assert snap["summary"]["stale"] == 1 and snap["summary"]["down"] == 0
+    # outside the freshness window the carried data expires
+    monkeypatch.setenv("AUTOCYCLER_FED_STALE_S", "0")
+    snap = FleetScraper(fleet_dir=tmp_path, out_path=out).poll()
+    assert snap["replicas"]["r0"]["health"] is None
+    assert snap["summary"]["down"] == 1
+    assert json.loads(out.read_text())["summary"]["down"] == 1
+
+
+# ---------------------------------------------------------------- verdicts
+
+
+def _summary(burn=None, util=0.0, queue=0, healthy=2, qpr=None):
+    return {"healthy": healthy, "burn_rate": burn, "utilization": util,
+            "queue_depth": queue,
+            "queue_per_replica": queue / max(1, healthy)
+            if qpr is None else qpr}
+
+
+def test_verdict_hysteresis_and_flip(monkeypatch):
+    from autocycler_tpu.obs.federate import ScaleVerdictEngine
+
+    monkeypatch.setenv("AUTOCYCLER_SCALE_HYSTERESIS", "2")
+    monkeypatch.setenv("AUTOCYCLER_SCALE_COOLDOWN_S", "0")
+    eng = ScaleVerdictEngine()
+    # one hot poll is NOT enough (hysteresis=2) ...
+    assert eng.evaluate(_summary())["verdict"] == "steady"
+    v = eng.evaluate(_summary(burn=2.0))
+    assert v["verdict"] == "steady" and v["desired"] == "scale_out"
+    assert v["streak"] == 1 and "burn 2 > 1" in v["reasons"][0]
+    # ... two agreeing polls flip
+    assert eng.evaluate(_summary(burn=2.0))["verdict"] == "scale_out"
+    # and the way back down needs two calm polls too
+    assert eng.evaluate(_summary())["verdict"] == "scale_out"
+    assert eng.evaluate(_summary())["verdict"] == "steady"
+
+
+def test_verdict_cooldown_blocks_flip(monkeypatch):
+    from autocycler_tpu.obs.federate import ScaleVerdictEngine
+
+    monkeypatch.setenv("AUTOCYCLER_SCALE_HYSTERESIS", "1")
+    monkeypatch.setenv("AUTOCYCLER_SCALE_COOLDOWN_S", "3600")
+    eng = ScaleVerdictEngine()
+    assert eng.evaluate(_summary(burn=2.0), now=1000.0)[
+        "verdict"] == "scale_out"
+    # desired flips back immediately, but the cooldown holds the verdict
+    v = eng.evaluate(_summary(), now=1001.0)
+    assert v["verdict"] == "scale_out" and v["desired"] == "steady"
+    assert v["cooldown_remaining_s"] > 0
+    # once the cooldown elapses the queued flip lands
+    assert eng.evaluate(_summary(), now=1000.0 + 3601)["verdict"] == "steady"
+
+
+def test_verdict_scale_in_and_state_roundtrip(monkeypatch):
+    from autocycler_tpu.obs.federate import ScaleVerdictEngine
+
+    monkeypatch.setenv("AUTOCYCLER_SCALE_HYSTERESIS", "1")
+    monkeypatch.setenv("AUTOCYCLER_SCALE_COOLDOWN_S", "0")
+    monkeypatch.setenv("AUTOCYCLER_SCALE_IN_UTIL", "0.5")
+    eng = ScaleVerdictEngine()
+    v = eng.evaluate(_summary(util=0.1))
+    assert v["verdict"] == "scale_in"
+    # a single-replica fleet never proposes scale_in
+    eng2 = ScaleVerdictEngine()
+    assert eng2.evaluate(_summary(util=0.1, healthy=1))["verdict"] == "steady"
+    # the default in_util=0.0 disables scale_in (utilization is never < 0)
+    monkeypatch.delenv("AUTOCYCLER_SCALE_IN_UTIL")
+    eng3 = ScaleVerdictEngine()
+    assert eng3.evaluate(_summary(util=0.0))["verdict"] == "steady"
+    # state round-trips through the persisted verdict block: a fresh
+    # engine resumes mid-streak instead of restarting hysteresis
+    monkeypatch.setenv("AUTOCYCLER_SCALE_HYSTERESIS", "2")
+    eng4 = ScaleVerdictEngine()
+    state = eng4.evaluate(_summary(burn=2.0))
+    eng5 = ScaleVerdictEngine(state=state)
+    assert eng5.evaluate(_summary(burn=2.0))["verdict"] == "scale_out"
+
+
+# ---------------------------------------------------------------- router
+
+
+def test_router_load_score_ordering():
+    from autocycler_tpu.serve.router import load_score
+
+    idle = {"name": "a", "queue_depth": 0, "busy_workers": 0, "workers": 2,
+            "jobs_total": 0}
+    busy = {"name": "b", "queue_depth": 3, "busy_workers": 2, "workers": 2,
+            "jobs_total": 0}
+    wide = {"name": "c", "queue_depth": 3, "busy_workers": 2, "workers": 10,
+            "jobs_total": 0}
+    veteran = dict(idle, name="d", jobs_total=9)
+    ranked = sorted([busy, idle, wide, veteran], key=load_score)
+    # pressure normalised by capacity; lifetime jobs break ties
+    assert [p["name"] for p in ranked] == ["a", "d", "c", "b"]
+
+
+def test_router_no_replicas(tmp_path):
+    from autocycler_tpu.serve.router import (NoHealthyReplicaError,
+                                             pick_replica)
+
+    with pytest.raises(NoHealthyReplicaError):
+        pick_replica(fleet_dir=tmp_path)
+    with pytest.raises(NoHealthyReplicaError):
+        pick_replica(endpoints=["http://127.0.0.1:9"], timeout=0.2)
+
+
+# ---------------------------------------------------------------- live fleet
+
+
+@pytest.fixture
+def fleet(tmp_path):
+    """Two running daemons under one fleet dir, sharing the warm cache."""
+    from autocycler_tpu.serve.server import ServeHandle
+    from autocycler_tpu.utils import cache as warm_cache
+
+    fleet_dir = tmp_path / "fleet"
+    warm_cache.set_shared_cache_dir(fleet_dir / ".cache")
+    handles = [ServeHandle(fleet_dir / f"r{i}", port=0).start()
+               for i in range(2)]
+    try:
+        yield fleet_dir, handles
+    finally:
+        for handle in handles:
+            handle.stop()
+        warm_cache.set_shared_cache_dir(None)
+
+
+def test_fleet_scrape_merge_and_build_info(fleet, monkeypatch):
+    from autocycler_tpu.obs.federate import (FLEET_STATUS_JSON,
+                                             FleetScraper)
+
+    fleet_dir, handles = fleet
+    monkeypatch.setenv("AUTOCYCLER_SCALE_COOLDOWN_S", "0")
+    snap = FleetScraper(fleet_dir=fleet_dir).poll()
+    assert sorted(snap["replicas"]) == ["r0", "r1"]
+    assert snap["summary"]["healthy"] == 2 and snap["summary"]["down"] == 0
+    assert snap["summary"]["workers"] == sum(
+        h.scheduler.workers for h in handles)
+    # same package in both replicas -> no skew
+    assert snap["summary"]["version_skew"] is False
+    # the build-info metric is exported by every replica's /metrics
+    info = snap["metrics"]["info"]
+    key = next(k for k in info if k.startswith("autocycler_build_info"))
+    assert sorted(info[key]) == ["r0", "r1"]
+    # the snapshot landed atomically on disk
+    on_disk = json.loads((fleet_dir / FLEET_STATUS_JSON).read_text())
+    assert on_disk["summary"]["replicas"] == 2
+    assert on_disk["verdict"]["verdict"] in ("steady", "scale_in",
+                                             "scale_out")
+
+
+def test_fleet_routing_and_correlation(fleet, monkeypatch, tmp_path, capsys):
+    """The acceptance path in miniature: two jobs submitted through the
+    router land on different replicas (idle-fleet tie-break), both carry
+    one correlation id, and `report --correlate` merges their traces into
+    one Chrome trace with one process lane per replica job."""
+    from autocycler_tpu.obs.report import (find_correlated_traces,
+                                           write_correlated_trace)
+    from autocycler_tpu.serve import client
+
+    fleet_dir, handles = fleet
+    asm = make_assemblies(tmp_path / "asm")
+    cid = "t-fedtest0001"
+    for i in range(2):
+        rc = client.submit(asm, fleet_dir=fleet_dir, command="compress",
+                           out_dir=tmp_path / f"out{i}", wait=True,
+                           trace_id=cid)
+        assert rc == 0
+    ran = [len(h.scheduler.jobs()) for h in handles]
+    assert sorted(ran) == [1, 1], f"router did not spread the load: {ran}"
+    # every job record carries the id, client-visible
+    for handle in handles:
+        (job,) = handle.scheduler.jobs()
+        assert job.trace_id == cid
+        assert job.to_dict()["trace_id"] == cid
+        run_dir = job.run_dir
+        header = json.loads(
+            (run_dir / "trace.jsonl").read_text().splitlines()[0])
+        assert header["trace_id"] == cid
+        ledger = json.loads((run_dir / "ledger.json").read_text())
+        assert ledger["trace_id"] == cid
+    matches = find_correlated_traces(fleet_dir, cid)
+    assert len(matches) == 2
+    assert {m["rel"].split("/")[0] for m in matches} == {"r0", "r1"}
+    out = write_correlated_trace(fleet_dir, cid)
+    chrome = json.loads(out.read_text())
+    lanes = [e for e in chrome["traceEvents"]
+             if e.get("name") == "process_name"]
+    assert len(lanes) == 2
+    assert len({e["pid"] for e in chrome["traceEvents"]}) == 2
+    assert any(e.get("ph") == "X" for e in chrome["traceEvents"])
+    # an unknown id is a clean miss, not a crash
+    assert find_correlated_traces(fleet_dir, "t-nope") == []
+    assert write_correlated_trace(fleet_dir, "t-nope") is None
+
+
+def test_top_fleet_frame(fleet, monkeypatch):
+    from autocycler_tpu.obs.top import render_fleet_frame
+
+    fleet_dir, handles = fleet
+    monkeypatch.setenv("AUTOCYCLER_SCALE_COOLDOWN_S", "0")
+    frame = render_fleet_frame(fleet_dir)
+    assert frame is not None
+    assert "2 healthy" in frame
+    assert "r0" in frame and "r1" in frame
+    assert "Verdict" in frame
+    # an empty dir renders nothing (top --fleet exits 1)
+    assert render_fleet_frame(fleet_dir / "r0" / "jobs") is None

@@ -265,6 +265,27 @@ def test_unix_socket_and_discovery(tmp_path, capsys):
     capsys.readouterr()
 
 
+def test_resolve_endpoint_torn_serve_json(tmp_path):
+    """Regression: a missing, torn (partial write) or non-object
+    serve.json must raise ONE clear AutocyclerError from resolve_endpoint
+    — never leak AttributeError/JSONDecodeError from the raw read."""
+    from autocycler_tpu.serve.client import resolve_endpoint
+    from autocycler_tpu.serve.protocol import SERVE_INFO_JSON
+    from autocycler_tpu.utils import AutocyclerError
+
+    info = tmp_path / SERVE_INFO_JSON
+    for content in (None,                                # missing file
+                    '{"endpoint": "http://127.0.0.1:1',  # torn mid-write
+                    '["a", "list"]',                     # non-object JSON
+                    '{"port": 80}'):                     # no endpoint key
+        if content is None:
+            info.unlink(missing_ok=True)
+        else:
+            info.write_text(content)
+        with pytest.raises(AutocyclerError, match="autocycler serve"):
+            resolve_endpoint(serve_dir=tmp_path)
+
+
 def test_submit_client_roundtrip(serve_handle, tmp_path, capsys):
     """The `autocycler submit --wait` client path end to end: 0 for a done
     job, 1 for a quarantined one."""
